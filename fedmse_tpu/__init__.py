@@ -1,0 +1,23 @@
+"""fedmse-tpu: TPU-native decentralized federated learning for IoT intrusion detection.
+
+A brand-new JAX/XLA/pjit framework with the full capabilities of the reference
+implementation (judahx67/fedmse-decentralized — the decentralized variant of the
+FedMSE paper, Computers & Security 151:104337). Instead of the reference's
+sequential single-process simulation (`/root/reference/src/main.py`), all N
+federated clients live as one stacked pytree sharded over a TPU device mesh:
+local training is a vmapped/`shard_map`-ed jitted scan, and aggregation is a
+masked weighted tree-reduction that XLA lowers to ICI collectives.
+
+Package layout:
+  config        typed experiment/dataset config (JSON-compatible with the
+                reference's src/Configuration/*.json)
+  data          CSV -> splits -> scalers -> padded stacked device arrays
+  models        Flax AE / Shrink-AE and the centroid one-class classifier
+  ops           loss math, masked metrics (AUC/F1), stats
+  federation    local training engine, voting, aggregation, verification,
+                the round engine
+  evaluation    per-client AUC / classification / latency evaluator
+  utils         seeding, logging, similarity scores
+"""
+
+__version__ = "0.1.0"

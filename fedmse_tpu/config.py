@@ -1,0 +1,220 @@
+"""Typed configuration for fedmse-tpu experiments.
+
+The reference keeps hyperparameters as edited-in-source module globals
+(reference src/main.py:37-71) and dataset topology as JSON
+(src/Configuration/*.json, loaded at src/main.py:120-122). Here both live in
+one typed, CLI-overridable config:
+
+  * `DatasetConfig` is JSON-compatible with the reference's Configuration
+    files ({data_path, devices_list: [{id, name, normal_data_path,
+    abnormal_data_path, test_normal_data_path}]}).
+  * `ExperimentConfig` covers every reference global, with the reference's
+    committed quick-run values as defaults (src/main.py:37-57).
+
+Compat flags deliberately reproduce (or fix) the reference's accidental
+behaviors documented in SURVEY.md §2; each flag cites the quirk it controls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """One federated gateway's data locations (reference Configuration schema)."""
+
+    id: int
+    name: str
+    normal_data_path: str
+    abnormal_data_path: str
+    test_normal_data_path: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DatasetConfig:
+    """Mirror of the reference's JSON config (e.g. scen2-nba-iot-10clients.json)."""
+
+    data_path: str
+    devices_list: Tuple[DeviceSpec, ...]
+
+    @staticmethod
+    def from_json(path: str, data_root: Optional[str] = None) -> "DatasetConfig":
+        """Load a reference-format JSON config.
+
+        `data_root`, if given, replaces relative `data_path` resolution — the
+        reference resolves relative to src/ (src/main.py:133); we allow an
+        explicit root so the same JSON works from anywhere.
+        """
+        with open(path, "r") as f:
+            raw = json.load(f)
+        data_path = raw["data_path"]
+        if data_root is not None:
+            data_path = os.path.join(data_root, os.path.basename(data_path.rstrip("/")))
+        devices = tuple(
+            DeviceSpec(
+                id=int(d["id"]),
+                name=str(d["name"]),
+                normal_data_path=str(d["normal_data_path"]),
+                abnormal_data_path=str(d["abnormal_data_path"]),
+                test_normal_data_path=str(d["test_normal_data_path"]),
+            )
+            for d in raw["devices_list"]
+        )
+        return DatasetConfig(data_path=data_path, devices_list=devices)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "data_path": self.data_path,
+            "devices_list": [dataclasses.asdict(d) for d in self.devices_list],
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class CompatConfig:
+    """Switches for the reference's accidental-but-load-bearing behaviors.
+
+    Defaults reproduce the reference exactly (SURVEY.md §2 'behavioral
+    quirks'); set a flag False to get the fixed behavior.
+    """
+
+    # Quirk 6 (src/main.py:264): every trainer's verification `validation_data`
+    # is overwritten with the loop-leftover tensor — i.e. the LAST client's
+    # valid split. False => each client verifies on its own valid split.
+    shared_last_client_val: bool = True
+
+    # Quirk 10 (src/main.py:358-365): global early stopping treats AUC as a
+    # loss (improvement = min(client_metrics) < best). False => higher-is-better.
+    inverted_global_early_stop: bool = True
+
+    # Quirk 10b (src/main.py:55): `min_val_loss` is a module global never reset
+    # between combinations. False => reset per combination.
+    global_early_stop_state_shared: bool = True
+
+    # Quirk 11 (client_trainer.py:408-411): local early stopping saves the best
+    # model but training's final in-memory weights enter aggregation. False =>
+    # restore best weights after local training.
+    no_best_restore: bool = True
+
+    # Quirk 8 (client_trainer.py:220-223): `calculate_mse_score` re-standardizes
+    # already-standardized input with batch mean/std (ddof=1) + 1e-8.
+    restandardize_vote_data: bool = True
+
+    # Voting tie-break (client_trainer.py:243-245): multiply each MSE score by
+    # 1 + (U(0,1)-0.5)*2e-4. False => deterministic scores.
+    vote_tie_break: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """All reference hyperparameters (src/main.py:37-71), typed.
+
+    Defaults are the reference's committed quick-run values; the paper-scale
+    run (reference README.md:30-34) is epochs=100, num_rounds=20, lr=1e-5,
+    shrink_lambda=10.
+    """
+
+    # Federation topology / schedule (src/main.py:37-40, 51-57)
+    num_participants: float = 0.5
+    epochs: int = 5
+    num_rounds: int = 3
+    network_size: int = 10
+
+    # Optimization (src/main.py:40-41, client_trainer.py:47-66: Adam)
+    lr_rate: float = 1e-3
+    batch_size: int = 12
+    shrink_lambda: float = 5.0
+    fedprox_mu: float = 0.001
+
+    # Early stopping (src/main.py:55-57; local patience = global_patience)
+    patience: int = 1
+    global_patience: int = 1
+
+    # Model / aggregation sweep axes (src/main.py:60-62)
+    model_types: Tuple[str, ...] = ("hybrid", "autoencoder")
+    update_types: Tuple[str, ...] = ("avg", "fedprox", "mse_avg")
+    dim_features: int = 115
+    hidden_neus: int = 27
+    latent_dim: int = 7
+
+    # Verification (src/main.py:49, 247-252)
+    verification_method: str = "val"  # "dev" | "val"
+    verification_threshold: float = 3.0
+    performance_threshold: float = 0.002
+    max_aggregation_threshold: int = 3  # client_trainer.py:78
+    max_rejected_updates: int = 3  # client_trainer.py:94
+
+    # Runs / seeds (src/main.py:43, 51, 73-78, 115-117)
+    num_runs: int = 1
+    data_seed: int = 1234
+    run_seed_stride: int = 10000
+
+    # Data handling (src/main.py:54, 151-159)
+    new_device: bool = True
+    scaler: str = "standard"
+    # normal-traffic split fractions train/valid/dev (test gets the remainder)
+    split_fractions: Tuple[float, float, float] = (0.4, 0.1, 0.4)
+
+    # Metric & experiment naming (src/main.py:46, 58-59, 64)
+    metric: str = "AUC"  # "AUC" | "classification"
+    scen_name: str = "FL-IoT"
+    experiment_name: str = "fedmse-tpu"
+    checkpoint_dir: str = "Checkpoint"
+
+    # TPU-specific knobs (no reference equivalent)
+    mesh_shape: Optional[Tuple[int, ...]] = None  # None => all local devices
+    client_axis_name: str = "clients"
+    param_dtype: str = "float32"
+
+    compat: CompatConfig = dataclasses.field(default_factory=CompatConfig)
+
+    def replace(self, **kw: Any) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kw)
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(raw: Dict[str, Any]) -> "ExperimentConfig":
+        raw = dict(raw)
+        if "compat" in raw and isinstance(raw["compat"], dict):
+            raw["compat"] = CompatConfig(**raw["compat"])
+        for key in ("model_types", "update_types", "split_fractions", "mesh_shape"):
+            if key in raw and isinstance(raw[key], list):
+                raw[key] = tuple(raw[key])
+        return ExperimentConfig(**raw)
+
+
+def paper_scale(cfg: ExperimentConfig) -> ExperimentConfig:
+    """The paper-scale schedule (reference README.md:30-34)."""
+    return cfg.replace(epochs=100, num_rounds=20, lr_rate=1e-5, shrink_lambda=10.0)
+
+
+def add_cli_overrides(parser) -> None:
+    """Register every scalar ExperimentConfig field as a --flag override."""
+    for f in dataclasses.fields(ExperimentConfig):
+        if f.name == "compat":
+            continue
+        ftype = f.type if isinstance(f.type, type) else None
+        name = "--" + f.name.replace("_", "-")
+        if ftype is bool or isinstance(f.default, bool):
+            parser.add_argument(name, type=lambda s: s.lower() in ("1", "true", "yes"),
+                                default=None)
+        elif isinstance(f.default, (int, float, str)):
+            parser.add_argument(name, type=type(f.default), default=None)
+        elif isinstance(f.default, tuple) and f.default and isinstance(f.default[0], str):
+            parser.add_argument(name, type=lambda s: tuple(s.split(",")), default=None)
+
+
+def apply_cli_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
+    updates = {}
+    for f in dataclasses.fields(ExperimentConfig):
+        if f.name == "compat":
+            continue
+        val = getattr(args, f.name, None)
+        if val is not None:
+            updates[f.name] = val
+    return cfg.replace(**updates) if updates else cfg

@@ -1,0 +1,20 @@
+from fedmse_tpu.data.loader import (
+    ClientData,
+    IoTDataProcessor,
+    build_dev_dataset,
+    load_data,
+    prepare_clients,
+)
+from fedmse_tpu.data.stacking import FederatedData, stack_clients
+from fedmse_tpu.data.synthetic import synthetic_clients
+
+__all__ = [
+    "ClientData",
+    "IoTDataProcessor",
+    "FederatedData",
+    "build_dev_dataset",
+    "load_data",
+    "prepare_clients",
+    "stack_clients",
+    "synthetic_clients",
+]

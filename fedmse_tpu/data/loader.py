@@ -1,0 +1,187 @@
+"""Host-side data pipeline: CSV shards -> per-client split/scaled arrays.
+
+Capability parity with the reference's data layer:
+  * `load_data`           — reference src/DataLoader/dataloader.py:22-30
+                            (concat every *.csv in a directory, headerless).
+  * `IoTDataProcessor`    — dataloader.py:32-58 (Standard/MinMax scaler wrapper,
+                            labels normal=0 / abnormal=1, get_metadata).
+  * `prepare_clients`     — the per-device pipeline of src/main.py:131-207:
+                            shuffle, 40/10/40/10 normal split, scaler fit on
+                            train only, abnormal all-test, optional `new_device`
+                            held-out normal appended to test.
+  * `build_dev_dataset`   — src/main.py:213-223: equal-size samples of each
+                            client's dev split, concatenated, re-standardized
+                            with a fresh scaler.
+
+Everything here is numpy on host — 115-feature tabular data is tiny; the whole
+federation is then stacked and moved to device once (see stacking.py), so the
+TPU round loop never touches the host again.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+from fedmse_tpu.config import DatasetConfig, ExperimentConfig
+from fedmse_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def load_data(path: str, header: Optional[int] = None) -> pd.DataFrame:
+    """Concatenate every CSV file in `path` (reference dataloader.py:22-30)."""
+    frames = []
+    for file in sorted(os.listdir(path)):
+        if ".csv" in file:
+            frames.append(pd.read_csv(os.path.join(path, file), header=header))
+    return pd.concat(frames, ignore_index=True)
+
+
+class IoTDataProcessor:
+    """Scaler wrapper with label attachment (reference dataloader.py:32-58).
+
+    Pure-numpy StandardScaler/MinMaxScaler equivalents (sklearn semantics:
+    biased std, ddof=0; minmax to (0, 1))."""
+
+    def __init__(self, scaler: str = "standard"):
+        self.kind = scaler
+        self.mean_: Optional[np.ndarray] = None
+        self.scale_: Optional[np.ndarray] = None
+        self.min_: Optional[np.ndarray] = None
+
+    def fit(self, data: np.ndarray) -> "IoTDataProcessor":
+        data = np.asarray(data, dtype=np.float64)
+        if self.kind == "standard":
+            self.mean_ = data.mean(axis=0)
+            scale = data.std(axis=0)  # ddof=0, like sklearn StandardScaler
+            # sklearn maps zero variance to scale 1.0
+            self.scale_ = np.where(scale == 0.0, 1.0, scale)
+        elif self.kind == "minmax":
+            dmin, dmax = data.min(axis=0), data.max(axis=0)
+            rng = np.where(dmax - dmin == 0.0, 1.0, dmax - dmin)
+            self.scale_ = 1.0 / rng
+            self.min_ = dmin
+        else:
+            raise ValueError(f"unknown scaler {self.kind!r}")
+        return self
+
+    def _apply(self, data: np.ndarray) -> np.ndarray:
+        data = np.asarray(data, dtype=np.float64)
+        if self.kind == "standard":
+            return (data - self.mean_) / self.scale_
+        return (data - self.min_) * self.scale_
+
+    def transform(self, dataframe, type: str = "normal") -> Tuple[np.ndarray, np.ndarray]:
+        processed = self._apply(np.asarray(dataframe))
+        label = np.zeros(len(processed)) if type == "normal" else np.ones(len(processed))
+        return processed, label
+
+    def fit_transform(self, dataframe) -> Tuple[np.ndarray, np.ndarray]:
+        self.fit(np.asarray(dataframe))
+        return self.transform(dataframe, type="normal")
+
+    def get_metadata(self):
+        return {"mean": self.mean_, "std": self.scale_}
+
+
+@dataclasses.dataclass
+class ClientData:
+    """One client's prepared (unpadded) arrays. float32, standardized."""
+
+    name: str
+    train_x: np.ndarray  # [n_train, D] normal, scaled
+    valid_x: np.ndarray  # [n_valid, D]
+    test_x: np.ndarray   # [n_test, D] normal-test (+ new-device normal) + abnormal
+    test_y: np.ndarray   # [n_test] 0=normal 1=abnormal
+    dev_raw: pd.DataFrame  # unscaled dev split rows (for the shared dev dataset)
+    scaler: IoTDataProcessor
+
+
+def _split_sizes(n: int, fractions: Sequence[float]) -> Tuple[int, int, int, int]:
+    """40/10/40/10 sizes, remainder to test (reference src/main.py:151-155)."""
+    train = int(fractions[0] * n)
+    valid = int(fractions[1] * n)
+    dev = int(fractions[2] * n)
+    return train, valid, dev, n - train - valid - dev
+
+
+def prepare_clients(
+    dataset: DatasetConfig,
+    cfg: ExperimentConfig,
+    data_rng: np.random.Generator,
+    network_size: Optional[int] = None,
+) -> List[ClientData]:
+    """Reference per-device pipeline (src/main.py:126-207).
+
+    `data_rng` drives device sampling and row shuffles (run-independent,
+    reference seeds np/random with data_seed at src/main.py:115-117)."""
+    n_net = network_size or cfg.network_size
+    devices = list(dataset.devices_list)
+    if len(devices) > n_net:
+        idx = data_rng.choice(len(devices), size=n_net, replace=False)
+        devices = [devices[i] for i in idx]  # random.sample analog (main.py:126)
+
+    clients: List[ClientData] = []
+    for device in devices:
+        normal = load_data(os.path.join(dataset.data_path, device.normal_data_path))
+        normal = normal.iloc[data_rng.permutation(len(normal))].reset_index(drop=True)
+        abnormal = load_data(os.path.join(dataset.data_path, device.abnormal_data_path))
+        abnormal = abnormal.iloc[data_rng.permutation(len(abnormal))].reset_index(drop=True)
+
+        n_train, n_valid, n_dev, _ = _split_sizes(len(normal), cfg.split_fractions)
+        train_df = normal.iloc[:n_train]
+        valid_df = normal.iloc[n_train:n_train + n_valid]
+        dev_df = normal.iloc[n_train + n_valid:n_train + n_valid + n_dev]
+        test_df = normal.iloc[n_train + n_valid + n_dev:]
+
+        proc = IoTDataProcessor(scaler=cfg.scaler)
+        train_x, _ = proc.fit_transform(train_df)  # scaler fit on train only
+        valid_x, _ = proc.transform(valid_df)
+        test_x, test_y = proc.transform(test_df)
+        abnormal_x, abnormal_y = proc.transform(abnormal, type="abnormal")
+
+        if cfg.new_device:
+            new_normal = load_data(
+                os.path.join(dataset.data_path, device.test_normal_data_path))
+            new_x, new_y = proc.transform(new_normal)
+            test_x = np.concatenate([test_x, new_x], axis=0)
+            test_y = np.concatenate([test_y, new_y], axis=0)
+
+        test_x = np.concatenate([test_x, abnormal_x], axis=0)
+        test_y = np.concatenate([test_y, abnormal_y], axis=0)
+
+        clients.append(ClientData(
+            name=device.name,
+            train_x=train_x.astype(np.float32),
+            valid_x=valid_x.astype(np.float32),
+            test_x=test_x.astype(np.float32),
+            test_y=test_y.astype(np.float32),
+            dev_raw=dev_df,
+            scaler=proc,
+        ))
+        logger.info("%s: %d train / %d valid / %d test rows",
+                    device.name, len(train_x), len(valid_x), len(test_x))
+    return clients
+
+
+def build_dev_dataset(
+    clients: Sequence[ClientData],
+    data_rng: np.random.Generator,
+    scaler: str = "standard",
+) -> np.ndarray:
+    """Shared dev dataset (reference src/main.py:213-223): sample min_len rows
+    from each client's dev split, concat, fit a FRESH scaler on the result."""
+    min_len = min(len(c.dev_raw) for c in clients)
+    parts = []
+    for c in clients:
+        idx = data_rng.choice(len(c.dev_raw), size=min_len, replace=False)
+        parts.append(c.dev_raw.iloc[idx])
+    dev = pd.concat(parts, axis=0)
+    proc = IoTDataProcessor(scaler=scaler)
+    dev_x, _ = proc.fit_transform(dev)
+    return dev_x.astype(np.float32)

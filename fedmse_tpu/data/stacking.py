@@ -1,0 +1,129 @@
+"""Stack per-client arrays into padded, static-shape device tensors.
+
+This is the core TPU-first data design (SURVEY.md §7): the reference iterates
+python DataLoaders per client sequentially (src/main.py:276-279); we stack all
+N clients on a leading `clients` axis with row masks so the whole federation
+trains as ONE vmapped/sharded jitted computation with static shapes. Padding
+rows carry mask 0 and contribute nothing to losses, gradients, or metrics;
+padding *clients* (to round the axis up to the device count) carry
+client_mask 0 and are excluded from selection, aggregation, and evaluation.
+
+Batch-major layout: train/valid data is reshaped to [N, num_batches, B, D] so
+the per-epoch minibatch loop is a `lax.scan` over the batch axis — the exact
+sequential-batch semantics of the reference's unshuffled DataLoader
+(src/main.py:180-195 creates DataLoaders without shuffle=True).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedmse_tpu.data.loader import ClientData
+
+
+def _pad_rows(x: np.ndarray, target: int) -> np.ndarray:
+    pad = target - x.shape[0]
+    if pad == 0:
+        return x
+    return np.concatenate([x, np.zeros((pad,) + x.shape[1:], dtype=x.dtype)], axis=0)
+
+
+def _to_batches(x: np.ndarray, n_rows: int, batch_size: int, num_batches: int
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad rows to num_batches*batch_size and reshape to [NB, B, ...] + mask."""
+    total = num_batches * batch_size
+    xb = _pad_rows(x, total).reshape(num_batches, batch_size, *x.shape[1:])
+    mask = (np.arange(total) < n_rows).astype(np.float32)
+    return xb, mask.reshape(num_batches, batch_size)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class FederatedData:
+    """All federation data as stacked device arrays (a pytree).
+
+    N = padded client count; B = batch size. Row masks are float32 {0,1}.
+    """
+
+    # Training minibatches: [N, NB, B, D] / [N, NB, B]
+    train_xb: jax.Array
+    train_mb: jax.Array
+    # Validation minibatches (per-client valid split): [N, NVB, B, D] / [N, NVB, B]
+    valid_xb: jax.Array
+    valid_mb: jax.Array
+    # Flat per-client valid tensors for voting/verification: [N, V, D] / [N, V]
+    valid_x: jax.Array
+    valid_m: jax.Array
+    # Test sets: [N, T, D] / [N, T] / labels [N, T]
+    test_x: jax.Array
+    test_m: jax.Array
+    test_y: jax.Array
+    # Shared dev dataset (replicated): [M, D]
+    dev_x: jax.Array
+    # Which clients are real (vs device-count padding): [N]
+    client_mask: jax.Array
+
+    @property
+    def num_clients_padded(self) -> int:
+        return self.train_xb.shape[0]
+
+    @property
+    def dim_features(self) -> int:
+        return self.train_xb.shape[-1]
+
+
+def stack_clients(
+    clients: Sequence[ClientData],
+    dev_x: np.ndarray,
+    batch_size: int,
+    pad_clients_to: Optional[int] = None,
+) -> FederatedData:
+    """Build the stacked FederatedData pytree from per-client arrays."""
+    n_real = len(clients)
+    n_pad = pad_clients_to or n_real
+    assert n_pad >= n_real
+
+    def ceil_div(a: int, b: int) -> int:
+        return -(-a // b)
+
+    nb = max(ceil_div(len(c.train_x), batch_size) for c in clients)
+    nvb = max(ceil_div(len(c.valid_x), batch_size) for c in clients)
+    v_max = max(len(c.valid_x) for c in clients)
+    t_max = max(len(c.test_x) for c in clients)
+    d = clients[0].train_x.shape[1]
+
+    def zeros_client() -> ClientData:
+        z = lambda *s: np.zeros(s, dtype=np.float32)
+        return ClientData(name="<pad>", train_x=z(1, d), valid_x=z(1, d),
+                          test_x=z(1, d), test_y=z(1), dev_raw=None, scaler=None)
+
+    padded: List[ClientData] = list(clients) + [zeros_client() for _ in range(n_pad - n_real)]
+
+    train_xb, train_mb, valid_xb, valid_mb = [], [], [], []
+    valid_x, valid_m, test_x, test_m, test_y = [], [], [], [], []
+    for i, c in enumerate(padded):
+        is_real = i < n_real
+        xb, mb = _to_batches(c.train_x, len(c.train_x) if is_real else 0, batch_size, nb)
+        train_xb.append(xb); train_mb.append(mb)
+        xb, mb = _to_batches(c.valid_x, len(c.valid_x) if is_real else 0, batch_size, nvb)
+        valid_xb.append(xb); valid_mb.append(mb)
+        valid_x.append(_pad_rows(c.valid_x, v_max))
+        valid_m.append((np.arange(v_max) < (len(c.valid_x) if is_real else 0)).astype(np.float32))
+        test_x.append(_pad_rows(c.test_x, t_max))
+        test_m.append((np.arange(t_max) < (len(c.test_x) if is_real else 0)).astype(np.float32))
+        test_y.append(_pad_rows(c.test_y, t_max))
+
+    client_mask = (np.arange(n_pad) < n_real).astype(np.float32)
+    stack = lambda xs: jnp.asarray(np.stack(xs, axis=0))
+    return FederatedData(
+        train_xb=stack(train_xb), train_mb=stack(train_mb),
+        valid_xb=stack(valid_xb), valid_mb=stack(valid_mb),
+        valid_x=stack(valid_x), valid_m=stack(valid_m),
+        test_x=stack(test_x), test_m=stack(test_m), test_y=stack(test_y),
+        dev_x=jnp.asarray(dev_x), client_mask=jnp.asarray(client_mask),
+    )

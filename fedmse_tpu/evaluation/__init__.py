@@ -1,0 +1,3 @@
+from fedmse_tpu.evaluation.evaluator import Evaluator, make_evaluate_all
+
+__all__ = ["Evaluator", "make_evaluate_all"]

@@ -1,0 +1,114 @@
+"""Per-client anomaly-detection evaluation, vectorized over the client axis.
+
+Reference `Evaluator` (src/Evaluator/evaluator.py:14-130):
+  * model_type 'autoencoder' (:52-74): anomaly score = per-sample mean
+    reconstruction MSE over the test set; metric = AUC or F1/precision/recall
+    at a 0.5 score threshold.
+  * model_type 'hybrid' (:76-127): encode the TRAIN set -> fit the centroid
+    classifier on train latents -> anomaly score = centroid density (distance
+    to origin of standardized latents) of test latents; metrics as above, plus
+    a 'time' mode measuring inference wall-clock (:99-108).
+
+The reference loops DataLoaders per client; here one jitted vmap evaluates
+every client's model on its own test set simultaneously (AUC included — see
+ops/metrics.roc_auc), so per-round evaluation of the whole federation is a
+single device computation.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.models.centroid import fit_centroid
+from fedmse_tpu.ops.losses import per_sample_mse
+from fedmse_tpu.ops.metrics import classification_metrics, roc_auc
+
+
+def _flatten_batches(xb: jax.Array, mb: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[NB, B, D] -> [NB*B, D] (the reference concatenates batch outputs)."""
+    return xb.reshape(-1, xb.shape[-1]), mb.reshape(-1)
+
+
+def make_evaluate_all(model, model_type: str, metric: str = "AUC") -> Callable:
+    """Build fn(stacked_params, test_x, test_m, test_y, train_xb, train_mb)
+    -> metrics [N] (AUC or F1, reference returns f1 for 'classification')."""
+
+    def anomaly_scores_one(params, test_x, train_xf, train_mf):
+        test_latent, recon = model.apply({"params": params}, test_x)
+        if model_type == "autoencoder":
+            return per_sample_mse(test_x, recon)
+        # hybrid: centroid density over latents (evaluator.py:76-112)
+        train_latent, _ = model.apply({"params": params}, train_xf)
+        cen = fit_centroid(train_latent, train_mf)
+        return cen.get_density(test_latent)
+
+    def eval_one(params, test_x, test_m, test_y, train_xf, train_mf):
+        scores = anomaly_scores_one(params, test_x, train_xf, train_mf)
+        scores = jnp.nan_to_num(scores)  # evaluator.py:24-25 nan_to_num guard
+        if metric == "AUC":
+            return roc_auc(test_y, scores, test_m)
+        f1, _, _ = classification_metrics(test_y, scores, test_m)
+        return f1
+
+    @jax.jit
+    def evaluate_all(stacked_params, test_x, test_m, test_y, train_xb, train_mb):
+        train_xf = train_xb.reshape(train_xb.shape[0], -1, train_xb.shape[-1])
+        train_mf = train_mb.reshape(train_mb.shape[0], -1)
+        return jax.vmap(eval_one)(stacked_params, test_x, test_m, test_y,
+                                  train_xf, train_mf)
+
+    return evaluate_all
+
+
+class Evaluator:
+    """Single-model evaluator with reference-API parity
+    (`Evaluator(model_type=..., metric=...).evaluate(...)`, evaluator.py:14).
+
+    Operates on one client's (unpadded) arrays; returns the same shapes the
+    reference returns: a scalar for 'autoencoder', and
+    (metric, test_latent, labels) for 'hybrid' (evaluator.py:119)."""
+
+    def __init__(self, model, params, model_type: str = "autoencoder",
+                 metric: str = "AUC"):
+        self.model = model
+        self.params = params
+        self.model_type = model_type
+        self.metric = metric
+
+    def evaluate(self, test_x, test_y, train_x=None):
+        test_x = jnp.asarray(test_x)
+        test_y = jnp.asarray(test_y)
+        test_latent, recon = self.model.apply({"params": self.params}, test_x)
+
+        if self.model_type == "autoencoder":
+            scores = jnp.nan_to_num(per_sample_mse(test_x, recon))
+            if self.metric == "AUC":
+                return float(roc_auc(test_y, scores))
+            f1, _, _ = classification_metrics(test_y, scores)
+            return float(f1)
+
+        # hybrid
+        assert train_x is not None, "hybrid evaluation needs train data"
+        train_latent, _ = self.model.apply({"params": self.params},
+                                           jnp.asarray(train_x))
+        cen = fit_centroid(train_latent)
+
+        if self.metric == "time":
+            # inference latency mode (evaluator.py:99-108)
+            start = time.time()
+            _ = jax.block_until_ready(
+                cen.get_density(self.model.apply({"params": self.params},
+                                                 test_x)[0]))
+            return time.time() - start
+
+        scores = jnp.nan_to_num(cen.get_density(test_latent))
+        if self.metric == "AUC":
+            return (float(roc_auc(test_y, scores)),
+                    jax.device_get(test_latent), jax.device_get(test_y))
+        f1, _, _ = classification_metrics(test_y, scores)
+        return (float(f1), jax.device_get(test_latent), jax.device_get(test_y))

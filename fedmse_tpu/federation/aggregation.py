@@ -1,0 +1,60 @@
+"""The three aggregation algorithms as masked weighted tree-reductions.
+
+Reference (src/Trainer/client_trainer.py):
+  * fed_avg (:107-113)      — sample-count-weighted average; the caller passes
+    weight 1 per selected client (aggregate_models :305-315), so it reduces to
+    the plain mean over the selected cohort.
+  * fed_mse_avg (:115-130)  — weight_i = 1 / MSE(dev_set, recon_i(dev_set)),
+    normalized to sum 1. (The per-client weights precomputed in
+    aggregate_models:309-315 are DISCARDED by the reference — quirk 2 — so we
+    never compute them.)
+  * fedprox (:132-134)      — identical to fed_avg; the proximal term lives in
+    the local training loss.
+
+TPU-first: a masked weighted sum over the stacked client axis. When the client
+axis is sharded over a device mesh, XLA lowers `jnp.einsum('n,n...->...')`
+to a weighted all-reduce over ICI — the collective form of the reference's
+shared-memory state_dict averaging (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.ops.losses import mse_loss
+
+
+def weighted_tree_mean(params: Any, weights: jax.Array) -> Any:
+    """Σ_n w_n · params_n over the leading client axis (weights already
+    normalized). The core collective of the framework."""
+    def reduce_leaf(t: jax.Array) -> jax.Array:
+        return jnp.einsum("n,n...->...", weights.astype(t.dtype), t)
+    return jax.tree.map(reduce_leaf, params)
+
+
+def make_aggregate_fn(model, update_type: str) -> Callable:
+    """Build fn(stacked_params, sel_mask, dev_x) -> (agg_params, weights[N])."""
+
+    def dev_mse(params, dev_x):
+        """MSE of one client's model on the shared dev set
+        (fed_mse_avg's scoring forward, client_trainer.py:119-123 — done here
+        as a vmap instead of the reference's sequential load-score-clobber,
+        SURVEY.md §7 hard part #2)."""
+        _, recon = model.apply({"params": params}, dev_x)
+        return mse_loss(dev_x, recon)
+
+    @jax.jit
+    def aggregate(stacked_params, sel_mask, dev_x) -> Tuple[Any, jax.Array]:
+        if update_type == "mse_avg":
+            mses = jax.vmap(dev_mse, in_axes=(0, None))(stacked_params, dev_x)
+            raw = sel_mask / mses  # 1/mse per selected client (:124)
+        else:  # 'avg' and 'fedprox' (:132-134)
+            raw = sel_mask
+        weights = raw / jnp.sum(raw)
+        return weighted_tree_mean(stacked_params, weights), weights
+
+    return aggregate

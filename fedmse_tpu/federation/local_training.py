@@ -1,0 +1,151 @@
+"""Vectorized local training: every client's epoch loop as one jitted scan.
+
+Reference semantics being reproduced (ClientTrainer.run,
+src/Trainer/client_trainer.py:360-419):
+  * sequential (unshuffled) minibatches of size B per epoch — the reference's
+    DataLoaders have no shuffle flag (src/main.py:180-195);
+  * per-batch Adam step on the model loss, + μ·Σ‖p − p_global‖² proximal term
+    when update_type == 'fedprox' (:374-378);
+  * epoch train loss = mean of batch losses (:383-385);
+  * validation after each epoch with the same batching, prox term included in
+    the reported valid loss too (:387-404);
+  * early stopping: patience epochs without valid-loss improvement stops
+    training (:408-417); the BEST params are checkpointed but the FINAL
+    in-memory params are what enter aggregation (SURVEY.md §2 quirk 11) —
+    we return both;
+  * Adam state persists across rounds (optimizer constructed once,
+    client_trainer.py:66).
+
+TPU-first design: the reference trains selected clients sequentially
+(src/main.py:276-279). Here `make_local_train_all` vmaps one client's
+epoch/batch `lax.scan` over the stacked client axis, so all clients train
+simultaneously; per-client early stopping becomes a masked `done` flag
+(no Python breaks — SURVEY.md §7 hard part #4), and clients with fewer
+batches skip trailing padded batches via row masks. Selection is applied by
+the caller (round engine) with a per-client select mask — unselected clients'
+state passes through unchanged, keeping shapes static (§7: 'selection masking
+instead of Python subsetting').
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedmse_tpu.federation.state import tree_select, tree_select_clients
+from fedmse_tpu.ops.losses import prox_term
+
+
+class LocalTrainResult(NamedTuple):
+    params: Any       # final in-memory params (enter aggregation; quirk 11)
+    opt_state: Any
+    best_params: Any  # best-valid-loss params (the reference's disk checkpoint)
+    min_valid: jax.Array   # best valid loss reached
+    tracking: jax.Array    # [E, 3]: (train_loss, valid_loss, active_flag)
+
+
+def make_local_train_one(model, tx: optax.GradientTransformation,
+                         epochs: int, patience: int, fedprox: bool,
+                         mu: float) -> Callable:
+    """Build the single-client local-training function (to be vmapped)."""
+
+    def batch_loss(params, prev_global, x, m):
+        latent, recon = model.apply({"params": params}, x)
+        loss = model.loss(x, latent, recon, m)
+        if fedprox:
+            loss = loss + mu * prox_term(params, prev_global)
+        return loss
+
+    grad_fn = jax.value_and_grad(batch_loss)
+
+    def train_one(params, opt_state, prev_global,
+                  train_xb, train_mb, valid_xb, valid_mb) -> LocalTrainResult:
+        # number of REAL batches for this client (loss normalizers — the
+        # reference divides by len(loader), client_trainer.py:385,402)
+        nb = jnp.maximum(jnp.sum(jnp.any(train_mb > 0, axis=1)), 1)
+        nvb = jnp.maximum(jnp.sum(jnp.any(valid_mb > 0, axis=1)), 1)
+
+        def batch_step(carry, xm):
+            p, o = carry
+            x, m = xm
+            has = jnp.any(m > 0)
+            loss, grads = grad_fn(p, prev_global, x, m)
+            updates, o2 = tx.update(grads, o, p)
+            p2 = optax.apply_updates(p, updates)
+            # padded batches are skipped entirely (no Adam time-step either)
+            p = tree_select(has, p2, p)
+            o = tree_select(has, o2, o)
+            return (p, o), jnp.where(has, loss, 0.0)
+
+        def valid_loss_of(params):
+            def vstep(_, xm):
+                x, m = xm
+                has = jnp.any(m > 0)
+                return None, jnp.where(has, batch_loss(params, prev_global, x, m), 0.0)
+            _, losses = jax.lax.scan(vstep, None, (valid_xb, valid_mb))
+            return jnp.sum(losses) / nvb
+
+        def epoch_body(carry, _):
+            p, o, min_v, worse, done, best_p = carry
+            (p_new, o_new), losses = jax.lax.scan(batch_step, (p, o),
+                                                  (train_xb, train_mb))
+            # a finished (early-stopped) client's epoch is a no-op
+            p = tree_select(done, p, p_new)
+            o = tree_select(done, o, o_new)
+            train_loss = jnp.sum(losses) / nb
+            v_loss = valid_loss_of(p)
+
+            active = ~done
+            improved = v_loss < min_v
+            min_v = jnp.where(active & improved, v_loss, min_v)
+            best_p = tree_select(active & improved, p, best_p)
+            worse = jnp.where(active, jnp.where(improved, 0, worse + 1), worse)
+            done = done | (active & (worse >= patience))
+            track = jnp.stack([train_loss, v_loss, active.astype(jnp.float32)])
+            return (p, o, min_v, worse, done, best_p), track
+
+        init = (params, opt_state, jnp.asarray(jnp.inf, jnp.float32),
+                jnp.asarray(0, jnp.int32), jnp.asarray(False), params)
+        (p, o, min_v, _, _, best_p), tracking = jax.lax.scan(
+            epoch_body, init, None, length=epochs)
+        return LocalTrainResult(p, o, best_p, min_v, tracking)
+
+    return train_one
+
+
+def make_local_train_all(model, tx: optax.GradientTransformation,
+                         epochs: int, patience: int, fedprox: bool, mu: float,
+                         donate: bool = True, restore_best: bool = False) -> Callable:
+    """Jitted, vmapped training of all clients with a selection mask.
+
+    Returns fn(states_params, states_opt, prev_global, sel_mask, data) ->
+    (params, opt_state, best_params, min_valid [N], tracking [N, E, 3]).
+    Unselected clients keep params/opt unchanged (reference trains only the
+    selected cohort, src/main.py:276-279).
+    """
+    train_one = make_local_train_one(model, tx, epochs, patience, fedprox, mu)
+    train_vmapped = jax.vmap(train_one)
+
+    @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+    def train_all(params, opt_state, prev_global, sel_mask,
+                  train_xb, train_mb, valid_xb, valid_mb):
+        res = train_vmapped(params, opt_state, prev_global,
+                            train_xb, train_mb, valid_xb, valid_mb)
+        sel = sel_mask > 0
+        # fixed-mode (compat.no_best_restore=False): the best-valid-loss
+        # checkpoint re-enters aggregation instead of the final weights
+        final = res.best_params if restore_best else res.params
+        out_params = tree_select_clients(sel, final, params)
+        out_opt = tree_select_clients(sel, res.opt_state, opt_state)
+        # unselected clients never trained this round: blank their curves so
+        # consumers don't read phantom training (their weights were untouched)
+        nanmask = jnp.where(sel, 1.0, jnp.nan)
+        min_valid = res.min_valid * nanmask
+        tracking = res.tracking * nanmask[:, None, None]
+        return out_params, out_opt, res.best_params, min_valid, tracking
+
+    return train_all

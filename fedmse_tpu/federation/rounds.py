@@ -1,0 +1,198 @@
+"""The federated round engine: select -> train -> vote -> aggregate ->
+broadcast -> verify -> evaluate.
+
+This is the TPU-native re-architecture of the reference's round loop
+(src/main.py:267-365). Per round:
+  1. sample ⌈ratio·N⌉ clients (src/main.py:270-273) — host RNG, becomes a
+     static-shape selection MASK on device;
+  2. local training of the selected cohort (main.py:276-279) — ONE jitted
+     vmapped scan trains all clients simultaneously; unselected clients
+     pass through via the mask;
+  3. first-voter-wins aggregator election with quota (main.py:282-288) —
+     host control flow over device-computed MSE scores;
+  4. the elected aggregator aggregates the selected cohort's live models
+     (main.py:293) — a masked weighted tree-reduction (ICI collective when
+     the client axis is sharded);
+  5. broadcast to ALL clients + per-client verification (main.py:296-312) —
+     one jitted vectorized verify step;
+  6. per-client evaluation (main.py:333-339) — one jitted vectorized
+     evaluator call.
+
+Host<->device traffic per round: the selection mask + one [N] score vector per
+voter + scalar metrics out. Everything heavy stays on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedmse_tpu.config import ExperimentConfig
+from fedmse_tpu.data.stacking import FederatedData
+from fedmse_tpu.evaluation.evaluator import make_evaluate_all
+from fedmse_tpu.federation.aggregation import make_aggregate_fn
+from fedmse_tpu.federation.local_training import make_local_train_all
+from fedmse_tpu.federation.state import ClientStates, HostState, init_client_states
+from fedmse_tpu.federation.verification import make_verify_fn
+from fedmse_tpu.federation.voting import elect_aggregator, make_mse_scores_fn
+from fedmse_tpu.utils.logging import get_logger
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class RoundResult:
+    round_index: int
+    selected: List[int]
+    aggregator: Optional[int]
+    client_metrics: np.ndarray          # [n_real]
+    verification_results: List[Dict]    # reference verification_results.json rows
+    mse_scores: Optional[np.ndarray]    # winning voter's scores (or None)
+    agg_weights: Optional[np.ndarray]   # aggregation weights [N_padded]
+    tracking: np.ndarray                # [n_real, E, 3] train/valid loss curves
+    min_valid: np.ndarray               # [n_real] best local valid loss
+
+
+class RoundEngine:
+    """One (model_type, update_type) federation over stacked client state."""
+
+    def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
+                 n_real: int, rngs: ExperimentRngs, model_type: str,
+                 update_type: str):
+        self.model = model
+        self.cfg = cfg
+        self.data = data
+        self.n_real = n_real
+        self.n_pad = data.num_clients_padded
+        self.rngs = rngs
+        self.model_type = model_type
+        self.update_type = update_type
+
+        self.tx = optax.adam(cfg.lr_rate)
+        self.train_all = make_local_train_all(
+            model, self.tx, epochs=cfg.epochs, patience=cfg.patience,
+            fedprox=(update_type == "fedprox"), mu=cfg.fedprox_mu,
+            restore_best=not cfg.compat.no_best_restore)
+        self.scores_fn = make_mse_scores_fn(
+            model, restandardize=cfg.compat.restandardize_vote_data,
+            tie_break=cfg.compat.vote_tie_break)
+        self.aggregate = make_aggregate_fn(model, update_type)
+        self.verify = make_verify_fn(model, cfg.verification_threshold,
+                                     cfg.performance_threshold)
+        self.evaluate_all = make_evaluate_all(model, model_type, cfg.metric)
+
+        self.states: ClientStates = init_client_states(
+            model, self.tx, rngs.next_jax(), self.n_pad)
+        self.host = HostState.create(n_real)
+        self._ver_x, self._ver_m = self._verification_tensors()
+
+    # ------------------------------------------------------------------ #
+
+    def _verification_tensors(self):
+        """Per-client verification data [N, V, D] / [N, V] (see
+        verification.py module docstring for the quirk-6 semantics)."""
+        d = self.data
+        if self.cfg.verification_method == "dev":
+            ver_x = jnp.broadcast_to(d.dev_x, (self.n_pad,) + d.dev_x.shape)
+            ver_m = jnp.ones((self.n_pad, d.dev_x.shape[0]), jnp.float32)
+        elif self.cfg.compat.shared_last_client_val:
+            # quirk 6: every client verifies on the LAST real client's valid
+            # split (src/main.py:264)
+            last = self.n_real - 1
+            ver_x = jnp.broadcast_to(d.valid_x[last], (self.n_pad,) + d.valid_x[last].shape)
+            ver_m = jnp.broadcast_to(d.valid_m[last], (self.n_pad,) + d.valid_m[last].shape)
+        else:
+            ver_x, ver_m = d.valid_x, d.valid_m
+        return ver_x, ver_m
+
+    def select_clients(self) -> List[int]:
+        """⌈ratio·N⌉ clients via host RNG (src/main.py:270-273)."""
+        n_sel = max(1, int(self.cfg.num_participants * self.n_real))
+        return self.rngs.select_rng.sample(range(self.n_real), n_sel)
+
+    # ------------------------------------------------------------------ #
+
+    def run_round(self, round_index: int,
+                  selected: Optional[List[int]] = None) -> RoundResult:
+        cfg, data = self.cfg, self.data
+        if selected is None:
+            selected = self.select_clients()
+        sel_mask_np = np.zeros(self.n_pad, dtype=np.float32)
+        sel_mask_np[selected] = 1.0
+        sel_mask = jnp.asarray(sel_mask_np)
+
+        # ---- local training (all selected clients in parallel) ----
+        params, opt_state, best_params, min_valid, tracking = self.train_all(
+            self.states.params, self.states.opt_state, self.states.prev_global,
+            sel_mask, data.train_xb, data.train_mb, data.valid_xb, data.valid_mb)
+        self.states = dataclasses.replace(self.states, params=params,
+                                          opt_state=opt_state)
+        self.last_best_params = best_params  # checkpointed, never restored
+                                             # (SURVEY.md §2 quirk 11)
+
+        # ---- aggregator election (host control plane) ----
+        vote_x = data.valid_x[selected[0]]   # first selected client's valid
+        vote_m = data.valid_m[selected[0]]   # split (src/main.py:285)
+
+        def fresh_scores() -> np.ndarray:
+            return np.asarray(jax.device_get(self.scores_fn(
+                self.states.params, vote_x, vote_m, self.rngs.next_jax())))
+
+        aggregator, scores = elect_aggregator(
+            selected, fresh_scores, self.host.aggregation_count,
+            self.host.votes_received, cfg.max_aggregation_threshold)
+
+        verification_rows: List[Dict] = []
+        agg_weights = None
+        if aggregator is not None and \
+                self.host.aggregation_count[aggregator] < cfg.max_aggregation_threshold:
+            agg_params, weights = self.aggregate(self.states.params, sel_mask,
+                                                 data.dev_x)
+            agg_weights = np.asarray(jax.device_get(weights))
+            self.host.aggregation_count[aggregator] += 1
+            self.host.rounds_aggregated.append((round_index, aggregator))
+
+            agg_onehot = np.zeros(self.n_pad, dtype=np.float32)
+            agg_onehot[aggregator] = 1.0
+            outcome = self.verify(self.states, agg_params, self._ver_x,
+                                  self._ver_m, jnp.asarray(agg_onehot),
+                                  data.client_mask)
+            self.states = outcome.states
+            rejected = np.asarray(jax.device_get(self.states.rejected))
+            for i in range(self.n_real):
+                if i != aggregator:
+                    # reference rows (src/main.py:304-312): is_verified is the
+                    # quirky rejected==0 check, not this round's accept bit
+                    verification_rows.append({
+                        "client_id": i,
+                        "rejected_updates": int(rejected[i]),
+                        "is_verified": bool(rejected[i] == 0),
+                    })
+                    if rejected[i] >= cfg.max_rejected_updates:
+                        logger.error("[Client %d] Too many rejected updates. "
+                                     "Possible attack detected.", i)
+        else:
+            logger.warning("No aggregator selected for round %d", round_index)
+
+        # ---- evaluation of every client (src/main.py:333-339) ----
+        metrics = np.asarray(jax.device_get(self.evaluate_all(
+            self.states.params, data.test_x, data.test_m, data.test_y,
+            data.train_xb, data.train_mb)))[: self.n_real]
+
+        return RoundResult(
+            round_index=round_index,
+            selected=list(selected),
+            aggregator=aggregator,
+            client_metrics=metrics,
+            verification_results=verification_rows,
+            mse_scores=None if scores is None else np.asarray(scores)[: self.n_real],
+            agg_weights=agg_weights,
+            tracking=np.asarray(jax.device_get(tracking))[: self.n_real],
+            min_valid=np.asarray(jax.device_get(min_valid))[: self.n_real],
+        )

@@ -1,0 +1,100 @@
+"""Federation state: all N clients as ONE stacked pytree.
+
+The reference keeps N `ClientTrainer` objects with mutable attributes
+(src/Trainer/client_trainer.py:47-95). Here every per-client quantity is a
+leading-axis-N array inside `ClientStates`, so the whole federation moves
+through jitted round steps as a single pytree — shard the leading axis over a
+device mesh and every step scales across chips (SURVEY.md §5.8 / §7).
+
+Mapping to reference attributes:
+  params        <- trainer.model.state_dict()
+  opt_state     <- trainer.optimizer state (Adam; created once at init,
+                   persists across rounds, client_trainer.py:66)
+  prev_global   <- trainer.previous_global_model (client_trainer.py:63,
+                   updated only on verified accepts, :193)
+  hist_params / hist_perf / hist_seen
+                <- trainer.verifier.history[client_id] (model_verifier.py:41-66):
+                   the last RECEIVED aggregated state + its measured performance
+  rejected      <- trainer.rejected_updates (client_trainer.py:93)
+
+Host-side (non-jitted, tiny control plane) counters live in `HostState`:
+aggregation_count / votes_received / has_aggregated_this_round
+(client_trainer.py:77-82) — these drive the election, which is data-dependent
+control flow the reference runs per round; keeping it on host preserves exact
+first-voter-wins semantics without dynamic shapes on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ClientStates:
+    """Device-resident stacked state for all (padded) clients."""
+
+    params: Any        # pytree, leaves [N, ...]
+    opt_state: Any     # optax state, leaves [N, ...]
+    prev_global: Any   # pytree, leaves [N, ...]
+    hist_params: Any   # pytree, leaves [N, ...] — last received aggregated state
+    hist_perf: jax.Array   # [N] — 1/(1+MSE) of last received state
+    hist_seen: jax.Array   # [N] bool — verifier history exists
+    rejected: jax.Array    # [N] int32 — consecutive rejected updates
+
+
+@dataclasses.dataclass
+class HostState:
+    """Host-side control-plane counters (numpy, n_real entries)."""
+
+    aggregation_count: np.ndarray  # int, per client
+    votes_received: np.ndarray     # int, per client
+    rounds_aggregated: list        # round -> aggregator index (log)
+
+    @staticmethod
+    def create(n_real: int) -> "HostState":
+        return HostState(
+            aggregation_count=np.zeros(n_real, dtype=np.int64),
+            votes_received=np.zeros(n_real, dtype=np.int64),
+            rounds_aggregated=[],
+        )
+
+
+def init_client_states(model, tx: optax.GradientTransformation,
+                       rng: jax.Array, n_clients: int) -> ClientStates:
+    """Initialize N independent clients (analog of src/main.py:225-257)."""
+    from fedmse_tpu.models.autoencoder import init_stacked_params
+
+    params = init_stacked_params(model, rng, n_clients)
+    opt_state = jax.vmap(tx.init)(params)
+    zeros_like_params = jax.tree.map(jnp.zeros_like, params)
+    return ClientStates(
+        params=params,
+        opt_state=opt_state,
+        # previous_global_model starts as a copy of the init model
+        # (client_trainer.py:63)
+        prev_global=jax.tree.map(lambda t: t.copy(), params),
+        hist_params=zeros_like_params,
+        hist_perf=jnp.zeros((n_clients,), dtype=jnp.float32),
+        hist_seen=jnp.zeros((n_clients,), dtype=bool),
+        rejected=jnp.zeros((n_clients,), dtype=jnp.int32),
+    )
+
+
+def tree_select(cond: jax.Array, a, b):
+    """Elementwise pytree select on a scalar (or broadcastable) condition."""
+    return jax.tree.map(lambda x, y: jnp.where(cond, x, y), a, b)
+
+
+def tree_select_clients(accept: jax.Array, a, b):
+    """Per-client select: accept [N] bool; leaves [N, ...]."""
+    def sel(x, y):
+        c = accept.reshape(accept.shape + (1,) * (x.ndim - 1))
+        return jnp.where(c, x, y)
+    return jax.tree.map(sel, a, b)

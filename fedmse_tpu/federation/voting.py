@@ -1,0 +1,99 @@
+"""MSE scoring + aggregator election with anti-monopolization quota.
+
+Reference semantics (SURVEY.md §2 quirk 1):
+  * `calculate_mse_score` (client_trainer.py:208-247): re-standardize the
+    voting validation tensor with its own mean/std (ddof=1, +1e-8) even though
+    it is already standardized (quirk 8), forward in batches of 128, score =
+    mean of batch MSEs, then multiply a ±0.01% uniform tie-break factor.
+  * `vote_for_aggregator` (client_trainer.py:249-285): a voter ranks all
+    *other* clients in the cohort by MSE score ascending and votes for the
+    first whose aggregation_count < max_aggregation_threshold (=3,
+    client_trainer.py:78 — the anti-manipulation quota from draft_task.txt:9).
+  * The election is first-voter-wins: main.py:284-288 breaks on the first
+    voter that returns a candidate, and each voter call recomputes scores
+    (fresh tie-breaks).
+
+The scoring is one vmapped jitted device computation over all clients; the
+election itself is tiny host control flow over [N] numpy arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedmse_tpu.ops.losses import mse_loss
+from fedmse_tpu.ops.stats import masked_mean_std
+
+VOTE_BATCH = 128  # client_trainer.py:226
+
+
+def make_mse_scores_fn(model, restandardize: bool = True,
+                       tie_break: bool = True) -> Callable:
+    """Build fn(stacked_params, val_x [V,D], val_m [V], rng) -> scores [N].
+
+    One shared validation tensor (the first selected client's valid split,
+    src/main.py:285) scored under every client's model.
+    """
+
+    def score_one(params, val_x, val_m, rng):
+        if restandardize:
+            mean, std = masked_mean_std(val_x, val_m, ddof=1, eps=1e-8)
+            val_x = (val_x - mean) / std
+        v = val_x.shape[0]
+        nb = -(-v // VOTE_BATCH)
+        pad = nb * VOTE_BATCH - v
+        xb = jnp.pad(val_x, ((0, pad), (0, 0))).reshape(nb, VOTE_BATCH, -1)
+        mb = jnp.pad(val_m, (0, pad)).reshape(nb, VOTE_BATCH)
+
+        def bstep(_, xm):
+            x, m = xm
+            has = jnp.any(m > 0)
+            _, recon = model.apply({"params": params}, x)
+            return None, jnp.where(has, mse_loss(x, recon, m), 0.0)
+
+        _, batch_mses = jax.lax.scan(bstep, None, (xb, mb))
+        n_real_batches = jnp.maximum(jnp.sum(jnp.any(mb > 0, axis=1)), 1)
+        avg = jnp.sum(batch_mses) / n_real_batches
+        if tie_break:
+            factor = 1.0 + (jax.random.uniform(rng) - 0.5) * 0.0002
+            avg = avg * factor
+        return avg
+
+    @jax.jit
+    def scores_all(stacked_params, val_x, val_m, rng):
+        n = jax.tree.leaves(stacked_params)[0].shape[0]
+        rngs = jax.random.split(rng, n)
+        return jax.vmap(score_one, in_axes=(0, None, None, 0))(
+            stacked_params, val_x, val_m, rngs)
+
+    return scores_all
+
+
+def elect_aggregator(
+    selected_indices: Sequence[int],
+    score_fn: Callable[[], np.ndarray],
+    aggregation_count: np.ndarray,
+    votes_received: np.ndarray,
+    max_threshold: int = 3,
+) -> Tuple[Optional[int], Optional[np.ndarray]]:
+    """First-voter-wins election over the selected cohort (host control plane).
+
+    `score_fn()` returns fresh [N] MSE scores (new tie-breaks per voter call,
+    matching main.py:284-288 calling vote_for_aggregator per voter).
+    Returns (aggregator_index or None, the winning voter's scores or None).
+    """
+    for voter in selected_indices:
+        scores = score_fn()
+        candidates = [i for i in selected_indices if i != voter]
+        candidates.sort(key=lambda i: scores[i])
+        for cand in candidates:
+            if aggregation_count[cand] < max_threshold:
+                votes_received[cand] += 1
+                return cand, scores
+        # this voter found nobody under quota; next voter tries (and in the
+        # reference every later voter fails identically — kept for parity)
+    return None, None

@@ -1,0 +1,18 @@
+from fedmse_tpu.models.autoencoder import (
+    Autoencoder,
+    ShrinkAutoencoder,
+    init_client_params,
+    init_stacked_params,
+    make_model,
+)
+from fedmse_tpu.models.centroid import CentroidClassifier, fit_centroid
+
+__all__ = [
+    "Autoencoder",
+    "ShrinkAutoencoder",
+    "CentroidClassifier",
+    "fit_centroid",
+    "init_client_params",
+    "init_stacked_params",
+    "make_model",
+]

@@ -1,0 +1,127 @@
+"""Flax autoencoder models with forward parity to the reference.
+
+Reference models (src/Model/Shrink_Autoencoder.py, src/Model/AutoEncoder.py):
+  * topology: input D -> Linear(hidden=27) -> ReLU -> Linear(latent=7) encoder
+    (Shrink_Autoencoder.py:38-44) and the mirror decoder (:93-99);
+  * init: uniform ±1/sqrt(fan_in) weights, zero biases (:47-59);
+  * forward returns (latent, reconstruction, loss) (:159-163);
+  * SAE loss = MSE(input, output) + λ·mean_batch ‖latent‖₂ (:138-156);
+  * AE loss = plain MSE (AutoEncoder.py:134-149).
+
+Here the modules are pure functions of params (Flax linen); the loss lives in
+ops/losses.py so the same apply_fn serves training, MSE scoring, verification
+and evaluation. `forward_with_loss` reproduces the reference's
+(latent, output, loss) triple for API parity.
+
+TPU note: at D=115/27/7 these matmuls are far below MXU tile size (128x128);
+throughput comes from batching all N clients × batch rows into one fused
+computation (vmap over the stacked client axis), not from per-op size.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.ops.losses import mse_loss, shrink_loss
+
+# torch nn.Linear-style init: U(-1/sqrt(fan_in), 1/sqrt(fan_in)) weights
+# (reference Shrink_Autoencoder.py:47-59), zero bias.
+fan_in_uniform = nn.initializers.variance_scaling(
+    scale=1.0 / 3.0, mode="fan_in", distribution="uniform")
+
+
+class Coder(nn.Module):
+    """Two-layer MLP: Dense(hidden) -> ReLU -> Dense(out). Used for both the
+    encoder (out=latent_dim) and decoder (out=input_dim)."""
+
+    hidden: int
+    out: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Dense(self.hidden, kernel_init=fan_in_uniform,
+                     bias_init=nn.initializers.zeros)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.out, kernel_init=fan_in_uniform,
+                        bias_init=nn.initializers.zeros)(x)
+
+
+class ShrinkAutoencoder(nn.Module):
+    """Shrink AE (reference Shrink_Autoencoder.py:119-167): the latent-norm
+    penalty pulls normal traffic toward the origin of the latent space, which
+    the centroid classifier then scores by distance-to-origin."""
+
+    input_dim: int = 115
+    hidden_neus: int = 27
+    latent_dim: int = 7
+    shrink_lambda: float = 10.0
+
+    def setup(self):
+        self.encoder = Coder(self.hidden_neus, self.latent_dim)
+        self.decoder = Coder(self.hidden_neus, self.input_dim)
+
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        latent = self.encoder(x)
+        recon = self.decoder(latent)
+        return latent, recon
+
+    def loss(self, x, latent, recon, mask=None) -> jax.Array:
+        return shrink_loss(x, recon, latent, self.shrink_lambda, mask)
+
+
+class Autoencoder(nn.Module):
+    """Plain AE baseline (reference AutoEncoder.py:119-159): same topology,
+    plain-MSE loss, anomaly score = per-sample reconstruction error."""
+
+    input_dim: int = 115
+    hidden_neus: int = 27
+    latent_dim: int = 7
+
+    def setup(self):
+        self.encoder = Coder(self.hidden_neus, self.latent_dim)
+        self.decoder = Coder(self.hidden_neus, self.input_dim)
+
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        latent = self.encoder(x)
+        recon = self.decoder(latent)
+        return latent, recon
+
+    def loss(self, x, latent, recon, mask=None) -> jax.Array:
+        return mse_loss(x, recon, mask)
+
+
+def make_model(model_type: str, dim_features: int, hidden_neus: int = 27,
+               latent_dim: int = 7, shrink_lambda: float = 10.0):
+    """Model factory matching the reference's hybrid/autoencoder switch
+    (src/main.py:229-236)."""
+    if model_type == "hybrid":
+        return ShrinkAutoencoder(input_dim=dim_features, hidden_neus=hidden_neus,
+                                 latent_dim=latent_dim, shrink_lambda=shrink_lambda)
+    if model_type == "autoencoder":
+        return Autoencoder(input_dim=dim_features, hidden_neus=hidden_neus,
+                           latent_dim=latent_dim)
+    raise ValueError(f"unknown model_type {model_type!r}")
+
+
+def init_client_params(model: nn.Module, rng: jax.Array) -> Dict[str, Any]:
+    dummy = jnp.zeros((1, model.input_dim), dtype=jnp.float32)
+    return model.init(rng, dummy)["params"]
+
+
+def init_stacked_params(model: nn.Module, rng: jax.Array, n_clients: int):
+    """Independent per-client inits stacked on a leading `clients` axis —
+    the vectorized analog of constructing N torch models (src/main.py:225-236)."""
+    rngs = jax.random.split(rng, n_clients)
+    return jax.vmap(lambda r: init_client_params(model, r))(rngs)
+
+
+def forward_with_loss(model: nn.Module, params, x: jax.Array, mask=None
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Reference forward parity: returns (latent, output, loss)
+    (Shrink_Autoencoder.py:159-163 / AutoEncoder.py:151-155)."""
+    latent, recon = model.apply({"params": params}, x)
+    return latent, recon, model.loss(x, latent, recon, mask)

@@ -1,0 +1,59 @@
+"""Centroid-based one-class classifier over SAE latent space.
+
+JAX port of the reference's `CentroidBasedOneClassClassifier`
+(src/Model/Centroid.py:6-39): standardize the training latents (so the
+centroid becomes the origin), anomaly score = Euclidean distance to the
+origin, decision threshold = the `100*threshold` percentile of training
+distances (reference default threshold=0.5 => median; the Evaluator uses
+the default, evaluator.py:96).
+
+Functional + masked: `fit_centroid` works on padded [S, L] latents and vmaps
+over the stacked client axis, so per-round hybrid evaluation of all N clients
+is one fused device computation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.ops.stats import masked_mean_std, masked_percentile
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CentroidClassifier:
+    """Fitted state: scaler stats + absolute threshold (a pytree)."""
+
+    mean: jax.Array   # [L]
+    scale: jax.Array  # [L]
+    abs_threshold: jax.Array  # scalar
+
+    def get_density(self, x: jax.Array, scale: bool = True) -> jax.Array:
+        """Distance to the origin of standardized latents (Centroid.py:30-35)."""
+        if scale:
+            x = (x - self.mean) / self.scale
+        return jnp.linalg.norm(x, axis=-1)
+
+    def predict(self, x: jax.Array) -> jax.Array:
+        """Boolean anomaly prediction (Centroid.py:37-39)."""
+        return self.get_density(x) > self.abs_threshold
+
+
+def fit_centroid(train_latent: jax.Array,
+                 mask: Optional[jax.Array] = None,
+                 threshold: float = 0.5) -> CentroidClassifier:
+    """Fit on (padded) training latents (Centroid.py:15-25).
+
+    sklearn StandardScaler semantics: biased std (ddof=0), zero-variance
+    columns mapped to scale 1.0.
+    """
+    mean, scale = masked_mean_std(train_latent, mask, ddof=0)
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    scaled = (train_latent - mean) / scale
+    dists = jnp.linalg.norm(scaled, axis=-1)
+    abs_threshold = masked_percentile(dists, 100.0 * threshold, mask)
+    return CentroidClassifier(mean=mean, scale=scale, abs_threshold=abs_threshold)

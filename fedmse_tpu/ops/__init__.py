@@ -1,0 +1,26 @@
+from fedmse_tpu.ops.losses import (
+    masked_mean,
+    mse_loss,
+    per_sample_mse,
+    prox_term,
+    shrink_loss,
+)
+from fedmse_tpu.ops.metrics import (
+    classification_metrics,
+    masked_auc,
+    roc_auc,
+)
+from fedmse_tpu.ops.stats import masked_mean_std, masked_percentile
+
+__all__ = [
+    "classification_metrics",
+    "masked_auc",
+    "masked_mean",
+    "masked_mean_std",
+    "masked_percentile",
+    "mse_loss",
+    "per_sample_mse",
+    "prox_term",
+    "roc_auc",
+    "shrink_loss",
+]

@@ -1,0 +1,58 @@
+"""Loss math with row masking for static-shape padded batches.
+
+Masked variants are exact: a mask of all-ones reproduces the reference's
+unmasked torch formulas bit-for-bit (up to float assoc):
+
+  * `mse_loss`     — torch nn.MSELoss(reduction='mean'): mean over ALL elements
+                     of the batch (client_trainer.py uses this everywhere).
+  * `shrink_loss`  — reference Shrink_Autoencoder.shrink_loss (:138-156):
+                     MSE + λ · (Σ_batch ‖latent_i‖₂) / batch_rows.
+  * `prox_term`    — FedProx proximal μ-term Σ‖p − p_global‖²
+                     (client_trainer.py:374-378; μ multiplied by caller).
+  * `per_sample_mse` — per-row mean MSE, the AE anomaly score
+                     (evaluator.py:56-62).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _safe_div(num: jax.Array, den: jax.Array) -> jax.Array:
+    return num / jnp.maximum(den, 1e-38)
+
+
+def masked_mean(values: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+    """Mean of `values` rows where mask==1 (mask broadcast over row axis)."""
+    if mask is None:
+        return jnp.mean(values)
+    return _safe_div(jnp.sum(values * mask), jnp.sum(mask))
+
+
+def per_sample_mse(x: jax.Array, recon: jax.Array) -> jax.Array:
+    """Per-row mean squared error: [rows, D] -> [rows]."""
+    return jnp.mean(jnp.square(x - recon), axis=-1)
+
+
+def mse_loss(x: jax.Array, recon: jax.Array,
+             mask: Optional[jax.Array] = None) -> jax.Array:
+    """torch MSELoss('mean') over valid rows: Σ(x-recon)²/(rows·D)."""
+    return masked_mean(per_sample_mse(x, recon), mask)
+
+
+def shrink_loss(x: jax.Array, recon: jax.Array, latent: jax.Array,
+                shrink_lambda: float, mask: Optional[jax.Array] = None
+                ) -> jax.Array:
+    """MSE + λ·mean_rows ‖latent‖₂ (reference Shrink_Autoencoder.py:138-156)."""
+    norms = jnp.linalg.norm(latent, axis=-1)
+    return mse_loss(x, recon, mask) + shrink_lambda * masked_mean(norms, mask)
+
+
+def prox_term(params, global_params) -> jax.Array:
+    """Σ over all tensors of Σ(p − p_global)² (client_trainer.py:374-378)."""
+    leaves = jax.tree_util.tree_leaves(
+        jax.tree.map(lambda p, g: jnp.sum(jnp.square(p - g)), params, global_params))
+    return jnp.sum(jnp.stack(leaves))

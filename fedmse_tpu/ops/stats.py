@@ -1,0 +1,45 @@
+"""Masked statistics helpers (sklearn/numpy-parity, static shapes)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_mean_std(x: jax.Array, mask: Optional[jax.Array] = None,
+                    ddof: int = 0, eps: float = 0.0
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Column-wise mean/std over valid rows. ddof=0 matches sklearn
+    StandardScaler; ddof=1 matches torch .std() (client_trainer.py:221-222)."""
+    if mask is None:
+        n = jnp.asarray(x.shape[0], dtype=x.dtype)
+        mean = jnp.mean(x, axis=0)
+        var = jnp.sum(jnp.square(x - mean), axis=0) / jnp.maximum(n - ddof, 1.0)
+    else:
+        m = mask[:, None]
+        n = jnp.sum(mask)
+        mean = jnp.sum(x * m, axis=0) / jnp.maximum(n, 1.0)
+        var = jnp.sum(jnp.square(x - mean) * m, axis=0) / jnp.maximum(n - ddof, 1.0)
+    return mean, jnp.sqrt(var) + eps
+
+
+def masked_percentile(values: jax.Array, q: float,
+                      mask: Optional[jax.Array] = None) -> jax.Array:
+    """np.percentile (linear interpolation) over valid entries, static shape.
+
+    Pads are sorted to +inf; the interpolation index uses the dynamic valid
+    count n: idx = q/100 * (n-1).
+    """
+    if mask is None:
+        return jnp.percentile(values, q)
+    s = jnp.sort(jnp.where(mask > 0, values, jnp.inf))
+    n = jnp.sum(mask > 0)
+    pos = (q / 100.0) * (n.astype(values.dtype) - 1.0)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, values.shape[0] - 1)
+    hi = jnp.clip(lo + 1, 0, values.shape[0] - 1)
+    frac = pos - lo.astype(values.dtype)
+    v_lo = s[lo]
+    v_hi = jnp.where(hi < n, s[hi], v_lo)  # guard hi==n when pos is integral
+    return v_lo + frac * (v_hi - v_lo)

@@ -1,0 +1,12 @@
+from fedmse_tpu.utils.seeding import ExperimentRngs, set_seeds
+from fedmse_tpu.utils.logging import get_logger
+from fedmse_tpu.utils.similarity import similarity_score, kl_divergence, js_divergence
+
+__all__ = [
+    "ExperimentRngs",
+    "set_seeds",
+    "get_logger",
+    "similarity_score",
+    "kl_divergence",
+    "js_divergence",
+]

@@ -1,0 +1,20 @@
+"""Single logging setup (the reference configures logging redundantly in every
+module — src/main.py:33-34, client_trainer.py:22-24, evaluator.py:11-12 ...;
+here it is configured once)."""
+
+from __future__ import annotations
+
+import logging
+
+_CONFIGURED = False
+
+
+def get_logger(name: str = "fedmse_tpu") -> logging.Logger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s - %(levelname)s - %(message)s",
+        )
+        _CONFIGURED = True
+    return logging.getLogger(name)

@@ -1,0 +1,56 @@
+"""Seeding discipline mirroring the reference (src/main.py:73-78, 115-117).
+
+The reference calls `set_seeds(run * 10000)` (torch + numpy + random) then
+re-seeds `random`/`np` with `data_seed=1234` so that device sampling and data
+splits are identical across runs while model init varies per run. We keep that
+split of responsibilities but on JAX PRNG:
+
+  * `data_rng`   — numpy Generator seeded with data_seed: device sampling,
+                   row shuffles, dev-dataset sampling (run-independent).
+  * `select_rng` — python-random-equivalent per-round client selection,
+                   seeded per run like the reference's global `random` state
+                   after re-seeding (src/main.py:116).
+  * `jax_root`   — jax.random.key(run_seed): model init, vote tie-breaks.
+
+JAX PRNG will never bit-match torch init, so parity targets are statistical
+(SURVEY.md §7 'hard parts' #5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random as _pyrandom
+
+import jax
+import numpy as np
+
+
+def set_seeds(seed: int) -> None:
+    """Global fallback seeding (reference set_seeds, src/main.py:73-78)."""
+    _pyrandom.seed(seed)
+    np.random.seed(seed)
+
+
+@dataclasses.dataclass
+class ExperimentRngs:
+    """All RNG streams for one (model_type, update_type, run) combination."""
+
+    run: int
+    data_seed: int = 1234
+    run_seed_stride: int = 10000
+
+    def __post_init__(self):
+        run_seed = self.run * self.run_seed_stride
+        # Data streams are seeded with data_seed only => identical splits across
+        # runs (reference src/main.py:115-117).
+        self.data_rng = np.random.default_rng(self.data_seed)
+        # Selection uses python random in the reference (src/main.py:271); a
+        # dedicated Random instance keeps it isolated from library internals.
+        self.select_rng = _pyrandom.Random(self.data_seed + 7919 * (self.run + 1))
+        # Model init / tie-breaks vary per run like torch.manual_seed(run*1e4).
+        self.jax_root = jax.random.key(run_seed if run_seed != 0 else 987654321)
+        self._fold = 0
+
+    def next_jax(self) -> jax.Array:
+        self._fold += 1
+        return jax.random.fold_in(self.jax_root, self._fold)

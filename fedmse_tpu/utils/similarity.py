@@ -1,0 +1,47 @@
+"""Distribution-similarity scores — capability parity with the reference's
+`src/Utils/utils.py` (dead code there: nothing imports it, SURVEY.md §2 #7 —
+kept here as a live, tested utility).
+
+  * `similarity_score` (reference utils.py:10-24): Jensen-Shannon divergence
+    between KDE score distributions of a dev set and a candidate set.
+  * `kl_divergence` / `js_divergence` (utils.py:26-53): closed-form Gaussian
+    KL and the JS-via-mixture approximation.
+
+Implemented on numpy/sklearn like the reference (these are host-side,
+offline analytics, not TPU hot paths)."""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.spatial.distance import jensenshannon
+from sklearn.neighbors import KernelDensity
+
+
+def similarity_score(dev_kde_scores: np.ndarray, dataset_2: np.ndarray) -> float:
+    """JS divergence between exp(KDE log-scores) of dev data and dataset_2."""
+    kde = KernelDensity(kernel="gaussian", bandwidth="scott").fit(dataset_2)
+    kde2_scores = kde.score_samples(dataset_2)
+    return float(jensenshannon(np.exp(dev_kde_scores), np.exp(kde2_scores)))
+
+
+def kl_divergence(p_mean: np.ndarray, p_cov: np.ndarray,
+                  q_mean: np.ndarray, q_cov: np.ndarray) -> float:
+    """KL(N(p)||N(q)) in closed form."""
+    k = p_mean.shape[0]
+    q_cov_inv = np.linalg.inv(q_cov)
+    tr = np.trace(q_cov_inv @ p_cov)
+    diff = q_mean - p_mean
+    mahalanobis = float(diff.T @ q_cov_inv @ diff)
+    det_ratio = float(np.log(np.linalg.det(q_cov) / np.linalg.det(p_cov)))
+    return 0.5 * (tr + mahalanobis - k + det_ratio)
+
+
+def js_divergence(p_mean: np.ndarray, p_cov: np.ndarray,
+                  q_mean: np.ndarray, q_cov: np.ndarray) -> float:
+    """Gaussian JS divergence via the half-mixture approximation."""
+    mix_mean = 0.5 * (p_mean + q_mean)
+    mix_cov = 0.5 * (p_cov + q_cov)
+    return 0.5 * (
+        kl_divergence(p_mean, p_cov, mix_mean, mix_cov)
+        + kl_divergence(q_mean, q_cov, mix_mean, mix_cov)
+    )

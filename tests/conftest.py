@@ -1,0 +1,36 @@
+"""Test configuration: force an 8-device virtual CPU platform so multi-client
+sharding paths are exercised without TPU hardware (SURVEY.md §4: the reference
+'simulates multi-node without a cluster'; we do the same at the XLA level).
+
+The container's sitecustomize registers the axon TPU backend in EVERY python
+process (and the axon hook initializes it even under JAX_PLATFORMS=cpu, which
+can block on the device tunnel). Tests must be hermetic and parallel-safe, so
+we deregister the axon backend factory before any backend is initialized.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)  # sitecustomize-registered TPU tunnel
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,152 @@
+"""Unit tests for loss math, metrics, stats — checked against torch/sklearn
+references where available (the same libraries the reference implementation
+uses, so agreement here is agreement with the reference's numerics)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.ops.losses import mse_loss, per_sample_mse, prox_term, shrink_loss
+from fedmse_tpu.ops.metrics import classification_metrics, roc_auc
+from fedmse_tpu.ops.stats import masked_mean_std, masked_percentile
+
+
+def test_mse_loss_matches_torch(rng):
+    import torch
+    x = rng.normal(size=(13, 7)).astype(np.float32)
+    y = rng.normal(size=(13, 7)).astype(np.float32)
+    want = torch.nn.MSELoss(reduction="mean")(torch.tensor(x), torch.tensor(y)).item()
+    got = float(mse_loss(jnp.asarray(x), jnp.asarray(y)))
+    assert got == pytest.approx(want, rel=1e-6)
+
+
+def test_mse_loss_masked_equals_unmasked_subset(rng):
+    x = rng.normal(size=(10, 4)).astype(np.float32)
+    y = rng.normal(size=(10, 4)).astype(np.float32)
+    mask = np.array([1] * 6 + [0] * 4, dtype=np.float32)
+    got = float(mse_loss(jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask)))
+    want = float(mse_loss(jnp.asarray(x[:6]), jnp.asarray(y[:6])))
+    assert got == pytest.approx(want, rel=1e-6)
+
+
+def test_shrink_loss_matches_reference_formula(rng):
+    """MSE + λ·(Σ‖z‖₂)/rows (reference Shrink_Autoencoder.py:138-156)."""
+    import torch
+    x = rng.normal(size=(9, 5)).astype(np.float32)
+    recon = rng.normal(size=(9, 5)).astype(np.float32)
+    z = rng.normal(size=(9, 3)).astype(np.float32)
+    lam = 5.0
+    want = (torch.nn.MSELoss(reduction="mean")(torch.tensor(x), torch.tensor(recon))
+            + lam * torch.sum(torch.linalg.vector_norm(torch.tensor(z), dim=1)) / 9).item()
+    got = float(shrink_loss(jnp.asarray(x), jnp.asarray(recon), jnp.asarray(z), lam))
+    assert got == pytest.approx(want, rel=1e-6)
+
+
+def test_prox_term(rng):
+    p = {"a": jnp.asarray(rng.normal(size=(3, 2)).astype(np.float32)),
+         "b": jnp.asarray(rng.normal(size=(4,)).astype(np.float32))}
+    g = jax.tree.map(lambda t: t + 0.5, p)
+    want = sum(float(np.sum((np.asarray(a) - np.asarray(b)) ** 2))
+               for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(g)))
+    assert float(prox_term(p, g)) == pytest.approx(want, rel=1e-6)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_auc_matches_sklearn(seed):
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.default_rng(seed)
+    n = 257
+    labels = (rng.random(n) < 0.3).astype(np.float32)
+    scores = rng.normal(size=n).astype(np.float32)
+    # inject ties
+    scores[::5] = np.round(scores[::5], 1)
+    want = roc_auc_score(labels, scores)
+    got = float(roc_auc(jnp.asarray(labels), jnp.asarray(scores)))
+    assert got == pytest.approx(want, abs=1e-6)
+
+
+def test_auc_masked_matches_subset():
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.default_rng(42)
+    n, valid = 64, 40
+    labels = (rng.random(n) < 0.5).astype(np.float32)
+    scores = rng.normal(size=n).astype(np.float32)
+    mask = (np.arange(n) < valid).astype(np.float32)
+    want = roc_auc_score(labels[:valid], scores[:valid])
+    got = float(roc_auc(jnp.asarray(labels), jnp.asarray(scores), jnp.asarray(mask)))
+    assert got == pytest.approx(want, abs=1e-6)
+
+
+def test_auc_large_scale_no_overflow():
+    """Regression: int32 overflow at N-BaIoT scale (>=46341 rows per class)."""
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.default_rng(9)
+    n = 120_000
+    labels = (rng.random(n) < 0.6).astype(np.float32)
+    scores = (rng.normal(size=n) + labels).astype(np.float32)
+    want = roc_auc_score(labels, scores)
+    got = float(roc_auc(jnp.asarray(labels), jnp.asarray(scores)))
+    assert got == pytest.approx(want, abs=1e-4)
+
+
+def test_auc_single_class_is_nan():
+    labels = jnp.zeros(10)
+    scores = jnp.arange(10.0)
+    assert np.isnan(float(roc_auc(labels, scores)))
+
+
+def test_classification_metrics_match_sklearn():
+    from sklearn.metrics import f1_score, precision_score, recall_score
+    rng = np.random.default_rng(3)
+    labels = (rng.random(100) < 0.4).astype(np.float32)
+    scores = rng.random(100).astype(np.float32)
+    pred = (scores > 0.5).astype(int)
+    f1, prec, rec = classification_metrics(jnp.asarray(labels), jnp.asarray(scores))
+    assert float(f1) == pytest.approx(f1_score(labels, pred), abs=1e-6)
+    assert float(prec) == pytest.approx(precision_score(labels, pred), abs=1e-6)
+    assert float(rec) == pytest.approx(recall_score(labels, pred), abs=1e-6)
+
+
+def test_masked_mean_std_ddof(rng):
+    x = rng.normal(size=(20, 3)).astype(np.float32)
+    mask = (np.arange(20) < 12).astype(np.float32)
+    mean0, std0 = masked_mean_std(jnp.asarray(x), jnp.asarray(mask), ddof=0)
+    mean1, std1 = masked_mean_std(jnp.asarray(x), jnp.asarray(mask), ddof=1)
+    np.testing.assert_allclose(np.asarray(mean0), x[:12].mean(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(std0), x[:12].std(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(std1), x[:12].std(0, ddof=1), rtol=1e-5)
+
+
+@pytest.mark.parametrize("q", [0.0, 25.0, 50.0, 95.0, 100.0])
+def test_masked_percentile_matches_numpy(q):
+    rng = np.random.default_rng(7)
+    vals = rng.normal(size=33).astype(np.float32)
+    mask = (np.arange(33) < 21).astype(np.float32)
+    want = np.percentile(vals[:21], q)
+    got = float(masked_percentile(jnp.asarray(vals), q, jnp.asarray(mask)))
+    assert got == pytest.approx(want, abs=1e-5)
+
+
+def test_centroid_matches_sklearn_reference(rng):
+    """Full parity with reference Centroid.py fit/get_density/predict."""
+    from sklearn import preprocessing
+    import scipy.spatial
+    from fedmse_tpu.models.centroid import fit_centroid
+
+    train = rng.normal(size=(50, 7)).astype(np.float32)
+    test = rng.normal(size=(30, 7)).astype(np.float32)
+
+    scaler = preprocessing.StandardScaler().fit(train)
+    dists_ref = scipy.spatial.distance.cdist(
+        scaler.transform(test), np.zeros((1, 7))).mean(axis=1)
+    thr_ref = np.percentile(scipy.spatial.distance.cdist(
+        scaler.transform(train), np.zeros((1, 7))).mean(axis=1), 50.0)
+
+    cen = fit_centroid(jnp.asarray(train))
+    got = np.asarray(cen.get_density(jnp.asarray(test)))
+    np.testing.assert_allclose(got, dists_ref, rtol=1e-4)
+    assert float(cen.abs_threshold) == pytest.approx(thr_ref, rel=1e-4)
+    np.testing.assert_array_equal(
+        np.asarray(cen.predict(jnp.asarray(test))), dists_ref > thr_ref)
