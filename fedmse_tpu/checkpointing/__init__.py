@@ -1,0 +1,13 @@
+from fedmse_tpu.checkpointing.io import (
+    CheckpointManager,
+    ResultsWriter,
+    save_client_models,
+    save_training_tracking,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "ResultsWriter",
+    "save_client_models",
+    "save_training_tracking",
+]

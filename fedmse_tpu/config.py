@@ -72,6 +72,25 @@ class DatasetConfig:
             "devices_list": [dataclasses.asdict(d) for d in self.devices_list],
         }
 
+    @staticmethod
+    def for_client_dirs(data_path: str, n_clients: int,
+                        name_prefix: str = "Client") -> "DatasetConfig":
+        """Generate a config for the standard shard layout
+        `<data_path>/Client-k/{normal,abnormal,test_normal}` that the
+        reference's data-prep notebook emits (SURVEY.md §2 #9) — covers the
+        N-BaIoT IID/non-IID and Kitsune datasets without hand-written JSON."""
+        devices = tuple(
+            DeviceSpec(
+                id=k,
+                name=f"{name_prefix}-{k}",
+                normal_data_path=f"Client-{k}/normal",
+                abnormal_data_path=f"Client-{k}/abnormal",
+                test_normal_data_path=f"Client-{k}/test_normal",
+            )
+            for k in range(1, n_clients + 1)
+        )
+        return DatasetConfig(data_path=data_path, devices_list=devices)
+
 
 @dataclasses.dataclass(frozen=True)
 class CompatConfig:

@@ -1,0 +1,240 @@
+"""Experiment driver: the sweep {model_type x update_type x run} with
+reference-parity results artifacts, CLI-overridable typed config, and
+checkpoint/resume.
+
+Re-architecture of the reference's `src/main.py` (400 lines of module-global
+script): the hyperparameters live in `ExperimentConfig` (every global from
+src/main.py:37-71), the per-combination pipeline is `run_combination`, and the
+sweep driver is `run_experiment` (src/main.py:108-399). Differences by design:
+  * data is prepared ONCE and reused across combinations — the reference
+    reloads and re-shuffles per combination but re-seeds to data_seed first
+    (src/main.py:115-117), so every combination sees identical splits; we
+    compute that fixed point directly;
+  * global early stopping reproduces the reference's inverted-AUC comparison
+    and cross-combination state (SURVEY.md §2 quirk 10) under
+    compat.inverted_global_early_stop / global_early_stop_state_shared,
+    with the fixed higher-is-better variant behind the flags;
+  * checkpoints can actually be resumed (checkpointing/io.py).
+
+CLI:  python -m fedmse_tpu.main --dataset-config <reference-format json>
+        [--data-root ...] [--num-rounds 20] [--epochs 100] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import math
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from fedmse_tpu.config import (DatasetConfig, ExperimentConfig,
+                               add_cli_overrides, apply_cli_overrides)
+from fedmse_tpu.checkpointing import (CheckpointManager, ResultsWriter,
+                                      save_client_models,
+                                      save_training_tracking)
+from fedmse_tpu.data import build_dev_dataset, prepare_clients, stack_clients
+from fedmse_tpu.federation import RoundEngine
+from fedmse_tpu.models import make_model
+from fedmse_tpu.parallel import client_mesh, pad_to_multiple, shard_federation
+from fedmse_tpu.utils.logging import get_logger
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class GlobalEarlyStop:
+    """The reference's global early stopping (src/main.py:356-365 + quirk 10):
+    `min(client_metrics) < best` counts as improvement (a loss convention
+    applied to AUC), with state optionally carried across combinations
+    (module global never reset, src/main.py:55)."""
+
+    inverted: bool = True
+    patience: int = 1
+    best: float = math.inf
+    worse: int = 0
+
+    def reset(self):
+        self.best, self.worse = (math.inf if self.inverted else -math.inf), 0
+
+    def should_stop(self, client_metrics: np.ndarray) -> bool:
+        value = float(np.nanmin(client_metrics))
+        improved = value < self.best if self.inverted else value > self.best
+        if improved:
+            self.best, self.worse = value, 0
+            return False
+        self.worse += 1
+        return self.worse > self.patience
+
+
+def prepare_federation(cfg: ExperimentConfig, dataset: DatasetConfig,
+                       pad_multiple: Optional[int] = None):
+    """Load + split + stack the federation once (see module docstring)."""
+    rngs = ExperimentRngs(run=0, data_seed=cfg.data_seed)
+    clients = prepare_clients(dataset, cfg, rngs.data_rng)
+    dev_x = build_dev_dataset(clients, rngs.data_rng)
+    n_real = len(clients)
+    pad_to = pad_to_multiple(n_real, pad_multiple) if pad_multiple else n_real
+    data = stack_clients(clients, dev_x, cfg.batch_size, pad_clients_to=pad_to)
+    return clients, data, n_real
+
+
+def run_combination(cfg: ExperimentConfig, data, n_real: int,
+                    model_type: str, update_type: str, run: int,
+                    writer: Optional[ResultsWriter] = None,
+                    early_stop: Optional[GlobalEarlyStop] = None,
+                    device_names: Optional[List[str]] = None,
+                    mesh=None,
+                    resume: Optional[CheckpointManager] = None,
+                    save_checkpoints: bool = False) -> Dict:
+    """One (model_type, update_type, run): the reference round loop
+    (src/main.py:267-365) + final evaluation (src/main.py:368-374)."""
+    rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed,
+                          run_seed_stride=cfg.run_seed_stride)
+    model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
+                       cfg.latent_dim, cfg.shrink_lambda)
+    engine = RoundEngine(model, cfg, data, n_real=n_real, rngs=rngs,
+                         model_type=model_type, update_type=update_type)
+    if mesh is not None:
+        engine.data, engine.states = shard_federation(data, engine.states, mesh)
+        engine._ver_x, engine._ver_m = engine._verification_tensors()
+
+    tag = f"{model_type}_{update_type}_run{run}"
+    start_round = 0
+    if resume is not None and resume.exists(tag):
+        engine.states, engine.host, start_round = resume.restore(
+            tag, engine.states)
+        logger.info("resumed %s at round %d", tag, start_round)
+
+    round_times: List[float] = []
+    last_result = None
+    for round_index in range(start_round, cfg.num_rounds):
+        t0 = time.time()
+        result = engine.run_round(round_index)
+        round_times.append(time.time() - t0)
+        last_result = result
+        logger.info("[%s/%s run %d] round %d: agg=%s mean %s=%.4f (%.2fs)",
+                    model_type, update_type, run, round_index + 1,
+                    result.aggregator, cfg.metric,
+                    float(np.nanmean(result.client_metrics)), round_times[-1])
+        if writer is not None:
+            writer.append_round_metrics(run, round_index, result.client_metrics,
+                                        model_type, update_type)
+            writer.append_verification(run, round_index,
+                                       result.verification_results)
+        if resume is not None:
+            resume.save(tag, engine.states, engine.host, round_index + 1)
+        if early_stop is not None and early_stop.should_stop(result.client_metrics):
+            logger.info("Early stopping in global round!")
+            break
+
+    # final evaluation over every client (src/main.py:368-374)
+    final_metrics = np.asarray(jax.device_get(engine.evaluate_all(
+        engine.states.params, engine.data.test_x, engine.data.test_m,
+        engine.data.test_y, engine.data.train_xb,
+        engine.data.train_mb)))[:n_real]
+
+    if writer is not None and save_checkpoints and device_names:
+        save_client_models(writer, run, model_type, update_type, device_names,
+                           jax.device_get(engine.states.params))
+        if last_result is not None:
+            save_training_tracking(writer, run, model_type, update_type,
+                                   device_names, last_result.tracking)
+
+    return {
+        "final_metrics": final_metrics,
+        "best_final": float(np.nanmax(final_metrics)),
+        "round_times": round_times,
+        "rounds_run": len(round_times),
+        "aggregation_count": engine.host.aggregation_count.tolist(),
+        "votes_received": engine.host.votes_received.tolist(),
+    }
+
+
+def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
+                   use_mesh: bool = False,
+                   save_checkpoints: bool = True,
+                   resume_dir: Optional[str] = None) -> Dict:
+    """The full sweep (src/main.py:108-399) -> training summary dict."""
+    mesh = None
+    pad_multiple = None
+    if use_mesh and len(jax.devices()) > 1:
+        mesh = client_mesh()
+        pad_multiple = mesh.devices.size
+
+    clients, data, n_real = prepare_federation(cfg, dataset, pad_multiple)
+    device_names = [c.name for c in clients]
+
+    writer = ResultsWriter(cfg.checkpoint_dir, cfg.network_size,
+                           cfg.experiment_name, cfg.scen_name, cfg.metric,
+                           cfg.num_participants)
+    resume = CheckpointManager(resume_dir) if resume_dir else None
+
+    early_stop = GlobalEarlyStop(
+        inverted=cfg.compat.inverted_global_early_stop,
+        patience=cfg.global_patience)
+    early_stop.reset()
+
+    best_metrics = {mt: {ut: float("-inf") for ut in cfg.update_types}
+                    for mt in cfg.model_types}
+    all_results = {}
+    for model_type in cfg.model_types:
+        for update_type in cfg.update_types:
+            for run in range(cfg.num_runs):
+                if not cfg.compat.global_early_stop_state_shared:
+                    early_stop.reset()  # fixed mode: per-combination state
+                out = run_combination(
+                    cfg, data, n_real, model_type, update_type, run,
+                    writer=writer, early_stop=early_stop,
+                    device_names=device_names, mesh=mesh, resume=resume,
+                    save_checkpoints=save_checkpoints)
+                best_metrics[model_type][update_type] = max(
+                    best_metrics[model_type][update_type], out["best_final"])
+                all_results[f"{model_type}/{update_type}/run{run}"] = {
+                    "final_metrics": out["final_metrics"].tolist(),
+                    "round_times": out["round_times"],
+                }
+
+    summary_path = writer.write_summary(best_metrics, cfg.num_runs)
+    logger.info("Saved training summary to %s", summary_path)
+    return {"best_metrics": best_metrics, "results": all_results,
+            "summary_path": summary_path}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dataset-config", required=True,
+                   help="reference-format JSON (Configuration/*.json schema)")
+    p.add_argument("--data-root", default=None,
+                   help="root replacing the JSON's relative data_path")
+    p.add_argument("--use-mesh", action="store_true",
+                   help="shard the client axis over all local devices")
+    p.add_argument("--resume-dir", default=None,
+                   help="directory for full-state checkpoints (enables resume)")
+    p.add_argument("--no-save", action="store_true",
+                   help="skip per-client model/tracking artifacts")
+    p.add_argument("--paper-scale", action="store_true",
+                   help="epochs=100 rounds=20 lr=1e-5 lambda=10 (README.md:30-34)")
+    add_cli_overrides(p)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> Dict:
+    args = build_parser().parse_args(argv)
+    cfg = apply_cli_overrides(ExperimentConfig(), args)
+    if args.paper_scale:
+        from fedmse_tpu.config import paper_scale
+        cfg = paper_scale(cfg)
+    dataset = DatasetConfig.from_json(args.dataset_config, args.data_root)
+    return run_experiment(cfg, dataset, use_mesh=args.use_mesh,
+                          save_checkpoints=not args.no_save,
+                          resume_dir=args.resume_dir)
+
+
+if __name__ == "__main__":
+    main()

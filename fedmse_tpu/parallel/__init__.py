@@ -1,0 +1,17 @@
+from fedmse_tpu.parallel.mesh import (
+    client_mesh,
+    pad_to_multiple,
+    replicate,
+    shard_clients,
+    shard_federation,
+)
+from fedmse_tpu.parallel.collectives import make_shardmap_aggregate
+
+__all__ = [
+    "client_mesh",
+    "make_shardmap_aggregate",
+    "pad_to_multiple",
+    "replicate",
+    "shard_clients",
+    "shard_federation",
+]

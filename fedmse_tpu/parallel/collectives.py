@@ -1,0 +1,72 @@
+"""Explicit-collective aggregation via shard_map (the ICI-visible path).
+
+`federation.aggregation.make_aggregate_fn` relies on jit auto-partitioning to
+lower the weighted tree-reduction to collectives. This module provides the
+same aggregation with the communication written out explicitly in per-device
+code: each device computes the weighted partial sum of ITS client shard, then
+a single `jax.lax.psum` over the 'clients' mesh axis produces the replicated
+aggregated model — one all-reduce over ICI per round, which is the entire
+communication volume of a federated round (the reference's equivalent is N
+python-object state_dict copies, client_trainer.py:305-315).
+
+Useful both as documentation of the communication pattern and as a fallback
+when auto-partitioning chooses a worse layout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from fedmse_tpu.ops.losses import mse_loss
+
+
+def make_shardmap_aggregate(model, update_type: str, mesh: Mesh,
+                            axis_name: str = "clients") -> Callable:
+    """Build fn(stacked_params, sel_mask, dev_x) -> (agg_params, weights[N]).
+
+    Semantics identical to federation.aggregation.make_aggregate_fn (fed_avg /
+    fedprox = masked mean, fed_mse_avg = 1/MSE(dev) weights — reference
+    client_trainer.py:107-134); execution is explicit SPMD.
+    """
+
+    def dev_mse(params, dev_x):
+        _, recon = model.apply({"params": params}, dev_x)
+        return mse_loss(dev_x, recon)
+
+    def per_device(params_shard, sel_shard, dev_x):
+        # local weights for this device's clients
+        if update_type == "mse_avg":
+            mses = jax.vmap(dev_mse, in_axes=(0, None))(params_shard, dev_x)
+            raw = sel_shard / mses
+        else:
+            raw = sel_shard
+        total = jax.lax.psum(jnp.sum(raw), axis_name)
+        w = raw / total
+        # weighted partial sum of the local shard, then one all-reduce
+        partial_sum = jax.tree.map(
+            lambda t: jnp.einsum("n,n...->...", w.astype(t.dtype), t),
+            params_shard)
+        agg = jax.lax.psum(partial_sum, axis_name)
+        return agg, w
+
+    spec_clients = P(axis_name)
+
+    def in_specs_for(tree):
+        return jax.tree.map(lambda _: P(axis_name), tree)
+
+    @jax.jit
+    def aggregate(stacked_params, sel_mask, dev_x) -> Tuple[Any, jax.Array]:
+        fn = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(in_specs_for(stacked_params), spec_clients, P()),
+            out_specs=(jax.tree.map(lambda _: P(), stacked_params), spec_clients),
+        )
+        return fn(stacked_params, sel_mask, dev_x)
+
+    return aggregate

@@ -1,0 +1,92 @@
+"""Device mesh + client-axis sharding: the federation's distributed backend.
+
+The reference has NO communication backend — peers are in-process objects
+wired by method calls (SURVEY.md §5.8; src/main.py:260-264). The TPU-native
+equivalent maps the *federated client axis* onto a 1-D `jax.sharding.Mesh`:
+
+  * every stacked array/pytree leaf with a leading client axis is sharded
+    `PartitionSpec('clients', ...)` — each device holds its shard of clients'
+    params, optimizer state, and data;
+  * local training (a vmapped scan) is embarrassingly parallel along the
+    sharded axis — zero communication;
+  * aggregation's weighted tree-reduction (`einsum('n,n...->...')`) reduces
+    over the sharded axis — XLA lowers it to a weighted all-reduce over ICI
+    (DCN across hosts in a multi-host pod);
+  * broadcast-back is the replication of the aggregated pytree, which XLA
+    fuses into the same collective.
+
+Clients-per-device > 1 is the normal case (e.g. 10 clients padded to 16 on a
+v5e-8 mesh = 2 per device); padding clients carry zero masks everywhere, so
+collectives stay correct (see data/stacking.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Round the client count up to a multiple of the device count."""
+    return -(-n // multiple) * multiple
+
+
+def client_mesh(n_devices: Optional[int] = None,
+                devices: Optional[Sequence[jax.Device]] = None,
+                axis_name: str = "clients") -> Mesh:
+    """1-D mesh over `n_devices` (default: all local devices)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def shard_clients(tree: Any, mesh: Mesh, axis_name: str = "clients") -> Any:
+    """Place a stacked pytree with its leading axis sharded over the mesh."""
+    def place(leaf):
+        leaf = jnp.asarray(leaf)
+        spec = P(axis_name, *([None] * (leaf.ndim - 1)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    return jax.tree.map(place, tree)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Replicate a pytree across every device of the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda leaf: jax.device_put(jnp.asarray(leaf), sharding),
+                        tree)
+
+
+def shard_federation(data, states, mesh: Mesh, axis_name: str = "clients"):
+    """Shard a FederatedData + ClientStates pair onto the mesh.
+
+    Per-client leaves (leading axis = padded client count) go
+    `P('clients')`; the shared dev set is replicated. jit then propagates
+    these shardings through the whole round computation.
+    """
+    import dataclasses
+
+    from fedmse_tpu.data.stacking import FederatedData
+
+    n = data.num_clients_padded
+    if n % mesh.devices.size != 0:
+        raise ValueError(
+            f"padded client count {n} must be a multiple of the mesh size "
+            f"{mesh.devices.size}; stack with pad_clients_to="
+            f"pad_to_multiple(n_real, mesh_size)")
+
+    sharded_data = FederatedData(**{
+        f.name: (replicate(getattr(data, f.name), mesh)
+                 if f.name == "dev_x"
+                 else shard_clients(getattr(data, f.name), mesh, axis_name))
+        for f in dataclasses.fields(FederatedData)
+    })
+    sharded_states = jax.tree.map(
+        lambda leaf: shard_clients(leaf, mesh, axis_name), states,
+        is_leaf=lambda x: x is None)
+    return sharded_data, sharded_states

@@ -10,11 +10,18 @@ _CONFIGURED = False
 
 
 def get_logger(name: str = "fedmse_tpu") -> logging.Logger:
+    """Logger with a dedicated stderr handler on the package root, immune to
+    other libraries (absl/orbax) claiming the root logger first."""
     global _CONFIGURED
+    pkg_root = logging.getLogger("fedmse_tpu")
     if not _CONFIGURED:
-        logging.basicConfig(
-            level=logging.INFO,
-            format="%(asctime)s - %(levelname)s - %(message)s",
-        )
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s - %(levelname)s - %(message)s"))
+        pkg_root.addHandler(handler)
+        pkg_root.setLevel(logging.INFO)
+        pkg_root.propagate = False
         _CONFIGURED = True
-    return logging.getLogger(name)
+    if name == "fedmse_tpu" or name.startswith("fedmse_tpu."):
+        return logging.getLogger(name)
+    return logging.getLogger("fedmse_tpu." + name)

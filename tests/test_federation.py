@@ -1,0 +1,342 @@
+"""Federation-layer tests: aggregation properties, voting/quota semantics,
+verification accept/reject logic, local-training behavior, full-round
+integration on synthetic data (SURVEY.md §4 test plan)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedmse_tpu.config import CompatConfig, ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+from fedmse_tpu.federation import (RoundEngine, elect_aggregator,
+                                   init_client_states, make_aggregate_fn,
+                                   make_local_train_all, make_mse_scores_fn,
+                                   make_verify_fn)
+from fedmse_tpu.models import make_model, init_stacked_params
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+DIM = 12
+
+
+@pytest.fixture(scope="module")
+def model():
+    return make_model("hybrid", DIM, shrink_lambda=2.0)
+
+
+@pytest.fixture(scope="module")
+def stacked_params(model):
+    return init_stacked_params(model, jax.random.key(1), 4)
+
+
+# ---------------------------- aggregation ---------------------------- #
+
+def test_fedavg_equal_weights_is_mean(model, stacked_params):
+    """Property: FedAvg over the full cohort == plain mean (fed_avg with
+    weight 1 per client, reference client_trainer.py:107-113)."""
+    agg_fn = make_aggregate_fn(model, "avg")
+    sel = jnp.ones(4)
+    agg, w = agg_fn(stacked_params, sel, jnp.zeros((8, DIM)))
+    np.testing.assert_allclose(np.asarray(w), 0.25, rtol=1e-6)
+    want = jax.tree.map(lambda t: np.mean(np.asarray(t), axis=0), stacked_params)
+    got = jax.tree.map(np.asarray, agg)
+    for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_fedavg_respects_selection_mask(model, stacked_params):
+    agg_fn = make_aggregate_fn(model, "avg")
+    sel = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    agg, w = agg_fn(stacked_params, sel, jnp.zeros((8, DIM)))
+    np.testing.assert_allclose(np.asarray(w), [0.5, 0, 0.5, 0], rtol=1e-6)
+    leaf = jax.tree.leaves(stacked_params)[0]
+    want = (np.asarray(leaf[0]) + np.asarray(leaf[2])) / 2
+    np.testing.assert_allclose(np.asarray(jax.tree.leaves(agg)[0]), want, rtol=1e-5)
+
+
+def test_fedprox_aggregation_equals_fedavg(model, stacked_params):
+    """FedProx aggregation == FedAvg (reference client_trainer.py:132-134)."""
+    sel = jnp.asarray([1.0, 1.0, 0.0, 1.0])
+    dev = jnp.zeros((8, DIM))
+    a1, w1 = make_aggregate_fn(model, "avg")(stacked_params, sel, dev)
+    a2, w2 = make_aggregate_fn(model, "fedprox")(stacked_params, sel, dev)
+    np.testing.assert_allclose(np.asarray(w1), np.asarray(w2))
+    for x, y in zip(jax.tree.leaves(a1), jax.tree.leaves(a2)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y))
+
+
+def test_mse_avg_weights_are_inverse_mse_normalized(model, stacked_params):
+    """fed_mse_avg weight_i ∝ 1/MSE(dev, recon_i), summing to 1
+    (reference client_trainer.py:115-130)."""
+    from fedmse_tpu.ops.losses import mse_loss
+    rng = np.random.default_rng(0)
+    dev = jnp.asarray(rng.normal(size=(32, DIM)).astype(np.float32))
+    sel = jnp.ones(4)
+    agg_fn = make_aggregate_fn(model, "mse_avg")
+    _, w = agg_fn(stacked_params, sel, dev)
+    mses = []
+    for i in range(4):
+        p_i = jax.tree.map(lambda t: t[i], stacked_params)
+        _, recon = model.apply({"params": p_i}, dev)
+        mses.append(float(mse_loss(dev, recon)))
+    want = (1.0 / np.asarray(mses))
+    want = want / want.sum()
+    np.testing.assert_allclose(np.asarray(w), want, rtol=1e-4)
+    assert float(jnp.sum(w)) == pytest.approx(1.0, abs=1e-5)
+
+
+# ------------------------------ voting ------------------------------- #
+
+def test_mse_scores_restandardize_matches_torch_convention(model, stacked_params):
+    """calculate_mse_score re-standardizes with ddof=1 + 1e-8 then averages
+    batch MSEs (reference client_trainer.py:208-247)."""
+    rng = np.random.default_rng(1)
+    val = rng.normal(size=(300, DIM)).astype(np.float32)
+    scores_fn = make_mse_scores_fn(model, restandardize=True, tie_break=False)
+    got = np.asarray(scores_fn(stacked_params, jnp.asarray(val),
+                               jnp.ones(300), jax.random.key(0)))
+    # manual reference computation for client 0
+    mean = val.mean(0, keepdims=True)
+    std = val.std(0, ddof=1, keepdims=True) + 1e-8
+    norm = (val - mean) / std
+    p0 = jax.tree.map(lambda t: t[0], stacked_params)
+    batch_mses = []
+    for i in range(0, 300, 128):
+        b = jnp.asarray(norm[i:i + 128])
+        _, recon = model.apply({"params": p0}, b)
+        batch_mses.append(float(jnp.mean(jnp.square(b - recon))))
+    assert got[0] == pytest.approx(np.mean(batch_mses), rel=1e-4)
+
+
+def test_tie_break_factor_bounds(model, stacked_params):
+    rng = np.random.default_rng(1)
+    val = jnp.asarray(rng.normal(size=(64, DIM)).astype(np.float32))
+    m = jnp.ones(64)
+    base = np.asarray(make_mse_scores_fn(model, tie_break=False)(
+        stacked_params, val, m, jax.random.key(0)))
+    jittered = np.asarray(make_mse_scores_fn(model, tie_break=True)(
+        stacked_params, val, m, jax.random.key(0)))
+    ratio = jittered / base
+    assert np.all(ratio >= 1 - 1.01e-4) and np.all(ratio <= 1 + 1.01e-4)
+    assert not np.allclose(ratio, 1.0)
+
+
+def test_election_first_voter_wins_and_quota():
+    """Voter 0 votes for the lowest-MSE other client under quota
+    (reference client_trainer.py:249-285, main.py:282-288)."""
+    votes = np.zeros(4, dtype=np.int64)
+    scores = np.asarray([0.5, 0.1, 0.3, 0.2])
+    agg_count = np.zeros(4, dtype=np.int64)
+    winner, _ = elect_aggregator([0, 1, 2, 3], lambda: scores, agg_count, votes)
+    assert winner == 1 and votes[1] == 1  # lowest MSE, not the voter itself
+
+    # quota: client 1 maxed out -> next lowest (3) wins
+    agg_count = np.asarray([0, 3, 0, 0])
+    winner, _ = elect_aggregator([0, 1, 2, 3], lambda: scores, agg_count, votes)
+    assert winner == 3
+
+    # voter never votes for itself even if it has the lowest score
+    winner, _ = elect_aggregator([1, 0, 2, 3], lambda: scores,
+                                 np.zeros(4, dtype=np.int64), votes)
+    assert winner == 3  # 1 is the voter; best other under quota is 3 (0.2)
+
+    # all candidates at quota -> None
+    winner, _ = elect_aggregator([0, 1], lambda: scores,
+                                 np.asarray([3, 3]), votes)
+    assert winner is None
+
+
+# --------------------------- verification ---------------------------- #
+
+def _mk_states(model, n=4, seed=2):
+    tx = optax.adam(1e-3)
+    return init_client_states(model, tx, jax.random.key(seed), n)
+
+
+def test_verify_first_update_always_accepted(model):
+    states = _mk_states(model)
+    verify = make_verify_fn(model, verification_threshold=0.0,
+                            performance_threshold=0.0)
+    agg = jax.tree.map(lambda t: t[0] + 100.0, states.params)  # huge delta
+    ver_x = jnp.zeros((4, 16, DIM))
+    ver_m = jnp.ones((4, 16))
+    onehot = jnp.asarray([0.0, 0, 0, 1])  # client 3 aggregates
+    out = verify(states, agg, ver_x, ver_m, onehot, jnp.ones(4))
+    acc = np.asarray(out.accepted)
+    assert acc.tolist() == [True, True, True, True]  # first contact + aggregator
+    assert np.asarray(out.states.rejected).tolist() == [0, 0, 0, 0]
+    assert np.asarray(out.states.hist_seen).tolist() == [True, True, True, False]
+
+
+def test_verify_reject_on_param_delta(model):
+    states = _mk_states(model)
+    verify = make_verify_fn(model, verification_threshold=3.0,
+                            performance_threshold=0.002)
+    ver_x = jnp.zeros((4, 16, DIM))
+    ver_m = jnp.ones((4, 16))
+    onehot = jnp.asarray([0.0, 0, 0, 1])
+    agg1 = jax.tree.map(lambda t: t[0], states.params)
+    out1 = verify(states, agg1, ver_x, ver_m, onehot, jnp.ones(4))
+    # second update with a huge parameter jump -> delta check fails
+    agg2 = jax.tree.map(lambda t: t + 50.0, agg1)
+    out2 = verify(out1.states, agg2, ver_x, ver_m, onehot, jnp.ones(4))
+    acc = np.asarray(out2.accepted)
+    assert acc.tolist() == [False, False, False, True]  # only aggregator
+    assert np.asarray(out2.states.rejected).tolist() == [1, 1, 1, 0]
+    assert np.all(np.asarray(out2.param_delta)[:3] > 3.0)
+    # history advanced to the REJECTED state (model_verifier.py:59-66)
+    h = np.asarray(jax.tree.leaves(out2.states.hist_params)[0][0])
+    w = np.asarray(jax.tree.leaves(agg2)[0])
+    np.testing.assert_allclose(h, w)
+    # rejection does not move the client's live params
+    p = np.asarray(jax.tree.leaves(out2.states.params)[0][0])
+    p_prev = np.asarray(jax.tree.leaves(out1.states.params)[0][0])
+    np.testing.assert_allclose(p, p_prev)
+
+
+def test_verify_reject_on_perf_drop(model):
+    states = _mk_states(model)
+    verify = make_verify_fn(model, verification_threshold=1e9,
+                            performance_threshold=0.002)
+    rng = np.random.default_rng(3)
+    ver_x = jnp.asarray(np.tile(rng.normal(size=(1, 16, DIM)), (4, 1, 1))
+                        .astype(np.float32))
+    ver_m = jnp.ones((4, 16))
+    onehot = jnp.asarray([0.0, 0, 0, 1])
+    agg1 = jax.tree.map(lambda t: t[0], states.params)
+    out1 = verify(states, agg1, ver_x, ver_m, onehot, jnp.ones(4))
+    # corrupt the decoder output layer -> reconstruction collapses -> perf drop
+    agg2 = jax.tree.map(lambda t: t * 0.0 + 10.0, agg1)
+    out2 = verify(out1.states, agg2, ver_x, ver_m, onehot, jnp.ones(4))
+    assert np.asarray(out2.accepted).tolist() == [False, False, False, True]
+    assert np.all(np.asarray(out2.perf_change)[:3] < -0.002)
+
+
+# ------------------------- local training ---------------------------- #
+
+def test_local_training_decreases_loss(model):
+    tx = optax.adam(1e-2)
+    train_all = make_local_train_all(model, tx, epochs=8, patience=8,
+                                     fedprox=False, mu=0.0, donate=False)
+    states = _mk_states(model, n=2)
+    rng = np.random.default_rng(4)
+    xb = jnp.asarray(rng.normal(size=(2, 6, 8, DIM)).astype(np.float32))
+    mb = jnp.ones((2, 6, 8))
+    sel = jnp.ones(2)
+    _, _, _, _, tracking = train_all(states.params, states.opt_state,
+                                     states.prev_global, sel, xb, mb, xb, mb)
+    track = np.asarray(tracking)
+    assert np.all(track[:, -1, 0] < track[:, 0, 0])  # train loss decreased
+
+
+def test_unselected_clients_unchanged(model):
+    tx = optax.adam(1e-2)
+    train_all = make_local_train_all(model, tx, epochs=2, patience=2,
+                                     fedprox=False, mu=0.0, donate=False)
+    states = _mk_states(model, n=2)
+    rng = np.random.default_rng(5)
+    xb = jnp.asarray(rng.normal(size=(2, 4, 8, DIM)).astype(np.float32))
+    mb = jnp.ones((2, 4, 8))
+    sel = jnp.asarray([1.0, 0.0])
+    params, _, _, min_valid, tracking = train_all(
+        states.params, states.opt_state, states.prev_global, sel, xb, mb, xb, mb)
+    before = np.asarray(jax.tree.leaves(states.params)[0][1])
+    after = np.asarray(jax.tree.leaves(params)[0][1])
+    np.testing.assert_allclose(before, after)  # client 1 untouched
+    assert not np.allclose(np.asarray(jax.tree.leaves(params)[0][0]),
+                           np.asarray(jax.tree.leaves(states.params)[0][0]))
+    # unselected clients report no training curves (NaN-masked)
+    assert np.all(np.isnan(np.asarray(tracking)[1]))
+    assert np.isnan(np.asarray(min_valid)[1])
+
+
+def test_early_stopping_freezes_params(model):
+    """With patience=1 and a validation set the model can't improve on
+    (constant zeros after convergence), later epochs must be no-ops."""
+    tx = optax.adam(1e-2)
+    train_all = make_local_train_all(model, tx, epochs=6, patience=1,
+                                     fedprox=False, mu=0.0, donate=False)
+    states = _mk_states(model, n=1)
+    rng = np.random.default_rng(6)
+    xb = jnp.asarray(rng.normal(size=(1, 3, 8, DIM)).astype(np.float32))
+    mb = jnp.ones((1, 3, 8))
+    # validation loss will plateau quickly on random data with tiny lr
+    _, _, _, _, tracking = train_all(states.params, states.opt_state,
+                                     states.prev_global, jnp.ones(1),
+                                     xb, mb, xb, mb)
+    track = np.asarray(tracking)[0]  # [E, 3]
+    active = track[:, 2]
+    # once inactive, stays inactive
+    first_inactive = np.argmin(active) if np.any(active == 0) else len(active)
+    assert np.all(active[first_inactive:] == 0)
+
+
+def test_fedprox_prox_term_changes_training(model):
+    tx = optax.adam(1e-2)
+    states = _mk_states(model, n=1)
+    rng = np.random.default_rng(7)
+    xb = jnp.asarray(rng.normal(size=(1, 3, 8, DIM)).astype(np.float32))
+    mb = jnp.ones((1, 3, 8))
+    kw = dict(epochs=3, patience=3, donate=False)
+    p1, *_ = make_local_train_all(model, tx, fedprox=False, mu=0.0, **kw)(
+        states.params, states.opt_state, states.prev_global, jnp.ones(1),
+        xb, mb, xb, mb)
+    p2, *_ = make_local_train_all(model, tx, fedprox=True, mu=10.0, **kw)(
+        states.params, states.opt_state, states.prev_global, jnp.ones(1),
+        xb, mb, xb, mb)
+    l1 = np.asarray(jax.tree.leaves(p1)[0])
+    l2 = np.asarray(jax.tree.leaves(p2)[0])
+    assert not np.allclose(l1, l2)
+    # strong prox pulls params toward prev_global (the init)
+    init = np.asarray(jax.tree.leaves(states.prev_global)[0])
+    assert np.linalg.norm(l2 - init) < np.linalg.norm(l1 - init)
+
+
+# --------------------------- integration ----------------------------- #
+
+@pytest.mark.parametrize("model_type,update_type",
+                         [("hybrid", "mse_avg"), ("autoencoder", "avg"),
+                          ("hybrid", "fedprox")])
+def test_full_round_integration(model_type, update_type):
+    cfg = ExperimentConfig(dim_features=DIM, network_size=4, epochs=2,
+                           batch_size=8)
+    clients = synthetic_clients(n_clients=4, dim=DIM, n_normal=120,
+                                n_abnormal=60)
+    rngs = ExperimentRngs(run=0)
+    dev_x = build_dev_dataset(clients, rngs.data_rng)
+    data = stack_clients(clients, dev_x, cfg.batch_size)
+    m = make_model(model_type, DIM, shrink_lambda=cfg.shrink_lambda)
+    eng = RoundEngine(m, cfg, data, n_real=4, rngs=rngs,
+                      model_type=model_type, update_type=update_type)
+    for r in range(2):
+        res = eng.run_round(r)
+    assert res.client_metrics.shape == (4,)
+    assert np.all(res.client_metrics > 0.5)  # anomalies are separable
+    assert res.aggregator in res.selected
+    assert eng.host.aggregation_count.sum() == 2
+
+
+def test_round_with_padded_clients_matches_unpadded():
+    """Padding the client axis must not change real clients' results."""
+    cfg = ExperimentConfig(dim_features=DIM, network_size=4, epochs=2,
+                           batch_size=8,
+                           compat=CompatConfig(vote_tie_break=False))
+    clients = synthetic_clients(n_clients=4, dim=DIM, n_normal=120,
+                                n_abnormal=60)
+    res = {}
+    for pad in (4, 8):
+        rngs = ExperimentRngs(run=0)
+        dev_x = build_dev_dataset(clients, rngs.data_rng)
+        data = stack_clients(clients, dev_x, cfg.batch_size, pad_clients_to=pad)
+        m = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+        eng = RoundEngine(m, cfg, data, n_real=4, rngs=ExperimentRngs(run=0),
+                          model_type="hybrid", update_type="mse_avg")
+        r = eng.run_round(0, selected=[0, 2])
+        res[pad] = r
+    np.testing.assert_allclose(res[4].client_metrics, res[8].client_metrics,
+                               atol=2e-3)
+    assert res[4].aggregator == res[8].aggregator
