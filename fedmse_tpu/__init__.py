@@ -16,8 +16,11 @@ Package layout:
   ops           loss math, masked metrics (AUC/F1), stats
   federation    local training engine, voting, aggregation, verification,
                 the round engine
+  parallel      device mesh, client-axis sharding, shard_map collectives
   evaluation    per-client AUC / classification / latency evaluator
-  utils         seeding, logging, similarity scores
+  checkpointing reference-layout results artifacts + Orbax resume
+  visualization results plots, latent t-SNE, LatentData writer
+  utils         seeding, logging, profiling, similarity scores
 """
 
 __version__ = "0.1.0"

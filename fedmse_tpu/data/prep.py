@@ -1,0 +1,132 @@
+"""Offline federated shard creation — the reference's data-prep capability
+(Notebook/N-BaIoT/Data-Examination.ipynb, SURVEY.md §2 #9 / §3.5) as a
+scriptable tool instead of a notebook.
+
+The reference samples each source device's benign traffic, holds out a
+'new device' test_normal share, and shards normal/abnormal/test_normal across
+K clients with FedArtML's SplitAsFederatedData — IID, or label-skewed non-IID
+where the 'label' is the device of origin. Reproduced here without fedartml:
+
+  * IID: a uniform random partition of the pooled rows into K shards.
+  * non-IID: per-client Dirichlet(alpha) mixture over origin-device labels
+    (the standard label-skew construction; alpha -> inf recovers IID,
+    alpha -> 0 gives one-device-per-client extremes).
+
+Output layout is exactly what the data layer consumes (and what the reference
+notebook writes, Data-Examination.ipynb cells 26-38):
+  <out_dir>/Client-k/{normal,abnormal,test_normal}/data.csv
+
+CLI:
+  python -m fedmse_tpu.data.prep --source <dir-with-Client-k-shards> \
+      --n-clients 50 --mode noniid --alpha 0.5 --out Data/nbaiot-50
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+from fedmse_tpu.data.loader import load_data
+from fedmse_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+SPLITS = ("normal", "abnormal", "test_normal")
+
+
+def pool_source_shards(source_dir: str) -> Dict[str, Tuple[pd.DataFrame, np.ndarray]]:
+    """Read existing Client-k dirs back into pooled frames; rows keep their
+    source-client index as the origin 'label' used for non-IID skew."""
+    clients = sorted(
+        (d for d in os.listdir(source_dir) if d.startswith("Client-")),
+        key=lambda s: int(s.split("-")[1]))
+    pooled = {}
+    for split in SPLITS:
+        frames, origins = [], []
+        for i, c in enumerate(clients):
+            path = os.path.join(source_dir, c, split)
+            if not os.path.isdir(path):
+                continue
+            df = load_data(path)
+            frames.append(df)
+            origins.append(np.full(len(df), i))
+        pooled[split] = (pd.concat(frames, ignore_index=True),
+                        np.concatenate(origins))
+    return pooled
+
+
+def dirichlet_partition(origins: np.ndarray, n_clients: int, alpha: float,
+                        rng: np.random.Generator) -> List[np.ndarray]:
+    """Label-skew partition: for each origin label, split its row indices
+    across clients by Dirichlet(alpha) proportions."""
+    shards: List[List[np.ndarray]] = [[] for _ in range(n_clients)]
+    for label in np.unique(origins):
+        idx = np.flatnonzero(origins == label)
+        rng.shuffle(idx)
+        props = rng.dirichlet(np.full(n_clients, alpha))
+        cuts = (np.cumsum(props)[:-1] * len(idx)).astype(int)
+        for k, part in enumerate(np.split(idx, cuts)):
+            shards[k].append(part)
+    return [np.concatenate(s) if s else np.empty(0, dtype=int) for s in shards]
+
+
+def iid_partition(n_rows: int, n_clients: int,
+                  rng: np.random.Generator) -> List[np.ndarray]:
+    idx = rng.permutation(n_rows)
+    return list(np.array_split(idx, n_clients))
+
+
+def create_federated_shards(
+    source_dir: str,
+    out_dir: str,
+    n_clients: int,
+    mode: str = "iid",
+    alpha: float = 0.5,
+    seed: int = 42,
+    sample_frac: float = 1.0,
+) -> None:
+    """Shard pooled source traffic into n_clients federated clients."""
+    rng = np.random.default_rng(seed)
+    pooled = pool_source_shards(source_dir)
+    for split in SPLITS:
+        df, origins = pooled[split]
+        if sample_frac < 1.0:  # the notebook samples 5% of benign traffic
+            keep = rng.random(len(df)) < sample_frac
+            df, origins = df[keep].reset_index(drop=True), origins[keep]
+        if mode == "iid":
+            parts = iid_partition(len(df), n_clients, rng)
+        elif mode == "noniid":
+            parts = dirichlet_partition(origins, n_clients, alpha, rng)
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+        for k, idx in enumerate(parts, start=1):
+            d = os.path.join(out_dir, f"Client-{k}", split)
+            os.makedirs(d, exist_ok=True)
+            df.iloc[idx].to_csv(os.path.join(d, "data.csv"),
+                                index=False, header=False)
+        sizes = [len(p) for p in parts]
+        logger.info("%s: %d rows -> %d clients (min %d / max %d)",
+                    split, len(df), n_clients, min(sizes), max(sizes))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--source", required=True,
+                   help="dir containing Client-k/{normal,abnormal,test_normal}")
+    p.add_argument("--out", required=True)
+    p.add_argument("--n-clients", type=int, required=True)
+    p.add_argument("--mode", choices=("iid", "noniid"), default="iid")
+    p.add_argument("--alpha", type=float, default=0.5)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--sample-frac", type=float, default=1.0)
+    args = p.parse_args(argv)
+    create_federated_shards(args.source, args.out, args.n_clients, args.mode,
+                            args.alpha, args.seed, args.sample_frac)
+
+
+if __name__ == "__main__":
+    main()

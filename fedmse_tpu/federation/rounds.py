@@ -64,7 +64,7 @@ class RoundEngine:
 
     def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
                  n_real: int, rngs: ExperimentRngs, model_type: str,
-                 update_type: str):
+                 update_type: str, profile: bool = False):
         self.model = model
         self.cfg = cfg
         self.data = data
@@ -91,6 +91,8 @@ class RoundEngine:
             model, self.tx, rngs.next_jax(), self.n_pad)
         self.host = HostState.create(n_real)
         self._ver_x, self._ver_m = self._verification_tensors()
+        from fedmse_tpu.utils.profiling import PhaseTimer
+        self.timer = PhaseTimer(enabled=profile)
 
     # ------------------------------------------------------------------ #
 
@@ -128,9 +130,12 @@ class RoundEngine:
         sel_mask = jnp.asarray(sel_mask_np)
 
         # ---- local training (all selected clients in parallel) ----
-        params, opt_state, best_params, min_valid, tracking = self.train_all(
-            self.states.params, self.states.opt_state, self.states.prev_global,
-            sel_mask, data.train_xb, data.train_mb, data.valid_xb, data.valid_mb)
+        with self.timer.phase("train"):
+            params, opt_state, best_params, min_valid, tracking = self.train_all(
+                self.states.params, self.states.opt_state, self.states.prev_global,
+                sel_mask, data.train_xb, data.train_mb, data.valid_xb, data.valid_mb)
+            if self.timer.enabled:
+                jax.block_until_ready(params)
         self.states = dataclasses.replace(self.states, params=params,
                                           opt_state=opt_state)
         self.last_best_params = best_params  # checkpointed, never restored
@@ -144,27 +149,30 @@ class RoundEngine:
             return np.asarray(jax.device_get(self.scores_fn(
                 self.states.params, vote_x, vote_m, self.rngs.next_jax())))
 
-        aggregator, scores = elect_aggregator(
-            selected, fresh_scores, self.host.aggregation_count,
-            self.host.votes_received, cfg.max_aggregation_threshold)
+        with self.timer.phase("vote"):
+            aggregator, scores = elect_aggregator(
+                selected, fresh_scores, self.host.aggregation_count,
+                self.host.votes_received, cfg.max_aggregation_threshold)
 
         verification_rows: List[Dict] = []
         agg_weights = None
         if aggregator is not None and \
                 self.host.aggregation_count[aggregator] < cfg.max_aggregation_threshold:
-            agg_params, weights = self.aggregate(self.states.params, sel_mask,
-                                                 data.dev_x)
-            agg_weights = np.asarray(jax.device_get(weights))
+            with self.timer.phase("aggregate"):
+                agg_params, weights = self.aggregate(self.states.params,
+                                                     sel_mask, data.dev_x)
+                agg_weights = np.asarray(jax.device_get(weights))
             self.host.aggregation_count[aggregator] += 1
             self.host.rounds_aggregated.append((round_index, aggregator))
 
             agg_onehot = np.zeros(self.n_pad, dtype=np.float32)
             agg_onehot[aggregator] = 1.0
-            outcome = self.verify(self.states, agg_params, self._ver_x,
-                                  self._ver_m, jnp.asarray(agg_onehot),
-                                  data.client_mask)
-            self.states = outcome.states
-            rejected = np.asarray(jax.device_get(self.states.rejected))
+            with self.timer.phase("verify"):
+                outcome = self.verify(self.states, agg_params, self._ver_x,
+                                      self._ver_m, jnp.asarray(agg_onehot),
+                                      data.client_mask)
+                self.states = outcome.states
+                rejected = np.asarray(jax.device_get(self.states.rejected))
             for i in range(self.n_real):
                 if i != aggregator:
                     # reference rows (src/main.py:304-312): is_verified is the
@@ -181,9 +189,10 @@ class RoundEngine:
             logger.warning("No aggregator selected for round %d", round_index)
 
         # ---- evaluation of every client (src/main.py:333-339) ----
-        metrics = np.asarray(jax.device_get(self.evaluate_all(
-            self.states.params, data.test_x, data.test_m, data.test_y,
-            data.train_xb, data.train_mb)))[: self.n_real]
+        with self.timer.phase("evaluate"):
+            metrics = np.asarray(jax.device_get(self.evaluate_all(
+                self.states.params, data.test_x, data.test_m, data.test_y,
+                data.train_xb, data.train_mb)))[: self.n_real]
 
         return RoundResult(
             round_index=round_index,

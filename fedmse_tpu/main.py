@@ -145,6 +145,22 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
         if last_result is not None:
             save_training_tracking(writer, run, model_type, update_type,
                                    device_names, last_result.tracking)
+        if model_type == "hybrid":
+            # LatentData pickles for the latent t-SNE notebook parity
+            # (the reference reads these but never writes them — SURVEY §2 #10)
+            from fedmse_tpu.visualization import save_latent_data
+            latents = jax.device_get(jax.jit(jax.vmap(
+                lambda p, x: model.apply({"params": p}, x)[0]))(
+                    engine.states.params, engine.data.test_x))
+            mask = np.asarray(jax.device_get(engine.data.test_m)) > 0
+            labels = np.asarray(jax.device_get(engine.data.test_y))
+            lat = np.concatenate([latents[i][mask[i]] for i in range(n_real)])
+            lab = np.concatenate([labels[i][mask[i]] for i in range(n_real)])
+            save_latent_data(
+                os.path.join(cfg.checkpoint_dir, "LatentData",
+                             str(cfg.network_size), cfg.experiment_name,
+                             f"Run_{run}"),
+                update_type, lat, lab)
 
     return {
         "final_metrics": final_metrics,

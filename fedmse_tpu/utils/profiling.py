@@ -1,0 +1,59 @@
+"""Tracing/profiling — a first-class subsystem the reference lacks
+(SURVEY.md §5.1: its only latency tool is a wall-clock eval mode,
+evaluator.py:99-108).
+
+  * `trace(log_dir)`  — context manager around `jax.profiler.trace`; view the
+                        result in TensorBoard/XProf (device timelines, HLO).
+  * `PhaseTimer`      — per-phase wall-clock accounting for the round loop
+                        (train / vote / aggregate / verify / evaluate). When
+                        enabled it synchronizes (`block_until_ready`) at phase
+                        boundaries so the numbers attribute device time
+                        honestly; disabled it is a no-op so the async dispatch
+                        pipeline stays intact.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace for everything inside the block."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class PhaseTimer:
+    """Accumulates seconds per named phase; `timings()` returns the dict."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._acc: Dict[str, float] = defaultdict(float)
+
+    @contextlib.contextmanager
+    def phase(self, name: str, sync_on=None) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            if sync_on is not None:
+                jax.block_until_ready(sync_on)
+            self._acc[name] += time.time() - t0
+
+    def timings(self) -> Dict[str, float]:
+        return dict(self._acc)
+
+    def reset(self) -> None:
+        self._acc.clear()

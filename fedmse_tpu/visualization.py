@@ -1,0 +1,152 @@
+"""Results + latent-space visualization — the reference's Visualization
+notebooks (src/Visualization/results_visualization.ipynb,
+latent_visualization.ipynb; SURVEY.md §2 #11) as a scriptable module.
+
+  * `plot_results`       — per-client metric bars + per-round mean curves for
+                           every (model_type, update_type) found in a results
+                           directory (the reference hard-codes its tables;
+                           we read the per-round JSON-lines artifacts).
+  * `save_latent_data`   — the missing writer for the reference's
+                           `Checkpoint/LatentData/.../latent_hybrid_*.pkl`
+                           (its latent_visualization.ipynb reads these but no
+                           live code writes them — SURVEY.md §2 #10).
+  * `plot_latent_tsne`   — 2-D/3-D t-SNE scatter of test latents colored by
+                           label, one panel per aggregation algorithm.
+
+CLI: python -m fedmse_tpu.visualization --results-dir <...> --out plots/
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import matplotlib
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+from fedmse_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def load_round_results(results_dir: str) -> Dict[str, List[dict]]:
+    """Read every `*_results.json` (JSON-lines, reference src/main.py:347-355)
+    under a Run_*/metric directory into {combo_name: [round rows]}."""
+    out = {}
+    for path in sorted(glob.glob(os.path.join(results_dir, "**", "*_results.json"),
+                                 recursive=True)):
+        rows = [json.loads(line) for line in open(path) if line.strip()]
+        if rows and "client_metrics" in rows[0]:
+            out[os.path.basename(path).replace("_results.json", "")] = rows
+    return out
+
+
+def plot_results(results_dir: str, out_dir: str) -> List[str]:
+    """Per-client final metric bars + per-round mean curves per combination."""
+    os.makedirs(out_dir, exist_ok=True)
+    combos = load_round_results(results_dir)
+    if not combos:
+        logger.warning("no results found under %s", results_dir)
+        return []
+    written = []
+
+    # final per-client bars (analog of the ipynb per-gateway AUC tables)
+    fig, ax = plt.subplots(figsize=(10, 4.5))
+    width = 0.8 / max(len(combos), 1)
+    for i, (name, rows) in enumerate(combos.items()):
+        final = np.asarray(rows[-1]["client_metrics"])
+        x = np.arange(len(final)) + i * width
+        ax.bar(x, final * 100, width=width, label=name)
+    ax.set_xlabel("gateway")
+    ax.set_ylabel("final metric (%)")
+    ax.set_ylim(80, 100.5)
+    ax.legend(fontsize=7)
+    ax.set_title("Per-gateway final metric by method")
+    p = os.path.join(out_dir, "per_gateway_metrics.png")
+    fig.tight_layout(); fig.savefig(p, dpi=120); plt.close(fig)
+    written.append(p)
+
+    # per-round mean curves
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for name, rows in combos.items():
+        means = [float(np.mean(r["client_metrics"])) for r in rows]
+        ax.plot(np.arange(1, len(means) + 1), means, marker="o", label=name)
+    ax.set_xlabel("round"); ax.set_ylabel("mean client metric")
+    ax.legend(fontsize=7); ax.set_title("Convergence per aggregation method")
+    p = os.path.join(out_dir, "round_curves.png")
+    fig.tight_layout(); fig.savefig(p, dpi=120); plt.close(fig)
+    written.append(p)
+    return written
+
+
+def save_latent_data(latent_dir: str, update_type: str,
+                     test_latent: np.ndarray, labels: np.ndarray) -> str:
+    """Writer for the reference's LatentData pickles
+    (`latent_hybrid_{update}.pkl` holding (latents, labels))."""
+    os.makedirs(latent_dir, exist_ok=True)
+    path = os.path.join(latent_dir, f"latent_hybrid_{update_type}.pkl")
+    with open(path, "wb") as f:
+        pickle.dump((np.asarray(test_latent), np.asarray(labels)), f)
+    return path
+
+
+def plot_latent_tsne(latent_files: Sequence[str], out_path: str,
+                     dims: int = 2, max_points: int = 2000,
+                     seed: int = 0) -> str:
+    """t-SNE panels of test latents, one per aggregation algorithm
+    (latent_visualization.ipynb parity)."""
+    from sklearn.manifold import TSNE
+
+    n = len(latent_files)
+    fig = plt.figure(figsize=(5 * n, 4.5))
+    rng = np.random.default_rng(seed)
+    for i, path in enumerate(latent_files):
+        with open(path, "rb") as f:
+            latents, labels = pickle.load(f)
+        latents, labels = np.asarray(latents), np.asarray(labels)
+        if len(latents) > max_points:
+            idx = rng.choice(len(latents), max_points, replace=False)
+            latents, labels = latents[idx], labels[idx]
+        emb = TSNE(n_components=dims, random_state=seed,
+                   init="pca").fit_transform(latents)
+        ax = fig.add_subplot(1, n, i + 1,
+                             projection="3d" if dims == 3 else None)
+        for cls, color, name in ((0, "tab:blue", "normal"),
+                                 (1, "tab:red", "abnormal")):
+            m = labels == cls
+            ax.scatter(*[emb[m, d] for d in range(dims)], s=4, alpha=0.5,
+                       c=color, label=name)
+        ax.set_title(os.path.basename(path).replace(".pkl", ""))
+        ax.legend(fontsize=7)
+    fig.tight_layout(); fig.savefig(out_path, dpi=120); plt.close(fig)
+    return out_path
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--results-dir", required=True)
+    p.add_argument("--out", default="plots")
+    p.add_argument("--latent-glob", default=None,
+                   help="glob of latent_hybrid_*.pkl files for t-SNE panels")
+    p.add_argument("--tsne-dims", type=int, default=2, choices=(2, 3))
+    args = p.parse_args(argv)
+    written = plot_results(args.results_dir, args.out)
+    if args.latent_glob:
+        files = sorted(glob.glob(args.latent_glob))
+        if files:
+            written.append(plot_latent_tsne(
+                files, os.path.join(args.out, "latent_tsne.png"),
+                dims=args.tsne_dims))
+    for w in written:
+        logger.info("wrote %s", w)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,157 @@
+"""Auxiliary subsystems: data-prep sharding, visualization, profiling,
+similarity utils (SURVEY.md §2 #7, #9, #11; §5.1)."""
+
+import glob
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from tests.test_data import _write_client_csvs
+
+
+# ----------------------------- data prep ----------------------------- #
+
+def test_prep_iid_shards(tmp_path):
+    from fedmse_tpu.data.prep import create_federated_shards
+    from fedmse_tpu.data.loader import load_data
+    src, out = str(tmp_path / "src"), str(tmp_path / "out")
+    _write_client_csvs(src, 3, dim=5, n_normal=60, n_abnormal=21)
+    create_federated_shards(src, out, n_clients=6, mode="iid", seed=0)
+    dirs = sorted(os.listdir(out))
+    assert len(dirs) == 6
+    total = sum(len(load_data(os.path.join(out, d, "normal"))) for d in dirs)
+    assert total == 3 * 60  # partition, no loss/duplication
+    sizes = [len(load_data(os.path.join(out, d, "normal"))) for d in dirs]
+    assert max(sizes) - min(sizes) <= 1  # IID = near-equal shards
+
+
+def test_prep_noniid_shards_are_skewed(tmp_path):
+    from fedmse_tpu.data.prep import create_federated_shards
+    from fedmse_tpu.data.loader import load_data
+    src, out = str(tmp_path / "src"), str(tmp_path / "out")
+    _write_client_csvs(src, 4, dim=5, n_normal=100, n_abnormal=20)
+    create_federated_shards(src, out, n_clients=4, mode="noniid",
+                            alpha=0.1, seed=0)
+    sizes = [len(load_data(os.path.join(out, f"Client-{k}", "normal")))
+             for k in range(1, 5)]
+    assert sum(sizes) == 400
+    # alpha=0.1 must produce strong quantity skew
+    assert max(sizes) - min(sizes) > 30
+
+
+def test_prep_roundtrips_into_pipeline(tmp_path):
+    """Generated shards must feed straight into prepare_clients."""
+    from fedmse_tpu.config import DatasetConfig, ExperimentConfig
+    from fedmse_tpu.data import prepare_clients
+    from fedmse_tpu.data.prep import create_federated_shards
+    src, out = str(tmp_path / "src"), str(tmp_path / "out")
+    _write_client_csvs(src, 2, dim=5, n_normal=80, n_abnormal=30)
+    create_federated_shards(src, out, n_clients=3, mode="iid", seed=1)
+    ds = DatasetConfig.for_client_dirs(out, 3)
+    cfg = ExperimentConfig(dim_features=5, network_size=3)
+    clients = prepare_clients(ds, cfg, np.random.default_rng(0))
+    assert len(clients) == 3
+    assert all(c.train_x.shape[1] == 5 for c in clients)
+
+
+# --------------------------- visualization --------------------------- #
+
+def test_plot_results_and_latents(tmp_path):
+    import json
+    from fedmse_tpu.visualization import (plot_results, plot_latent_tsne,
+                                          save_latent_data)
+    rdir = tmp_path / "Run_0" / "AUC"
+    rdir.mkdir(parents=True)
+    with open(rdir / "FL-IoT_0.5_hybrid_avg_results.json", "w") as f:
+        for rnd in range(3):
+            json.dump({"round": rnd + 1,
+                       "client_metrics": list(np.random.rand(4) * 0.1 + 0.9),
+                       "update_type": "avg", "model_type": "hybrid",
+                       "global_loss": 0.9}, f)
+            f.write("\n")
+    out = plot_results(str(tmp_path), str(tmp_path / "plots"))
+    assert len(out) == 2 and all(os.path.getsize(p) > 0 for p in out)
+
+    rng = np.random.default_rng(0)
+    lat = np.concatenate([rng.normal(0, 1, (60, 7)), rng.normal(4, 1, (40, 7))])
+    lab = np.concatenate([np.zeros(60), np.ones(40)])
+    p = save_latent_data(str(tmp_path / "LatentData"), "avg", lat, lab)
+    with open(p, "rb") as f:
+        l2, lab2 = pickle.load(f)
+    assert l2.shape == (100, 7)
+    png = plot_latent_tsne([p], str(tmp_path / "tsne.png"), max_points=100)
+    assert os.path.getsize(png) > 0
+
+
+# ----------------------------- profiling ----------------------------- #
+
+def test_phase_timer_accumulates():
+    import time
+    from fedmse_tpu.utils.profiling import PhaseTimer
+    t = PhaseTimer(enabled=True)
+    with t.phase("a"):
+        time.sleep(0.01)
+    with t.phase("a"):
+        time.sleep(0.01)
+    with t.phase("b"):
+        pass
+    assert t.timings()["a"] >= 0.02
+    assert set(t.timings()) == {"a", "b"}
+    t2 = PhaseTimer(enabled=False)
+    with t2.phase("x"):
+        pass
+    assert t2.timings() == {}
+
+
+def test_round_engine_phase_timings():
+    from fedmse_tpu.config import ExperimentConfig
+    from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+    cfg = ExperimentConfig(dim_features=8, network_size=3, epochs=1, batch_size=8)
+    clients = synthetic_clients(n_clients=3, dim=8, n_normal=60, n_abnormal=20)
+    rngs = ExperimentRngs(run=0)
+    data = stack_clients(clients, build_dev_dataset(clients, rngs.data_rng), 8)
+    eng = RoundEngine(make_model("hybrid", 8, shrink_lambda=1.0), cfg, data,
+                      n_real=3, rngs=rngs, model_type="hybrid",
+                      update_type="avg", profile=True)
+    eng.run_round(0)
+    t = eng.timer.timings()
+    assert {"train", "vote", "evaluate"} <= set(t)
+    assert all(v >= 0 for v in t.values())
+
+
+# ---------------------------- similarity ----------------------------- #
+
+def test_similarity_score_matches_reference_formula(rng):
+    """similarity_score = JS(exp(dev KDE scores), exp(self KDE scores))
+    (reference src/Utils/utils.py:10-24)."""
+    from sklearn.neighbors import KernelDensity
+    from scipy.spatial.distance import jensenshannon
+    from fedmse_tpu.utils.similarity import similarity_score
+    a = rng.normal(size=(80, 3))
+    b = rng.normal(0.5, 1.2, size=(80, 3))
+    dev_scores = KernelDensity(kernel="gaussian",
+                               bandwidth="scott").fit(a).score_samples(a)
+    want = jensenshannon(np.exp(dev_scores), np.exp(
+        KernelDensity(kernel="gaussian", bandwidth="scott").fit(b)
+        .score_samples(b)))
+    got = similarity_score(dev_scores, b)
+    assert got == pytest.approx(float(want), rel=1e-6)
+
+
+def test_gaussian_kl_js(rng):
+    from fedmse_tpu.utils.similarity import js_divergence, kl_divergence
+    mean = np.zeros(3)
+    cov = np.eye(3)
+    assert kl_divergence(mean, cov, mean, cov) == pytest.approx(0.0, abs=1e-9)
+    assert js_divergence(mean, cov, mean, cov) == pytest.approx(0.0, abs=1e-9)
+    # KL to a wider gaussian is positive
+    assert kl_divergence(mean, cov, mean, 2 * cov) > 0
+    # JS is symmetric
+    m2 = np.ones(3)
+    assert js_divergence(mean, cov, m2, 2 * cov) == pytest.approx(
+        js_divergence(m2, 2 * cov, mean, cov), rel=1e-9)

@@ -1,0 +1,145 @@
+"""Data-layer tests: reference split/scale discipline, stacking masks,
+config round-trips (SURVEY.md §2 #4, #8; src/main.py:131-223)."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fedmse_tpu.config import DatasetConfig, ExperimentConfig
+from fedmse_tpu.data import (IoTDataProcessor, build_dev_dataset, load_data,
+                             prepare_clients, stack_clients, synthetic_clients)
+
+
+def _write_client_csvs(root, n_clients, dim=6, n_normal=50, n_abnormal=20,
+                       seed=0):
+    rng = np.random.default_rng(seed)
+    for k in range(1, n_clients + 1):
+        for split, n, shift in (("normal", n_normal, 0.0),
+                                ("abnormal", n_abnormal, 4.0),
+                                ("test_normal", 15, 0.0)):
+            d = os.path.join(root, f"Client-{k}", split)
+            os.makedirs(d, exist_ok=True)
+            data = rng.normal(shift, 1.0, size=(n, dim))
+            pd.DataFrame(data).to_csv(os.path.join(d, "data.csv"),
+                                      index=False, header=False)
+
+
+def test_load_data_concatenates_headerless_csvs(tmp_path):
+    d = tmp_path / "x"
+    d.mkdir()
+    pd.DataFrame(np.ones((3, 4))).to_csv(d / "a.csv", index=False, header=False)
+    pd.DataFrame(np.zeros((2, 4))).to_csv(d / "b.csv", index=False, header=False)
+    df = load_data(str(d))
+    assert df.shape == (5, 4)
+
+
+def test_standard_scaler_matches_sklearn(rng):
+    from sklearn.preprocessing import StandardScaler
+    x = rng.normal(2.0, 3.0, size=(40, 5))
+    proc = IoTDataProcessor("standard")
+    got, labels = proc.fit_transform(pd.DataFrame(x))
+    want = StandardScaler().fit_transform(x)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert labels.sum() == 0
+    _, ab = proc.transform(pd.DataFrame(x), type="abnormal")
+    assert ab.sum() == len(x)
+
+
+def test_minmax_scaler_matches_sklearn(rng):
+    from sklearn.preprocessing import MinMaxScaler
+    x = rng.normal(size=(30, 4))
+    proc = IoTDataProcessor("minmax")
+    got, _ = proc.fit_transform(pd.DataFrame(x))
+    want = MinMaxScaler((0, 1)).fit_transform(x)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_prepare_clients_split_discipline(tmp_path):
+    """40/10/40/10 normal split, scaler fit on train only, abnormal all-test,
+    new_device appends held-out normals (src/main.py:151-178)."""
+    _write_client_csvs(str(tmp_path), 2, n_normal=100, n_abnormal=30)
+    ds = DatasetConfig.for_client_dirs(str(tmp_path), 2)
+    cfg = ExperimentConfig(dim_features=6, network_size=2)
+    clients = prepare_clients(ds, cfg, np.random.default_rng(1234))
+    c = clients[0]
+    assert len(c.train_x) == 40
+    assert len(c.valid_x) == 10
+    assert len(c.dev_raw) == 40
+    # test = 10 normal + 15 new-device normal + 30 abnormal
+    assert len(c.test_x) == 55
+    assert c.test_y.sum() == 30
+    # scaler fit on train only -> train standardized exactly
+    np.testing.assert_allclose(c.train_x.mean(0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(c.train_x.std(0), 1.0, atol=1e-4)
+
+
+def test_prepare_clients_no_new_device(tmp_path):
+    _write_client_csvs(str(tmp_path), 1, n_normal=100, n_abnormal=30)
+    ds = DatasetConfig.for_client_dirs(str(tmp_path), 1)
+    cfg = ExperimentConfig(dim_features=6, network_size=1, new_device=False)
+    c = prepare_clients(ds, cfg, np.random.default_rng(1))[0]
+    assert len(c.test_x) == 40  # 10 normal + 30 abnormal
+
+
+def test_device_subsampling(tmp_path):
+    _write_client_csvs(str(tmp_path), 5)
+    ds = DatasetConfig.for_client_dirs(str(tmp_path), 5)
+    cfg = ExperimentConfig(dim_features=6, network_size=3)
+    clients = prepare_clients(ds, cfg, np.random.default_rng(1234))
+    assert len(clients) == 3
+
+
+def test_dev_dataset_equal_sampling(rng):
+    clients = synthetic_clients(n_clients=3, dim=5, n_normal=100, seed=1)
+    # unequal dev sizes
+    clients[1].dev_raw = clients[1].dev_raw.iloc[:17]
+    dev = build_dev_dataset(clients, rng)
+    assert dev.shape == (17 * 3, 5)
+    np.testing.assert_allclose(dev.mean(0), 0.0, atol=1e-5)  # fresh scaler
+
+
+def test_stacking_masks_and_batches():
+    clients = synthetic_clients(n_clients=2, dim=5, n_normal=60,
+                                n_abnormal=20, seed=2)
+    # make client 1 smaller
+    clients[1].train_x = clients[1].train_x[:13]
+    data = stack_clients(clients, np.zeros((8, 5), np.float32), batch_size=4,
+                         pad_clients_to=4)
+    assert data.train_xb.shape[0] == 4
+    nb = data.train_xb.shape[1]
+    assert nb == 6  # ceil(24/4) for client 0
+    m = np.asarray(data.train_mb)
+    assert m[0].sum() == 24 and m[1].sum() == 13
+    assert m[2].sum() == 0 and m[3].sum() == 0  # padding clients
+    assert np.asarray(data.client_mask).tolist() == [1, 1, 0, 0]
+    # row masks are prefix-shaped within the flattened batch order
+    flat = m[1].reshape(-1)
+    assert np.all(flat[:13] == 1) and np.all(flat[13:] == 0)
+
+
+def test_dataset_config_roundtrip(tmp_path):
+    ds = DatasetConfig.for_client_dirs("/data/x", 3)
+    p = tmp_path / "c.json"
+    with open(p, "w") as f:
+        json.dump(ds.to_json(), f)
+    ds2 = DatasetConfig.from_json(str(p))
+    assert ds2 == ds
+    assert ds.devices_list[2].normal_data_path == "Client-3/normal"
+
+
+def test_reference_config_schema_loads():
+    ref = "/root/reference/src/Configuration/scen2-nba-iot-10clients.json"
+    if not os.path.exists(ref):
+        pytest.skip("reference configs not mounted")
+    ds = DatasetConfig.from_json(ref, data_root="/root/reference/Data/N-BaIoT")
+    assert len(ds.devices_list) == 10
+    assert ds.data_path.endswith("IID-10-Client_Data")
+
+
+def test_experiment_config_json_roundtrip():
+    cfg = ExperimentConfig(epochs=7, update_types=("avg",))
+    cfg2 = ExperimentConfig.from_json(json.loads(json.dumps(cfg.to_json())))
+    assert cfg2 == cfg
