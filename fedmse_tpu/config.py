@@ -187,6 +187,9 @@ class ExperimentConfig:
     mesh_shape: Optional[Tuple[int, ...]] = None  # None => all local devices
     client_axis_name: str = "clients"
     param_dtype: str = "float32"
+    # fused single-kernel forward for evaluation: 'off' | 'auto' | 'pallas' |
+    # 'xla' ('auto' = pallas on TPU, XLA-fused elsewhere; ops/pallas_ae.py)
+    fused_eval: str = "off"
 
     compat: CompatConfig = dataclasses.field(default_factory=CompatConfig)
 

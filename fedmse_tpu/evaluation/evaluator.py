@@ -34,11 +34,26 @@ def _flatten_batches(xb: jax.Array, mb: jax.Array) -> Tuple[jax.Array, jax.Array
     return xb.reshape(-1, xb.shape[-1]), mb.reshape(-1)
 
 
-def make_evaluate_all(model, model_type: str, metric: str = "AUC") -> Callable:
+def make_evaluate_all(model, model_type: str, metric: str = "AUC",
+                      fused: str = "off") -> Callable:
     """Build fn(stacked_params, test_x, test_m, test_y, train_xb, train_mb)
-    -> metrics [N] (AUC or F1, reference returns f1 for 'classification')."""
+    -> metrics [N] (AUC or F1, reference returns f1 for 'classification').
+
+    fused: 'off' uses the flax apply; 'auto'/'pallas'/'xla' route the forward
+    through the single-kernel fused path (ops/pallas_ae.py) — same math, one
+    VMEM-resident pass per row block on TPU."""
 
     def anomaly_scores_one(params, test_x, train_xf, train_mf):
+        if fused != "off":
+            from fedmse_tpu.ops.pallas_ae import fused_forward_stats
+            test_latent, test_mse, _ = fused_forward_stats(
+                params, test_x, latent_dim=model.latent_dim, mode=fused)
+            if model_type == "autoencoder":
+                return test_mse
+            train_latent, _, _ = fused_forward_stats(
+                params, train_xf, latent_dim=model.latent_dim, mode=fused)
+            cen = fit_centroid(train_latent, train_mf)
+            return cen.get_density(test_latent)
         test_latent, recon = model.apply({"params": params}, test_x)
         if model_type == "autoencoder":
             return per_sample_mse(test_x, recon)

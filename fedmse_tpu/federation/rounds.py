@@ -85,7 +85,8 @@ class RoundEngine:
         self.aggregate = make_aggregate_fn(model, update_type)
         self.verify = make_verify_fn(model, cfg.verification_threshold,
                                      cfg.performance_threshold)
-        self.evaluate_all = make_evaluate_all(model, model_type, cfg.metric)
+        self.evaluate_all = make_evaluate_all(model, model_type, cfg.metric,
+                                              fused=cfg.fused_eval)
 
         self.states: ClientStates = init_client_states(
             model, self.tx, rngs.next_jax(), self.n_pad)
