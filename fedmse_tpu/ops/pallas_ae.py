@@ -43,10 +43,6 @@ LANE = 128
 BLOCK_ROWS = 512
 
 
-def _cdiv(a: int, b: int) -> int:
-    return -(-a // b)
-
-
 def _pad2(w: jax.Array, rows: int = LANE, cols: int = LANE) -> jax.Array:
     return jnp.zeros((rows, cols), w.dtype).at[: w.shape[0], : w.shape[1]].set(w)
 
@@ -69,10 +65,8 @@ def pack_params(params: Dict[str, Any]) -> Tuple[jax.Array, ...]:
     )
 
 
-def _kernel(dims_ref, x_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+def _kernel(dim, latent_dim, x_ref, w1_ref, b1_ref, w2_ref, b2_ref,
             w3_ref, b3_ref, w4_ref, b4_ref, out_ref):
-    d = dims_ref[0]  # true feature dim
-    latent_dim = dims_ref[1]
     x = x_ref[:]
     h1 = jnp.maximum(
         jnp.dot(x, w1_ref[:], preferred_element_type=jnp.float32) + b1_ref[:],
@@ -84,7 +78,7 @@ def _kernel(dims_ref, x_ref, w1_ref, b1_ref, w2_ref, b2_ref,
     recon = jnp.dot(h2, w4_ref[:], preferred_element_type=jnp.float32) + b4_ref[:]
 
     err = jnp.square(x - recon)          # padded cols are 0 - 0
-    mse = jnp.sum(err, axis=1, keepdims=True) / d.astype(jnp.float32)
+    mse = jnp.sum(err, axis=1, keepdims=True) / dim
     znorm = jnp.sqrt(jnp.sum(jnp.square(z), axis=1, keepdims=True))
 
     col = jax.lax.broadcasted_iota(jnp.int32, z.shape, 1)
@@ -98,27 +92,25 @@ def _kernel(dims_ref, x_ref, w1_ref, b1_ref, w2_ref, b2_ref,
 def _fused_pallas(x_pad: jax.Array, mats: Tuple[jax.Array, ...],
                   dim: int, latent_dim: int, interpret: bool) -> jax.Array:
     rows = x_pad.shape[0]
-    grid = (_cdiv(rows, BLOCK_ROWS),)
-    dims = jnp.asarray([dim, latent_dim], jnp.int32)
+    grid = (pl.cdiv(rows, BLOCK_ROWS),)
     full = lambda: pl.BlockSpec((LANE, LANE), lambda i: (0, 0),
                                 memory_space=pltpu.VMEM)
     bias = lambda: pl.BlockSpec((1, LANE), lambda i: (0, 0),
                                 memory_space=pltpu.VMEM)
     specs = [
-        pl.BlockSpec(memory_space=pltpu.SMEM),             # dims
         pl.BlockSpec((BLOCK_ROWS, LANE), lambda i: (i, 0),
                      memory_space=pltpu.VMEM),              # x block
         full(), bias(), full(), bias(), full(), bias(), full(), bias(),
     ]
     return pl.pallas_call(
-        _kernel,
+        functools.partial(_kernel, float(dim), latent_dim),
         grid=grid,
         in_specs=specs,
         out_specs=pl.BlockSpec((BLOCK_ROWS, LANE), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rows, LANE), jnp.float32),
         interpret=interpret,
-    )(dims, x_pad, *mats)
+    )(x_pad, *mats)
 
 
 def _fused_xla(x_pad: jax.Array, mats: Tuple[jax.Array, ...],
@@ -146,7 +138,13 @@ def fused_forward_stats(params: Dict[str, Any], x: jax.Array,
     mode: 'pallas' | 'xla' | 'interpret' | 'auto' (pallas on TPU, else XLA).
     """
     rows, dim = x.shape
-    rows_pad = _cdiv(rows, BLOCK_ROWS) * BLOCK_ROWS
+    hidden = params["encoder"]["Dense_0"]["kernel"].shape[1]
+    if dim > LANE or latent_dim + 2 > LANE or hidden > LANE:
+        raise ValueError(
+            f"fused AE kernel packs features, hidden units and (latent, mse, "
+            f"znorm) into {LANE} lanes; got dim={dim}, hidden={hidden}, "
+            f"latent_dim={latent_dim}")
+    rows_pad = pl.cdiv(rows, BLOCK_ROWS) * BLOCK_ROWS
     x_pad = jnp.zeros((rows_pad, LANE), jnp.float32)
     x_pad = x_pad.at[:rows, :dim].set(x.astype(jnp.float32))
     mats = pack_params(params)
@@ -157,8 +155,11 @@ def fused_forward_stats(params: Dict[str, Any], x: jax.Array,
         packed = _fused_pallas(x_pad, mats, dim, latent_dim, False)
     elif mode == "interpret":
         packed = _fused_pallas(x_pad, mats, dim, latent_dim, True)
-    else:
+    elif mode == "xla":
         packed = _fused_xla(x_pad, mats, dim, latent_dim)
+    else:
+        raise ValueError(f"unknown fused-forward mode {mode!r}; expected "
+                         "'pallas' | 'xla' | 'interpret' | 'auto'")
 
     latent = packed[:rows, :latent_dim]
     mse = packed[:rows, latent_dim]

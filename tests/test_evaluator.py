@@ -56,14 +56,18 @@ def test_evaluate_all_matches_reference_math(model_type):
         assert got[i] == pytest.approx(want, abs=1e-5)
 
 
+@pytest.mark.parametrize("fused", ["xla", "interpret"])
 @pytest.mark.parametrize("model_type", ["autoencoder", "hybrid"])
-def test_fused_eval_matches_plain(model_type):
+def test_fused_eval_matches_plain(model_type, fused):
+    """'interpret' drives the actual pallas_call (in interpret mode) through
+    the vmapped, jitted evaluator — the same batching path the TPU kernel
+    takes with fused='pallas'."""
     model = make_model(model_type, DIM, shrink_lambda=1.0)
     params = init_stacked_params(model, jax.random.key(1), 3)
     data = _data(seed=1)
     plain = np.asarray(make_evaluate_all(model, model_type, fused="off")(params, *data))
-    fused = np.asarray(make_evaluate_all(model, model_type, fused="xla")(params, *data))
-    np.testing.assert_allclose(plain, fused, atol=1e-5)
+    got = np.asarray(make_evaluate_all(model, model_type, fused=fused)(params, *data))
+    np.testing.assert_allclose(plain, got, atol=1e-5)
 
 
 def test_single_evaluator_api_parity():
