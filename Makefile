@@ -1,0 +1,22 @@
+# Build the native IO runtime (native/fedmse_io.cpp -> a shared library the
+# data layer loads via ctypes, fedmse_tpu/data/fast_csv.py).
+
+CXX ?= g++
+# no -march=native: the .so must run on any deployment host (strtod parsing
+# is not vectorization-bound anyway)
+CXXFLAGS ?= -O3 -fPIC -Wall -Wextra
+LIB := fedmse_tpu/native/libfedmse_io.so
+
+.PHONY: native clean test
+
+native: $(LIB)
+
+$(LIB): native/fedmse_io.cpp
+	mkdir -p fedmse_tpu/native
+	$(CXX) $(CXXFLAGS) -shared -o $@ $<
+
+test:
+	python -m pytest tests/ -x -q
+
+clean:
+	rm -f $(LIB)

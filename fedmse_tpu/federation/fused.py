@@ -1,0 +1,182 @@
+"""Whole-round (and whole-schedule) fusion: one XLA dispatch per round, or
+one dispatch for a full multi-round schedule via `lax.scan`.
+
+The unfused `RoundEngine.run_round` issues ~5 device dispatches per round
+(train / vote / aggregate / verify / evaluate) with host syncs between them —
+exact reference control flow (src/main.py:267-365), but every sync crosses the
+host<->TPU link. This module compiles the ENTIRE round into a single jitted
+program by moving the election's data-dependent control flow into
+`lax.while_loop` / `lax.cond`:
+
+  * first-voter-wins election (src/main.py:284-288, client_trainer.py:249-285)
+    = `lax.while_loop` over the selected cohort in selection order; each voter
+    recomputes MSE scores with fresh tie-breaks (`jax.random.fold_in` per
+    voter), ranks the *other* selected clients ascending, and picks the first
+    under the aggregation quota — as a masked `argmin`;
+  * the aggregate + broadcast + verify block runs under `lax.cond` on whether
+    an aggregator was found (src/main.py:291-312);
+  * evaluation of every client closes the round (src/main.py:333-339).
+
+Host<->device traffic per round: the `[S]` selection indices in, one small
+result bundle out. `make_fused_rounds_scan` goes further and scans the round
+body over a precomputed `[R, S]` selection schedule, so an entire experiment
+(no early stopping) is ONE dispatch — the per-round cost drops to pure device
+compute.
+
+Semantics match the unfused path exactly except for RNG bookkeeping: the
+unfused election draws a fresh key from the host sequence per voter call,
+while here voter i uses `fold_in(round_key, i)`. The tie-break factor these
+keys feed is a ±0.01% jitter (client_trainer.py:243-245), so the two paths
+are statistically identical (verified by tests/test_fused.py with the
+tie-break disabled: bit-identical round outputs).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.federation.state import ClientStates
+
+
+class FusedRoundOut(NamedTuple):
+    """Per-round result bundle (everything the host logs, nothing more)."""
+
+    aggregator: jax.Array    # i32 scalar, -1 = no aggregator found
+    metrics: jax.Array       # [N] per-client eval metric
+    scores: jax.Array        # [N] winning voter's MSE scores (0 if no winner)
+    weights: jax.Array       # [N] aggregation weights (0 if no aggregation)
+    rejected: jax.Array      # [N] i32 consecutive rejected updates
+    min_valid: jax.Array     # [N] best local valid loss this round
+    tracking: jax.Array      # [N, E, 3] train/valid loss curves
+
+
+def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
+                     sel_mask: jax.Array, agg_count: jax.Array,
+                     vote_x: jax.Array, vote_m: jax.Array, rng: jax.Array,
+                     max_threshold: int) -> Tuple[jax.Array, jax.Array]:
+    """First-voter-wins election entirely on device.
+
+    Returns (aggregator i32 [-1 if none], winning voter's scores [N]).
+    """
+    n = sel_mask.shape[0]
+    n_sel = sel_indices.shape[0]
+    client_ids = jnp.arange(n)
+
+    def cond(carry):
+        i, agg, _ = carry
+        return (i < n_sel) & (agg < 0)
+
+    def body(carry):
+        i, agg, kept = carry
+        voter = sel_indices[i]
+        scores = scores_fn(params, vote_x, vote_m, jax.random.fold_in(rng, i))
+        cand = (sel_mask > 0) & (client_ids != voter) & \
+               (agg_count < max_threshold)
+        found = jnp.any(cand)
+        pick = jnp.argmin(jnp.where(cand, scores, jnp.inf)).astype(jnp.int32)
+        agg = jnp.where(found, pick, jnp.int32(-1))
+        kept = jnp.where(found, scores, kept)
+        return i + 1, agg, kept
+
+    _, aggregator, scores = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.int32(-1), jnp.zeros(n, jnp.float32)))
+    return aggregator, scores
+
+
+def make_round_body(train_all: Callable, scores_fn: Callable,
+                    aggregate: Callable, verify: Callable,
+                    evaluate_all: Callable, data, ver_x: jax.Array,
+                    ver_m: jax.Array, max_threshold: int) -> Callable:
+    """Build the traceable round body (jit-wrapped by make_fused_round,
+    scanned directly by make_fused_rounds_scan):
+
+    fn(states, sel_indices [S], sel_mask [N], agg_count [N], rng)
+      -> (states, agg_count, FusedRoundOut)
+    """
+    n_pad = data.num_clients_padded
+    client_ids = jnp.arange(n_pad)
+
+    def round_body(states: ClientStates, sel_indices, sel_mask, agg_count, rng):
+        # ---- local training of the selected cohort (src/main.py:276-279) ----
+        params, opt_state, best_params, min_valid, tracking = train_all(
+            states.params, states.opt_state, states.prev_global, sel_mask,
+            data.train_xb, data.train_mb, data.valid_xb, data.valid_mb)
+        states = ClientStates(
+            params=params, opt_state=opt_state, prev_global=states.prev_global,
+            hist_params=states.hist_params, hist_perf=states.hist_perf,
+            hist_seen=states.hist_seen, rejected=states.rejected)
+
+        # ---- election (src/main.py:282-288): voting data is the FIRST
+        # selected client's valid split (src/main.py:285) ----
+        vote_x = data.valid_x[sel_indices[0]]
+        vote_m = data.valid_m[sel_indices[0]]
+        aggregator, scores = _elect_on_device(
+            scores_fn, states.params, sel_indices, sel_mask, agg_count,
+            vote_x, vote_m, rng, max_threshold)
+
+        # ---- aggregate + broadcast + verify (src/main.py:291-312) ----
+        def do_aggregate(states):
+            agg_params, weights = aggregate(states.params, sel_mask, data.dev_x)
+            onehot = (client_ids == aggregator).astype(jnp.float32)
+            outcome = verify(states, agg_params, ver_x, ver_m, onehot,
+                             data.client_mask)
+            return outcome.states, weights
+
+        def no_aggregate(states):
+            return states, jnp.zeros(n_pad, jnp.float32)
+
+        states, weights = jax.lax.cond(aggregator >= 0, do_aggregate,
+                                       no_aggregate, states)
+        agg_count = agg_count + jnp.where(
+            (client_ids == aggregator) & (aggregator >= 0), 1, 0)
+
+        # ---- evaluation of every client (src/main.py:333-339) ----
+        metrics = evaluate_all(states.params, data.test_x, data.test_m,
+                               data.test_y, data.train_xb, data.train_mb)
+
+        out = FusedRoundOut(aggregator=aggregator, metrics=metrics,
+                            scores=scores, weights=weights,
+                            rejected=states.rejected, min_valid=min_valid,
+                            tracking=tracking)
+        return states, agg_count, out
+
+    return round_body
+
+
+def make_fused_round(*args) -> Callable:
+    """The single-dispatch round: jitted round body with the incoming states
+    buffers donated (they are consumed and replaced every round)."""
+    return jax.jit(make_round_body(*args), donate_argnums=(0,))
+
+
+def make_fused_rounds_scan(*args) -> Callable:
+    """Build the whole-schedule runner: `lax.scan` of the raw round body over
+    a precomputed selection schedule.
+
+    fn(states, sel_schedule [R, S], sel_masks [R, N], agg_count [N], rng)
+      -> (states, agg_count, FusedRoundOut stacked on a leading [R] axis)
+
+    One dispatch for R rounds; host early stopping cannot interleave (use
+    make_fused_round per-round when it must).
+    """
+    round_body = make_round_body(*args)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run_all(states: ClientStates, sel_schedule, sel_masks, agg_count, rng):
+        def step(carry, xs):
+            states, agg_count = carry
+            sel_indices, sel_mask, key = xs
+            states, agg_count, out = round_body(states, sel_indices, sel_mask,
+                                                agg_count, key)
+            return (states, agg_count), out
+
+        keys = jax.random.split(rng, sel_schedule.shape[0])
+        (states, agg_count), outs = jax.lax.scan(
+            step, (states, agg_count), (sel_schedule, sel_masks, keys))
+        return states, agg_count, outs
+
+    return run_all

@@ -64,7 +64,8 @@ class RoundEngine:
 
     def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
                  n_real: int, rngs: ExperimentRngs, model_type: str,
-                 update_type: str, profile: bool = False):
+                 update_type: str, profile: bool = False,
+                 fused: bool = False):
         self.model = model
         self.cfg = cfg
         self.data = data
@@ -95,6 +96,22 @@ class RoundEngine:
         from fedmse_tpu.utils.profiling import PhaseTimer
         self.timer = PhaseTimer(enabled=profile)
 
+        self.fused = fused
+        self._fused_round = None
+        self._fused_scan = None
+        if fused and profile:
+            logger.warning("profile=True forces the per-phase (unfused) round "
+                           "path; fused dispatch is not phase-attributable")
+
+    def _build_fused(self):
+        from fedmse_tpu.federation.fused import (make_fused_round,
+                                                 make_fused_rounds_scan)
+        args = (self.train_all, self.scores_fn, self.aggregate, self.verify,
+                self.evaluate_all, self.data, self._ver_x, self._ver_m,
+                self.cfg.max_aggregation_threshold)
+        self._fused_round = make_fused_round(*args)
+        self._fused_scan = make_fused_rounds_scan(*args)
+
     # ------------------------------------------------------------------ #
 
     def _verification_tensors(self):
@@ -121,8 +138,99 @@ class RoundEngine:
 
     # ------------------------------------------------------------------ #
 
+    # ---- fused fast path: ONE dispatch per round (federation/fused.py) ---- #
+
+    def _fused_result(self, round_index: int, selected: List[int],
+                      out) -> RoundResult:
+        """Host bookkeeping + RoundResult from a FusedRoundOut bundle."""
+        out = jax.device_get(out)
+        aggregator = int(out.aggregator)
+        rejected = np.asarray(out.rejected)
+        verification_rows: List[Dict] = []
+        if aggregator >= 0:
+            self.host.aggregation_count[aggregator] += 1
+            self.host.votes_received[aggregator] += 1
+            self.host.rounds_aggregated.append((round_index, aggregator))
+            for i in range(self.n_real):
+                if i != aggregator:
+                    verification_rows.append({
+                        "client_id": i,
+                        "rejected_updates": int(rejected[i]),
+                        "is_verified": bool(rejected[i] == 0),
+                    })
+                    if rejected[i] >= self.cfg.max_rejected_updates:
+                        logger.error("[Client %d] Too many rejected updates. "
+                                     "Possible attack detected.", i)
+        else:
+            logger.warning("No aggregator selected for round %d", round_index)
+        return RoundResult(
+            round_index=round_index,
+            selected=list(selected),
+            aggregator=None if aggregator < 0 else aggregator,
+            client_metrics=np.asarray(out.metrics)[: self.n_real],
+            verification_results=verification_rows,
+            mse_scores=(None if aggregator < 0
+                        else np.asarray(out.scores)[: self.n_real]),
+            agg_weights=(None if aggregator < 0 else np.asarray(out.weights)),
+            tracking=np.asarray(out.tracking)[: self.n_real],
+            min_valid=np.asarray(out.min_valid)[: self.n_real],
+        )
+
+    def _selection_arrays(self, selected: List[int]):
+        sel_mask = np.zeros(self.n_pad, dtype=np.float32)
+        sel_mask[selected] = 1.0
+        return (np.asarray(selected, dtype=np.int32), sel_mask)
+
+    def _agg_count_padded(self) -> jnp.ndarray:
+        return jnp.asarray(np.pad(
+            self.host.aggregation_count, (0, self.n_pad - self.n_real)
+        ).astype(np.int32))
+
+    def reset_federation(self) -> None:
+        """Restart the federation from construction state — fresh RNG streams,
+        client models, and host counters; compiled programs are reused. A
+        subsequent run is bit-identical to a newly built engine's."""
+        self.rngs = ExperimentRngs(run=self.rngs.run,
+                                   data_seed=self.rngs.data_seed,
+                                   run_seed_stride=self.rngs.run_seed_stride)
+        self.states = init_client_states(self.model, self.tx,
+                                         self.rngs.next_jax(), self.n_pad)
+        self.host = HostState.create(self.n_real)
+
+    def run_round_fused(self, round_index: int,
+                        selected: Optional[List[int]] = None) -> RoundResult:
+        if self._fused_round is None:
+            self._build_fused()
+        if selected is None:
+            selected = self.select_clients()
+        sel_indices, sel_mask = self._selection_arrays(selected)
+        self.states, _, out = self._fused_round(
+            self.states, jnp.asarray(sel_indices), jnp.asarray(sel_mask),
+            self._agg_count_padded(), self.rngs.next_jax())
+        return self._fused_result(round_index, selected, out)
+
+    def run_rounds(self, start_round: int, n_rounds: int) -> List[RoundResult]:
+        """n_rounds in ONE dispatch (lax.scan schedule; no early stopping)."""
+        if self._fused_scan is None:
+            self._build_fused()
+        schedule = [self.select_clients() for _ in range(n_rounds)]
+        arrays = [self._selection_arrays(sel) for sel in schedule]
+        sel_idx = jnp.asarray(np.stack([a[0] for a in arrays]))
+        masks = jnp.asarray(np.stack([a[1] for a in arrays]))
+        self.states, _, outs = self._fused_scan(
+            self.states, sel_idx, masks, self._agg_count_padded(),
+            self.rngs.next_jax())
+        outs = jax.device_get(outs)
+        return [self._fused_result(start_round + r, schedule[r],
+                                   jax.tree.map(lambda t: t[r], outs))
+                for r in range(n_rounds)]
+
+    # ------------------------------------------------------------------ #
+
     def run_round(self, round_index: int,
                   selected: Optional[List[int]] = None) -> RoundResult:
+        if self.fused and not self.timer.enabled:
+            return self.run_round_fused(round_index, selected)
         cfg, data = self.cfg, self.data
         if selected is None:
             selected = self.select_clients()

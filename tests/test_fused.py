@@ -1,0 +1,88 @@
+"""Fused-round path (federation/fused.py): the single-dispatch round and the
+scan-over-rounds schedule must reproduce the unfused reference-control-flow
+path exactly (tie-break disabled => both paths are deterministic)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from fedmse_tpu.config import CompatConfig, ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+from fedmse_tpu.federation import RoundEngine
+from fedmse_tpu.models import make_model
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+DIM = 12
+N = 4
+
+
+def build_engine(fused: bool, update_type: str = "mse_avg",
+                 model_type: str = "hybrid", pad_to: int = None):
+    cfg = ExperimentConfig(
+        dim_features=DIM, network_size=N, epochs=2, batch_size=8,
+        compat=CompatConfig(vote_tie_break=False))
+    clients = synthetic_clients(n_clients=N, dim=DIM, n_normal=120,
+                                n_abnormal=60)
+    rngs = ExperimentRngs(run=0)
+    dev_x = build_dev_dataset(clients, rngs.data_rng)
+    data = stack_clients(clients, dev_x, cfg.batch_size, pad_clients_to=pad_to)
+    m = make_model(model_type, DIM, shrink_lambda=cfg.shrink_lambda)
+    return RoundEngine(m, cfg, data, n_real=N, rngs=rngs,
+                       model_type=model_type, update_type=update_type,
+                       fused=fused)
+
+
+def assert_results_match(a, b):
+    assert a.selected == b.selected
+    assert a.aggregator == b.aggregator
+    np.testing.assert_allclose(a.client_metrics, b.client_metrics,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(a.min_valid, b.min_valid, rtol=1e-4, atol=1e-5)
+    rows_a = [(r["client_id"], r["rejected_updates"]) for r in a.verification_results]
+    rows_b = [(r["client_id"], r["rejected_updates"]) for r in b.verification_results]
+    assert rows_a == rows_b
+    if a.agg_weights is not None:
+        np.testing.assert_allclose(a.agg_weights, b.agg_weights,
+                                   rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("update_type", ["avg", "fedprox", "mse_avg"])
+def test_fused_round_matches_unfused(update_type):
+    ref = build_engine(fused=False, update_type=update_type)
+    fus = build_engine(fused=True, update_type=update_type)
+    for r in range(3):
+        res_ref = ref.run_round(r)
+        res_fus = fus.run_round(r)
+        assert_results_match(res_ref, res_fus)
+    np.testing.assert_array_equal(ref.host.aggregation_count,
+                                  fus.host.aggregation_count)
+
+
+def test_fused_scan_matches_per_round():
+    """run_rounds (one dispatch for the whole schedule) == per-round fused."""
+    a = build_engine(fused=True)
+    b = build_engine(fused=True)
+    res_a = [a.run_round(r) for r in range(3)]
+    res_b = b.run_rounds(0, 3)
+    for ra, rb in zip(res_a, res_b):
+        assert_results_match(ra, rb)
+
+
+def test_fused_with_padded_clients():
+    fus = build_engine(fused=True, pad_to=8)
+    res = fus.run_rounds(0, 2)
+    assert res[-1].client_metrics.shape == (N,)
+    assert np.all(np.isfinite(res[-1].client_metrics))
+    assert res[-1].aggregator in res[-1].selected
+
+
+def test_fused_quota_exhaustion():
+    """Once every client hit the aggregation quota, no aggregator is found
+    (reference: every voter returns None, main.py:284-288)."""
+    fus = build_engine(fused=True)
+    fus.host.aggregation_count[:] = fus.cfg.max_aggregation_threshold
+    res = fus.run_round(0)
+    assert res.aggregator is None
+    assert res.mse_scores is None
+    assert res.verification_results == []

@@ -1,0 +1,100 @@
+"""Native IO runtime (native/fedmse_io.cpp + data/fast_csv.py): parsed floats
+must match pandas bit-for-bit (both parse to float64), including header
+detection, CRLF endings, scientific notation, and multi-file concat."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fedmse_tpu.data.fast_csv import (native_available, read_csv_f64,
+                                      read_dir_f64)
+from fedmse_tpu.data.loader import load_data
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native IO library unavailable")
+
+
+def write(path, text):
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def test_basic_parse(tmp_path):
+    p = tmp_path / "a.csv"
+    write(p, "1.5,2.0,-3.25\n4.0,5e-3,6.0\n")
+    out = read_csv_f64(str(p))
+    np.testing.assert_array_equal(
+        out, np.array([[1.5, 2.0, -3.25], [4.0, 5e-3, 6.0]], np.float64))
+
+
+def test_header_detected_and_skipped(tmp_path):
+    p = tmp_path / "a.csv"
+    write(p, "col_a,col_b,col_c\n1.0,2.0,3.0\n")
+    out = read_csv_f64(str(p))
+    assert out.shape == (1, 3)
+    np.testing.assert_array_equal(out[0], [1.0, 2.0, 3.0])
+
+
+def test_crlf_and_no_trailing_newline(tmp_path):
+    p = tmp_path / "a.csv"
+    write(p, "1.0,2.0\r\n3.0,4.0")
+    out = read_csv_f64(str(p))
+    np.testing.assert_array_equal(
+        out, np.array([[1, 2], [3, 4]], np.float64))
+
+
+def test_scientific_notation_matches_pandas(tmp_path, rng):
+    vals = rng.standard_normal((50, 7)) * 10.0 ** rng.integers(-12, 12, (50, 7))
+    p = tmp_path / "a.csv"
+    pd.DataFrame(vals).to_csv(p, header=False, index=False)
+    out = read_csv_f64(str(p))
+    want = pd.read_csv(p, header=None, float_precision="round_trip").values
+    np.testing.assert_array_equal(out, want)
+
+
+def test_read_dir_concatenates_sorted(tmp_path):
+    write(tmp_path / "b.csv", "3.0,4.0\n")
+    write(tmp_path / "a.csv", "1.0,2.0\n")
+    out = read_dir_f64(str(tmp_path))
+    np.testing.assert_array_equal(out, np.array([[1, 2], [3, 4]], np.float64))
+
+
+def test_load_data_uses_native_and_matches_pandas(tmp_path, rng):
+    vals = rng.standard_normal((30, 5))
+    pd.DataFrame(vals).to_csv(tmp_path / "data.csv", header=False, index=False)
+    native = load_data(str(tmp_path), use_native=True)
+    fallback = load_data(str(tmp_path), use_native=False)
+    np.testing.assert_array_equal(native.values, fallback.values)
+
+
+def test_explicit_header_disables_native(tmp_path):
+    # an explicit header index is a pandas-only contract (loader.py)
+    write(tmp_path / "data.csv", "9.0,9.0\n1.0,2.0\n")
+    out = load_data(str(tmp_path), header=0, use_native=True)
+    assert len(out) == 1  # pandas consumed the first row as the header
+
+
+def test_malformed_falls_back_to_pandas(tmp_path):
+    # a ragged file the native parser rejects: load_data must still return
+    write(tmp_path / "data.csv", "1.0,2.0\n3.0\n")
+    out = load_data(str(tmp_path), use_native=True)
+    assert len(out) == 2  # pandas parses ragged as NaN-padded or raises later
+
+
+def test_wide_rows_rejected_by_native(tmp_path):
+    # wider-than-first rows must NOT silently truncate: native rejects,
+    # load_data falls back to pandas
+    write(tmp_path / "a.csv", "1.0,2.0\n3.0,4.0,5.0\n")
+    with pytest.raises(RuntimeError):
+        read_csv_f64(str(tmp_path / "a.csv"))
+
+
+def test_header_consistency_with_fallback(tmp_path):
+    # load_data must return the same thing whether or not the native lib is
+    # present: header-bearing files go through pandas on both paths
+    write(tmp_path / "data.csv", "h1,h2\n1.0,2.0\n")
+    native = load_data(str(tmp_path), use_native=True)
+    fallback = load_data(str(tmp_path), use_native=False)
+    assert len(native) == len(fallback) == 2  # header row parsed as data
