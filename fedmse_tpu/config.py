@@ -190,6 +190,11 @@ class ExperimentConfig:
     # fused single-kernel forward for evaluation: 'off' | 'auto' | 'pallas' |
     # 'xla' ('auto' = pallas on TPU, XLA-fused elsewhere; ops/pallas_ae.py)
     fused_eval: str = "off"
+    # single-dispatch rounds (federation/fused.py): the whole round compiles
+    # into one XLA program. Same math as the per-phase path (bit-identical
+    # when compat.vote_tie_break is off; with it on, only the tie-break
+    # jitter's key derivation differs — statistically identical).
+    fused_rounds: bool = True
 
     compat: CompatConfig = dataclasses.field(default_factory=CompatConfig)
 

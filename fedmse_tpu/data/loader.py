@@ -150,8 +150,20 @@ def prepare_clients(
     for device in devices:
         normal = load_data(os.path.join(dataset.data_path, device.normal_data_path))
         normal = normal.iloc[data_rng.permutation(len(normal))].reset_index(drop=True)
-        abnormal = load_data(os.path.join(dataset.data_path, device.abnormal_data_path))
-        abnormal = abnormal.iloc[data_rng.permutation(len(abnormal))].reset_index(drop=True)
+        # label-skewed non-IID shards can leave a client with NO abnormal
+        # traffic at all (e.g. the committed noniid-10-Client_Data set,
+        # Clients 6/9/10): treat a missing or CSV-less shard as zero abnormal
+        # rows — that client's AUC is NaN and every reduction here is nan-aware
+        abn_path = os.path.join(dataset.data_path, device.abnormal_data_path)
+        has_shard = os.path.isdir(abn_path) and \
+            any(".csv" in f for f in os.listdir(abn_path))
+        if has_shard:
+            abnormal = load_data(abn_path)
+            abnormal = abnormal.iloc[data_rng.permutation(len(abnormal))].reset_index(drop=True)
+        else:
+            abnormal = normal.iloc[:0]
+            logger.warning("%s: no abnormal shard at %s (0 abnormal rows)",
+                           device.name, abn_path)
 
         n_train, n_valid, n_dev, _ = _split_sizes(len(normal), cfg.split_fractions)
         train_df = normal.iloc[:n_train]

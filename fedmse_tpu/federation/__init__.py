@@ -1,11 +1,13 @@
 from fedmse_tpu.federation.state import ClientStates, init_client_states
 from fedmse_tpu.federation.local_training import make_local_train_all
 from fedmse_tpu.federation.aggregation import make_aggregate_fn
+from fedmse_tpu.federation.attack import AttackSpec, make_poison_fn, poison_params
 from fedmse_tpu.federation.voting import elect_aggregator, make_mse_scores_fn
 from fedmse_tpu.federation.verification import make_verify_fn
 from fedmse_tpu.federation.rounds import RoundEngine, RoundResult
 
 __all__ = [
+    "AttackSpec",
     "ClientStates",
     "RoundEngine",
     "RoundResult",
@@ -14,5 +16,7 @@ __all__ = [
     "make_aggregate_fn",
     "make_local_train_all",
     "make_mse_scores_fn",
+    "make_poison_fn",
     "make_verify_fn",
+    "poison_params",
 ]

@@ -1,0 +1,86 @@
+"""Byzantine attack simulation: adversarial perturbations of the broadcast
+aggregated model, for exercising the verification subsystem.
+
+The reference's security mechanism is reactive — `ModelVerifier` rejects
+suspicious aggregated updates (param-delta > 3.0 or performance drop > 0.002,
+reference src/Trainer/model_verifier.py:72-75) and `rejected_updates >= 3`
+flags a "possible attack" (client_trainer.py:201-203) — but the repo contains
+no way to *produce* an attack, so the defense is never exercised. This module
+supplies the attacker: pure, jittable transformations of the aggregated
+params pytree, applied between aggregation and broadcast exactly where a
+malicious elected aggregator would tamper (the round's single point of trust,
+src/main.py:293-300).
+
+Attacks (standard model-poisoning shapes from the federated-learning
+literature):
+  * scale      — multiply all parameters by `strength` (boosting attack);
+  * noise      — add N(0, strength^2) gaussian noise per tensor;
+  * sign_flip  — broadcast -strength * params (direction reversal);
+  * zero       — broadcast an all-zero model (nullification).
+
+Use via `RoundEngine(..., poison_fn=make_poison_fn(spec))`; `every_k` attacks
+only rounds where `round_index % every_k == 0` so accept/reject sequences can
+be scripted. The round RNG is folded in, so noise draws differ per round but
+stay reproducible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+ATTACK_KINDS = ("scale", "noise", "sign_flip", "zero")
+
+
+@dataclasses.dataclass(frozen=True)
+class AttackSpec:
+    """Declarative attack description (kind + strength + schedule)."""
+
+    kind: str = "scale"
+    strength: float = 10.0
+    every_k: int = 1          # attack rounds where round % every_k == 0
+    start_round: int = 0      # first attacked round
+
+    def __post_init__(self):
+        if self.kind not in ATTACK_KINDS:
+            raise ValueError(f"unknown attack kind {self.kind!r}; "
+                             f"one of {ATTACK_KINDS}")
+
+
+def poison_params(params: Any, spec: AttackSpec, rng: jax.Array) -> Any:
+    """Apply the attack to a params pytree (pure; safe under jit)."""
+    if spec.kind == "scale":
+        return jax.tree.map(lambda t: t * spec.strength, params)
+    if spec.kind == "sign_flip":
+        return jax.tree.map(lambda t: -spec.strength * t, params)
+    if spec.kind == "zero":
+        return jax.tree.map(jnp.zeros_like, params)
+    # noise
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(rng, len(leaves))
+    noisy = [t + spec.strength * jax.random.normal(k, t.shape, t.dtype)
+             for t, k in zip(leaves, keys)]
+    return jax.tree.unflatten(treedef, noisy)
+
+
+def make_poison_fn(spec: AttackSpec) -> Callable:
+    """Build poison_fn(agg_params, round_index, rng) -> agg_params for
+    RoundEngine: applies the attack on scheduled rounds, identity otherwise.
+    `round_index` is a traced scalar so the schedule works inside the fused
+    scan (lax.cond, no python branching on round number)."""
+
+    def poison_fn(agg_params: Any, round_index: jax.Array,
+                  rng: jax.Array) -> Any:
+        round_index = jnp.asarray(round_index)
+        active = (round_index >= spec.start_round) & \
+                 ((round_index % spec.every_k) == 0)
+        return jax.lax.cond(
+            active,
+            lambda p: poison_params(p, spec, rng),
+            lambda p: p,
+            agg_params)
+
+    return poison_fn

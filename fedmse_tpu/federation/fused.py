@@ -90,17 +90,24 @@ def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
 def make_round_body(train_all: Callable, scores_fn: Callable,
                     aggregate: Callable, verify: Callable,
                     evaluate_all: Callable, data, ver_x: jax.Array,
-                    ver_m: jax.Array, max_threshold: int) -> Callable:
+                    ver_m: jax.Array, max_threshold: int,
+                    poison_fn: Callable = None) -> Callable:
     """Build the traceable round body (jit-wrapped by make_fused_round,
     scanned directly by make_fused_rounds_scan):
 
-    fn(states, sel_indices [S], sel_mask [N], agg_count [N], rng)
+    fn(states, sel_indices [S], sel_mask [N], agg_count [N], rng, round_index)
       -> (states, agg_count, FusedRoundOut)
+
+    `poison_fn(agg_params, round_index, rng)`, when given, tampers with the
+    aggregated model between aggregation and broadcast — the malicious-
+    aggregator threat the verification subsystem defends against
+    (federation/attack.py).
     """
     n_pad = data.num_clients_padded
     client_ids = jnp.arange(n_pad)
 
-    def round_body(states: ClientStates, sel_indices, sel_mask, agg_count, rng):
+    def round_body(states: ClientStates, sel_indices, sel_mask, agg_count,
+                   rng, round_index):
         # ---- local training of the selected cohort (src/main.py:276-279) ----
         params, opt_state, best_params, min_valid, tracking = train_all(
             states.params, states.opt_state, states.prev_global, sel_mask,
@@ -121,6 +128,10 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
         # ---- aggregate + broadcast + verify (src/main.py:291-312) ----
         def do_aggregate(states):
             agg_params, weights = aggregate(states.params, sel_mask, data.dev_x)
+            if poison_fn is not None:  # malicious-aggregator tampering point
+                # fold constant is any index the voter loop can't reach
+                agg_params = poison_fn(agg_params, round_index,
+                                       jax.random.fold_in(rng, 0x7FFFFFFF))
             onehot = (client_ids == aggregator).astype(jnp.float32)
             outcome = verify(states, agg_params, ver_x, ver_m, onehot,
                              data.client_mask)
@@ -166,17 +177,19 @@ def make_fused_rounds_scan(*args) -> Callable:
     round_body = make_round_body(*args)
 
     @partial(jax.jit, donate_argnums=(0,))
-    def run_all(states: ClientStates, sel_schedule, sel_masks, agg_count, rng):
+    def run_all(states: ClientStates, sel_schedule, sel_masks, agg_count, rng,
+                round_indices):
         def step(carry, xs):
             states, agg_count = carry
-            sel_indices, sel_mask, key = xs
+            sel_indices, sel_mask, key, round_index = xs
             states, agg_count, out = round_body(states, sel_indices, sel_mask,
-                                                agg_count, key)
+                                                agg_count, key, round_index)
             return (states, agg_count), out
 
         keys = jax.random.split(rng, sel_schedule.shape[0])
         (states, agg_count), outs = jax.lax.scan(
-            step, (states, agg_count), (sel_schedule, sel_masks, keys))
+            step, (states, agg_count),
+            (sel_schedule, sel_masks, keys, round_indices))
         return states, agg_count, outs
 
     return run_all

@@ -65,7 +65,7 @@ class RoundEngine:
     def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
                  n_real: int, rngs: ExperimentRngs, model_type: str,
                  update_type: str, profile: bool = False,
-                 fused: bool = False):
+                 fused: bool = False, poison_fn=None):
         self.model = model
         self.cfg = cfg
         self.data = data
@@ -97,6 +97,7 @@ class RoundEngine:
         self.timer = PhaseTimer(enabled=profile)
 
         self.fused = fused
+        self.poison_fn = poison_fn  # attack simulation (federation/attack.py)
         self._fused_round = None
         self._fused_scan = None
         if fused and profile:
@@ -108,7 +109,7 @@ class RoundEngine:
                                                  make_fused_rounds_scan)
         args = (self.train_all, self.scores_fn, self.aggregate, self.verify,
                 self.evaluate_all, self.data, self._ver_x, self._ver_m,
-                self.cfg.max_aggregation_threshold)
+                self.cfg.max_aggregation_threshold, self.poison_fn)
         self._fused_round = make_fused_round(*args)
         self._fused_scan = make_fused_rounds_scan(*args)
 
@@ -206,7 +207,8 @@ class RoundEngine:
         sel_indices, sel_mask = self._selection_arrays(selected)
         self.states, _, out = self._fused_round(
             self.states, jnp.asarray(sel_indices), jnp.asarray(sel_mask),
-            self._agg_count_padded(), self.rngs.next_jax())
+            self._agg_count_padded(), self.rngs.next_jax(),
+            jnp.asarray(round_index, jnp.int32))
         return self._fused_result(round_index, selected, out)
 
     def run_rounds(self, start_round: int, n_rounds: int) -> List[RoundResult]:
@@ -219,7 +221,8 @@ class RoundEngine:
         masks = jnp.asarray(np.stack([a[1] for a in arrays]))
         self.states, _, outs = self._fused_scan(
             self.states, sel_idx, masks, self._agg_count_padded(),
-            self.rngs.next_jax())
+            self.rngs.next_jax(),
+            jnp.arange(start_round, start_round + n_rounds, dtype=jnp.int32))
         outs = jax.device_get(outs)
         return [self._fused_result(start_round + r, schedule[r],
                                    jax.tree.map(lambda t: t[r], outs))
@@ -270,6 +273,10 @@ class RoundEngine:
             with self.timer.phase("aggregate"):
                 agg_params, weights = self.aggregate(self.states.params,
                                                      sel_mask, data.dev_x)
+                if self.poison_fn is not None:  # attack simulation
+                    agg_params = self.poison_fn(
+                        agg_params, jnp.asarray(round_index, jnp.int32),
+                        self.rngs.next_jax())
                 agg_weights = np.asarray(jax.device_get(weights))
             self.host.aggregation_count[aggregator] += 1
             self.host.rounds_aggregated.append((round_index, aggregator))

@@ -99,7 +99,8 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
                        cfg.latent_dim, cfg.shrink_lambda)
     engine = RoundEngine(model, cfg, data, n_real=n_real, rngs=rngs,
-                         model_type=model_type, update_type=update_type)
+                         model_type=model_type, update_type=update_type,
+                         fused=cfg.fused_rounds)
     if mesh is not None:
         engine.data, engine.states = shard_federation(data, engine.states, mesh)
         engine._ver_x, engine._ver_m = engine._verification_tensors()

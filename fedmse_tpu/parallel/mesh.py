@@ -46,20 +46,30 @@ def client_mesh(n_devices: Optional[int] = None,
     return Mesh(np.asarray(devices), (axis_name,))
 
 
+def _place(leaf, sharding: NamedSharding):
+    """Single- and multi-process-safe placement. device_put requires every
+    target device to be addressable; when the mesh spans other hosts
+    (multi-controller run) each process instead contributes its local shard
+    of the (identical, fully-loaded-everywhere) host array."""
+    if jax.process_count() == 1:
+        return jax.device_put(jnp.asarray(leaf), sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(leaf))
+
+
 def shard_clients(tree: Any, mesh: Mesh, axis_name: str = "clients") -> Any:
-    """Place a stacked pytree with its leading axis sharded over the mesh."""
+    """Place a stacked pytree with its leading axis sharded over the mesh
+    (the mesh may span multiple hosts — see parallel/multihost.py)."""
     def place(leaf):
-        leaf = jnp.asarray(leaf)
-        spec = P(axis_name, *([None] * (leaf.ndim - 1)))
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+        spec = P(axis_name, *([None] * (jnp.ndim(leaf) - 1)))
+        return _place(leaf, NamedSharding(mesh, spec))
     return jax.tree.map(place, tree)
 
 
 def replicate(tree: Any, mesh: Mesh) -> Any:
-    """Replicate a pytree across every device of the mesh."""
-    sharding = NamedSharding(mesh, P())
-    return jax.tree.map(lambda leaf: jax.device_put(jnp.asarray(leaf), sharding),
-                        tree)
+    """Replicate a pytree across every device of the (possibly multi-host)
+    mesh."""
+    return jax.tree.map(
+        lambda leaf: _place(leaf, NamedSharding(mesh, P())), tree)
 
 
 def shard_federation(data, states, mesh: Mesh, axis_name: str = "clients"):

@@ -1,0 +1,81 @@
+"""Multi-host (DCN) federation: scale the client axis across TPU pod hosts.
+
+The reference has no distributed backend at all (SURVEY.md §5.8 — peers are
+in-process objects). Single-host fedmse-tpu maps clients onto the local
+chips' ICI via `parallel/mesh.py`; this module extends the same 1-D
+`clients` mesh across a multi-host pod slice:
+
+  * every host runs the same program (standard JAX multi-controller SPMD);
+  * `initialize()` wraps `jax.distributed.initialize` and MUST run before
+    any other JAX API touches a backend (coordinator address/process env
+    comes from the launcher — GKE/TPU-VM metadata — or explicit args);
+  * `global_client_mesh()` builds the 1-D mesh over ALL devices in the pod
+    slice, so the client axis spans hosts. XLA then routes the aggregation
+    all-reduce hierarchically: ICI within a host's chips, DCN between hosts
+    — exactly the layered topology the scaling playbook prescribes;
+  * placement is the SAME API as single-host: `shard_clients` / `replicate`
+    / `shard_federation` (parallel/mesh.py) detect multi-process runs and
+    switch from `jax.device_put` to
+    `jax.make_array_from_process_local_data`, with each process
+    contributing its devices' rows of the (identical, fully-loaded-
+    everywhere) host arrays. The federation's data is tiny — every host
+    loads the full dataset; there is no cross-host data exchange.
+
+The round engine is unchanged: `RoundEngine` + `shard_federation(data,
+states, mesh)` work identically whether the mesh spans 1 host or 64 — that
+is the point of expressing aggregation as a mesh reduction instead of
+point-to-point sends. Client-state initialization is deterministic in the
+PRNG key, so every process builds identical host-side state before placement.
+
+Launch shape (one command per host):
+
+    python -c "from fedmse_tpu.parallel import initialize_multihost as init; \
+               init()" ... python -m fedmse_tpu.main --use-mesh ...
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from fedmse_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-controller runtime. Call BEFORE any other jax API
+    touches devices — `jax.distributed.initialize` fails once a backend
+    exists, so this function must not query devices/processes first.
+
+    With explicit arguments a failure raises (a misconfigured pod launch
+    must not silently train disjoint federations). With no arguments it
+    auto-detects the launcher environment and quietly stays single-process
+    when there is none (laptop / single-VM runs)."""
+    global _initialized
+    if _initialized:
+        return
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        _initialized = True
+        logger.info("multihost: process %d/%d, %d global devices",
+                    jax.process_index(), jax.process_count(),
+                    len(jax.devices()))
+    except Exception as e:
+        if coordinator_address is not None or num_processes is not None:
+            raise  # explicit pod config that failed: surface it
+        logger.info("multihost init skipped (%s); running single-process", e)
+
+
+def global_client_mesh(axis_name: str = "clients") -> Mesh:
+    """1-D `clients` mesh over every device in the pod slice (all hosts)."""
+    return Mesh(np.asarray(jax.devices()), (axis_name,))

@@ -1,0 +1,133 @@
+"""Attack simulation (federation/attack.py) exercising the verification
+subsystem end-to-end: poisoned aggregated models must be rejected by the
+param-delta / performance checks (reference model_verifier.py:72-75), the
+rejected counter must grow toward the 'possible attack' threshold
+(client_trainer.py:201-203), and honest training must be unaffected."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.config import CompatConfig, ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+from fedmse_tpu.federation import AttackSpec, RoundEngine, make_poison_fn, poison_params
+from fedmse_tpu.models import make_model, init_client_params
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+DIM = 12
+N = 4
+
+
+def build_engine(poison_fn=None, fused=True, **cfg_kw):
+    cfg = ExperimentConfig(
+        dim_features=DIM, network_size=N, epochs=2, batch_size=8,
+        compat=CompatConfig(vote_tie_break=False), **cfg_kw)
+    clients = synthetic_clients(n_clients=N, dim=DIM, n_normal=120,
+                                n_abnormal=60)
+    rngs = ExperimentRngs(run=0)
+    dev_x = build_dev_dataset(clients, rngs.data_rng)
+    data = stack_clients(clients, dev_x, cfg.batch_size)
+    m = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+    return RoundEngine(m, cfg, data, n_real=N, rngs=rngs, model_type="hybrid",
+                       update_type="avg", fused=fused, poison_fn=poison_fn)
+
+
+def test_poison_params_shapes_and_kinds():
+    m = make_model("hybrid", DIM, shrink_lambda=1.0)
+    params = init_client_params(m, jax.random.key(0))
+    for kind in ("scale", "noise", "sign_flip", "zero"):
+        out = poison_params(params, AttackSpec(kind=kind, strength=3.0),
+                            jax.random.key(1))
+        assert jax.tree.structure(out) == jax.tree.structure(params)
+    zero = poison_params(params, AttackSpec(kind="zero"), jax.random.key(1))
+    assert all(float(jnp.abs(t).max()) == 0.0 for t in jax.tree.leaves(zero))
+    scaled = poison_params(params, AttackSpec(kind="scale", strength=2.0),
+                           jax.random.key(1))
+    np.testing.assert_allclose(np.asarray(jax.tree.leaves(scaled)[0]),
+                               2.0 * np.asarray(jax.tree.leaves(params)[0]),
+                               rtol=1e-6)
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValueError):
+        AttackSpec(kind="meteor")
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_scale_attack_rejected_after_first_contact(fused):
+    """Round 0's update is accepted unconditionally (first-contact rule,
+    model_verifier.py:41-47); attacked later rounds must be rejected and the
+    rejected counters must grow."""
+    spec = AttackSpec(kind="scale", strength=50.0, start_round=1)
+    eng = build_engine(poison_fn=make_poison_fn(spec), fused=fused)
+
+    r0 = eng.run_round(0)  # honest? no — start_round=1, so round 0 is clean
+    assert all(row["rejected_updates"] == 0 for row in r0.verification_results)
+
+    rejected_counts = []
+    for r in range(1, 4):
+        res = eng.run_round(r)
+        if res.aggregator is None:
+            continue
+        rejected_counts.append(
+            max(row["rejected_updates"] for row in res.verification_results))
+    # every attacked round adds a rejection for every receiving client
+    assert rejected_counts and rejected_counts[-1] >= 2
+    assert rejected_counts == sorted(rejected_counts)
+
+
+def test_attack_blocked_models_keep_prior_params():
+    """Rejected updates must leave the receivers' models untouched — EXCEPT
+    clients receiving their first-ever update, which the reference accepts
+    unconditionally (first-contact rule, model_verifier.py:41-47): those load
+    even a poisoned broadcast. The round-0 aggregator is exactly such a
+    client in round 1 (an aggregator's own history is never updated)."""
+    spec = AttackSpec(kind="zero", start_round=1)
+    eng = build_engine(poison_fn=make_poison_fn(spec))
+    seen_before = None
+    r0 = eng.run_round(0)
+    seen_before = np.asarray(jax.device_get(eng.states.hist_seen)).copy()
+    res = eng.run_round(1)
+    assert res.aggregator is not None
+    rejected = np.asarray(jax.device_get(eng.states.rejected))
+    leaf = np.asarray(jax.tree.leaves(jax.device_get(eng.states.params))[0])
+    for i in range(N):
+        if i == res.aggregator:
+            continue  # loads its own (poisoned) aggregate unconditionally
+        if seen_before[i]:
+            # verified receiver: rejects the zero model, keeps its params
+            assert rejected[i] == 1
+            assert np.abs(leaf[i]).max() > 0.0
+        else:
+            # first-contact receiver: the quirk accepts even a poisoned model
+            assert rejected[i] == 0
+            assert np.abs(leaf[i]).max() == 0.0
+
+
+def test_honest_run_has_no_rejections():
+    eng = build_engine(poison_fn=None)
+    for r in range(3):
+        res = eng.run_round(r)
+    assert all(row["rejected_updates"] == 0
+               for row in res.verification_results)
+
+
+def test_attack_schedule_every_k():
+    """every_k=2 attacks rounds 0,2,...; clean rounds re-accept (the verifier
+    compares against the last RECEIVED state, so a clean broadcast after a
+    huge poisoned one still fails the delta check — counters keep growing —
+    while small-perturbation schedules recover; here we just pin the
+    schedule logic itself."""
+    spec = AttackSpec(kind="scale", strength=50.0, every_k=2, start_round=0)
+    fn = make_poison_fn(spec)
+    m = make_model("hybrid", DIM, shrink_lambda=1.0)
+    params = init_client_params(m, jax.random.key(0))
+    leaf0 = np.asarray(jax.tree.leaves(params)[0])
+    out0 = fn(params, jnp.asarray(0), jax.random.key(1))
+    out1 = fn(params, jnp.asarray(1), jax.random.key(1))
+    np.testing.assert_allclose(np.asarray(jax.tree.leaves(out0)[0]),
+                               50.0 * leaf0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(jax.tree.leaves(out1)[0]),
+                               leaf0, rtol=1e-6)

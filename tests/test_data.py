@@ -143,3 +143,32 @@ def test_experiment_config_json_roundtrip():
     cfg = ExperimentConfig(epochs=7, update_types=("avg",))
     cfg2 = ExperimentConfig.from_json(json.loads(json.dumps(cfg.to_json())))
     assert cfg2 == cfg
+
+
+def test_missing_or_empty_abnormal_shard_yields_zero_rows(tmp_path):
+    """Clients without abnormal traffic (label-skewed non-IID shards, e.g.
+    the committed noniid-10-Client_Data set) must load with 0 abnormal rows
+    instead of crashing — whether the shard dir is absent or just CSV-less."""
+    import numpy as np
+    import pandas as pd
+    from fedmse_tpu.config import DatasetConfig, ExperimentConfig
+    from fedmse_tpu.data import prepare_clients
+
+    rng = np.random.default_rng(0)
+    for k, make_abnormal in ((1, "absent"), (2, "empty")):
+        base = tmp_path / f"Client-{k}"
+        for split in ("normal", "test_normal"):
+            d = base / split
+            d.mkdir(parents=True)
+            pd.DataFrame(rng.standard_normal((40, 6))).to_csv(
+                d / "data.csv", header=False, index=False)
+        if make_abnormal == "empty":
+            (base / "abnormal").mkdir()  # exists but holds no CSVs
+
+    ds = DatasetConfig.for_client_dirs(str(tmp_path), 2)
+    cfg = ExperimentConfig(dim_features=6, network_size=2)
+    clients = prepare_clients(ds, cfg, np.random.default_rng(1))
+    assert len(clients) == 2
+    for c in clients:
+        assert np.all(c.test_y[: len(c.test_y)] >= 0)
+        assert c.test_y.sum() == 0  # no abnormal rows -> all labels normal
