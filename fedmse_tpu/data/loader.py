@@ -146,24 +146,33 @@ def prepare_clients(
         idx = data_rng.choice(len(devices), size=n_net, replace=False)
         devices = [devices[i] for i in idx]  # random.sample analog (main.py:126)
 
+    def has_csvs(rel_path: str) -> bool:
+        p = os.path.join(dataset.data_path, rel_path)
+        return os.path.isdir(p) and any(".csv" in f for f in os.listdir(p))
+
     clients: List[ClientData] = []
     for device in devices:
+        # a gateway with no normal traffic cannot train a normal-profile
+        # autoencoder at all: skip it (e.g. the committed Kitsune non-IID
+        # set's Client-7 has only a test_normal shard)
+        if not has_csvs(device.normal_data_path):
+            logger.warning("%s: no normal shard under %s — skipping device",
+                           device.name, device.normal_data_path)
+            continue
         normal = load_data(os.path.join(dataset.data_path, device.normal_data_path))
         normal = normal.iloc[data_rng.permutation(len(normal))].reset_index(drop=True)
         # label-skewed non-IID shards can leave a client with NO abnormal
         # traffic at all (e.g. the committed noniid-10-Client_Data set,
         # Clients 6/9/10): treat a missing or CSV-less shard as zero abnormal
         # rows — that client's AUC is NaN and every reduction here is nan-aware
-        abn_path = os.path.join(dataset.data_path, device.abnormal_data_path)
-        has_shard = os.path.isdir(abn_path) and \
-            any(".csv" in f for f in os.listdir(abn_path))
-        if has_shard:
-            abnormal = load_data(abn_path)
+        if has_csvs(device.abnormal_data_path):
+            abnormal = load_data(
+                os.path.join(dataset.data_path, device.abnormal_data_path))
             abnormal = abnormal.iloc[data_rng.permutation(len(abnormal))].reset_index(drop=True)
         else:
             abnormal = normal.iloc[:0]
             logger.warning("%s: no abnormal shard at %s (0 abnormal rows)",
-                           device.name, abn_path)
+                           device.name, device.abnormal_data_path)
 
         n_train, n_valid, n_dev, _ = _split_sizes(len(normal), cfg.split_fractions)
         train_df = normal.iloc[:n_train]
@@ -178,11 +187,16 @@ def prepare_clients(
         abnormal_x, abnormal_y = proc.transform(abnormal, type="abnormal")
 
         if cfg.new_device:
-            new_normal = load_data(
-                os.path.join(dataset.data_path, device.test_normal_data_path))
-            new_x, new_y = proc.transform(new_normal)
-            test_x = np.concatenate([test_x, new_x], axis=0)
-            test_y = np.concatenate([test_y, new_y], axis=0)
+            if has_csvs(device.test_normal_data_path):
+                new_normal = load_data(os.path.join(
+                    dataset.data_path, device.test_normal_data_path))
+                new_x, new_y = proc.transform(new_normal)
+                test_x = np.concatenate([test_x, new_x], axis=0)
+                test_y = np.concatenate([test_y, new_y], axis=0)
+            else:
+                logger.warning("%s: no test_normal shard at %s (new-device "
+                               "normals absent from the test set)",
+                               device.name, device.test_normal_data_path)
 
         test_x = np.concatenate([test_x, abnormal_x], axis=0)
         test_y = np.concatenate([test_y, abnormal_y], axis=0)
@@ -198,6 +212,10 @@ def prepare_clients(
         ))
         logger.info("%s: %d train / %d valid / %d test rows",
                     device.name, len(train_x), len(valid_x), len(test_x))
+    if not clients:
+        raise FileNotFoundError(
+            f"no usable devices under {dataset.data_path!r} — every "
+            f"configured client is missing its normal-traffic shard")
     return clients
 
 

@@ -48,6 +48,10 @@ class AttackSpec:
         if self.kind not in ATTACK_KINDS:
             raise ValueError(f"unknown attack kind {self.kind!r}; "
                              f"one of {ATTACK_KINDS}")
+        if self.every_k < 1:
+            # would become a traced mod-by-zero under jit (undefined result,
+            # no ZeroDivisionError) — reject eagerly instead
+            raise ValueError(f"every_k must be >= 1, got {self.every_k}")
 
 
 def poison_params(params: Any, spec: AttackSpec, rng: jax.Array) -> Any:

@@ -242,6 +242,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> Dict:
+    # join the multi-controller runtime first (no-op on single hosts; must
+    # run before any backend is touched — parallel/multihost.py)
+    from fedmse_tpu.parallel import initialize_multihost
+    initialize_multihost()
     args = build_parser().parse_args(argv)
     cfg = apply_cli_overrides(ExperimentConfig(), args)
     if args.paper_scale:

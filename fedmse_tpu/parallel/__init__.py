@@ -6,14 +6,10 @@ from fedmse_tpu.parallel.mesh import (
     shard_federation,
 )
 from fedmse_tpu.parallel.collectives import make_shardmap_aggregate
-from fedmse_tpu.parallel.multihost import (
-    global_client_mesh,
-    initialize as initialize_multihost,
-)
+from fedmse_tpu.parallel.multihost import initialize as initialize_multihost
 
 __all__ = [
     "client_mesh",
-    "global_client_mesh",
     "initialize_multihost",
     "make_shardmap_aggregate",
     "pad_to_multiple",

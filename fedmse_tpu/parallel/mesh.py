@@ -49,11 +49,16 @@ def client_mesh(n_devices: Optional[int] = None,
 def _place(leaf, sharding: NamedSharding):
     """Single- and multi-process-safe placement. device_put requires every
     target device to be addressable; when the mesh spans other hosts
-    (multi-controller run) each process instead contributes its local shard
-    of the (identical, fully-loaded-everywhere) host array."""
+    (multi-controller run) each process instead contributes its slice of the
+    (identical, fully-loaded-everywhere) host array. Passing global_shape ==
+    the host array's shape tells JAX the local data IS the full target array
+    (each process donates the rows its devices own) — without it the global
+    client axis would be inflated process_count-fold."""
     if jax.process_count() == 1:
         return jax.device_put(jnp.asarray(leaf), sharding)
-    return jax.make_array_from_process_local_data(sharding, np.asarray(leaf))
+    leaf = np.asarray(leaf)
+    return jax.make_array_from_process_local_data(sharding, leaf,
+                                                  global_shape=leaf.shape)
 
 
 def shard_clients(tree: Any, mesh: Mesh, axis_name: str = "clients") -> Any:

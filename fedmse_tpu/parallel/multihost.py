@@ -26,26 +26,37 @@ states, mesh)` work identically whether the mesh spans 1 host or 64 — that
 is the point of expressing aggregation as a mesh reduction instead of
 point-to-point sends. Client-state initialization is deterministic in the
 PRNG key, so every process builds identical host-side state before placement.
+In a multi-controller run `jax.devices()` already returns the pod-global
+device list, so `client_mesh()` (parallel/mesh.py) IS the global mesh.
 
-Launch shape (one command per host):
+Launch shape: run the SAME command on every host; `fedmse_tpu.main` calls
+`initialize()` before touching any backend:
 
-    python -c "from fedmse_tpu.parallel import initialize_multihost as init; \
-               init()" ... python -m fedmse_tpu.main --use-mesh ...
+    python -m fedmse_tpu.main --use-mesh --dataset-config ...
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
-import numpy as np
-from jax.sharding import Mesh
 
 from fedmse_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 _initialized = False
+
+
+def _launcher_configured() -> bool:
+    """True when the environment carries pod-launcher multihost config (so an
+    init failure means a broken pod, not a laptop run)."""
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or \
+            os.environ.get("COORDINATOR_ADDRESS"):
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) > 1
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -55,13 +66,15 @@ def initialize(coordinator_address: Optional[str] = None,
     touches devices — `jax.distributed.initialize` fails once a backend
     exists, so this function must not query devices/processes first.
 
-    With explicit arguments a failure raises (a misconfigured pod launch
-    must not silently train disjoint federations). With no arguments it
-    auto-detects the launcher environment and quietly stays single-process
-    when there is none (laptop / single-VM runs)."""
+    A failure raises when the pod is explicitly configured (arguments given,
+    or launcher env markers present) — a misconfigured pod launch must not
+    silently train disjoint per-host federations. With no configuration at
+    all it quietly stays single-process (laptop / single-VM runs)."""
     global _initialized
     if _initialized:
         return
+    explicit = (coordinator_address is not None or num_processes is not None
+                or _launcher_configured())
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
@@ -71,11 +84,6 @@ def initialize(coordinator_address: Optional[str] = None,
                     jax.process_index(), jax.process_count(),
                     len(jax.devices()))
     except Exception as e:
-        if coordinator_address is not None or num_processes is not None:
-            raise  # explicit pod config that failed: surface it
+        if explicit:
+            raise  # configured pod that failed to join: surface it
         logger.info("multihost init skipped (%s); running single-process", e)
-
-
-def global_client_mesh(axis_name: str = "clients") -> Mesh:
-    """1-D `clients` mesh over every device in the pod slice (all hosts)."""
-    return Mesh(np.asarray(jax.devices()), (axis_name,))

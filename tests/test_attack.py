@@ -131,3 +131,8 @@ def test_attack_schedule_every_k():
                                50.0 * leaf0, rtol=1e-6)
     np.testing.assert_allclose(np.asarray(jax.tree.leaves(out1)[0]),
                                leaf0, rtol=1e-6)
+
+
+def test_every_k_zero_rejected():
+    with pytest.raises(ValueError):
+        AttackSpec(kind="scale", every_k=0)

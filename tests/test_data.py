@@ -172,3 +172,35 @@ def test_missing_or_empty_abnormal_shard_yields_zero_rows(tmp_path):
     for c in clients:
         assert np.all(c.test_y[: len(c.test_y)] >= 0)
         assert c.test_y.sum() == 0  # no abnormal rows -> all labels normal
+
+
+def test_device_without_normal_shard_is_skipped(tmp_path):
+    """A gateway with no normal traffic cannot train: it is skipped (the
+    committed Kitsune non-IID set's Client-7), and an all-unusable config
+    raises instead of returning an empty federation."""
+    import numpy as np
+    import pandas as pd
+    import pytest
+    from fedmse_tpu.config import DatasetConfig, ExperimentConfig
+    from fedmse_tpu.data import prepare_clients
+
+    rng = np.random.default_rng(0)
+    # Client-1 complete; Client-2 has only test_normal
+    for split in ("normal", "abnormal", "test_normal"):
+        d = tmp_path / "Client-1" / split
+        d.mkdir(parents=True)
+        pd.DataFrame(rng.standard_normal((40, 6))).to_csv(
+            d / "data.csv", header=False, index=False)
+    d = tmp_path / "Client-2" / "test_normal"
+    d.mkdir(parents=True)
+    pd.DataFrame(rng.standard_normal((10, 6))).to_csv(
+        d / "data.csv", header=False, index=False)
+
+    ds = DatasetConfig.for_client_dirs(str(tmp_path), 2)
+    cfg = ExperimentConfig(dim_features=6, network_size=2)
+    clients = prepare_clients(ds, cfg, np.random.default_rng(1))
+    assert [c.name for c in clients] == ["Client-1"]
+
+    ds_bad = DatasetConfig.for_client_dirs(str(tmp_path / "nowhere"), 2)
+    with pytest.raises(FileNotFoundError):
+        prepare_clients(ds_bad, cfg, np.random.default_rng(1))

@@ -101,14 +101,14 @@ def test_graft_entry_dryrun():
 # ----------------------------- multi-host ----------------------------- #
 
 def test_multihost_helpers_single_process():
-    """Single-process degradation: global mesh == all local devices; the
-    standard placement helpers serve the global mesh too."""
+    """Single-process degradation: client_mesh() == all (local) devices; the
+    standard placement helpers serve the multi-host mesh too."""
     import numpy as np
     import jax
     from jax.sharding import PartitionSpec as P
-    from fedmse_tpu.parallel import global_client_mesh, replicate, shard_clients
+    from fedmse_tpu.parallel import client_mesh, replicate, shard_clients
 
-    mesh = global_client_mesh()
+    mesh = client_mesh()
     assert mesh.devices.size == len(jax.devices())
 
     x = np.arange(16, dtype=np.float32).reshape(8, 2)
@@ -134,10 +134,10 @@ def test_full_round_on_global_mesh():
                                  synthetic_clients)
     from fedmse_tpu.federation import RoundEngine
     from fedmse_tpu.models import make_model
-    from fedmse_tpu.parallel import global_client_mesh, shard_federation
+    from fedmse_tpu.parallel import client_mesh, shard_federation
     from fedmse_tpu.utils.seeding import ExperimentRngs
 
-    mesh = global_client_mesh()
+    mesh = client_mesh()
     n = mesh.devices.size
     cfg = ExperimentConfig(dim_features=12, network_size=n, epochs=1,
                            batch_size=8)
