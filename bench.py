@@ -14,12 +14,41 @@ below). >1.0 means faster than the reference.
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
+
+
+def _ensure_live_backend(timeout_s: int = 150) -> None:
+    """Fall back to CPU when the TPU tunnel is wedged.
+
+    The container's axon TPU backend can hang device initialization
+    indefinitely if its tunnel is in a bad state; a hung benchmark is worse
+    than a CPU number. Probe device init in a subprocess (a hung in-process
+    init cannot be interrupted) and re-exec on CPU if it times out. No-op
+    once a fallback already happened or no tunnel is configured."""
+    if os.environ.get("FEDMSE_BENCH_CPU_FALLBACK") or \
+            not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return
+    detail = f"device init exceeded {timeout_s}s"
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        if probe.returncode == 0:
+            return
+        detail = probe.stderr.decode(errors="replace").strip()[-500:]
+    except subprocess.TimeoutExpired:
+        pass
+    sys.stderr.write(
+        f"bench: TPU backend unreachable ({detail}); falling back to CPU\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", FEDMSE_BENCH_CPU_FALLBACK="1")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 # Reference torch implementation, measured 2026-07-29 on this container's CPU:
 # hybrid+mse_avg, 3 rounds, 5 epochs/round, 10 clients, batch 12 -> round
@@ -50,6 +79,7 @@ def build_data(cfg):
 
 
 def main():
+    _ensure_live_backend()
     import numpy as np
     import jax
 

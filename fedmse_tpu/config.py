@@ -186,7 +186,6 @@ class ExperimentConfig:
     # TPU-specific knobs (no reference equivalent)
     mesh_shape: Optional[Tuple[int, ...]] = None  # None => all local devices
     client_axis_name: str = "clients"
-    param_dtype: str = "float32"
     # fused single-kernel forward for evaluation: 'off' | 'auto' | 'pallas' |
     # 'xla' ('auto' = pallas on TPU, XLA-fused elsewhere; ops/pallas_ae.py)
     fused_eval: str = "off"
