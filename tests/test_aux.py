@@ -155,3 +155,24 @@ def test_gaussian_kl_js(rng):
     m2 = np.ones(3)
     assert js_divergence(mean, cov, m2, 2 * cov) == pytest.approx(
         js_divergence(m2, 2 * cov, mean, cov), rel=1e-9)
+
+
+def test_repo_dataset_configs_are_valid():
+    """Every shipped configs/*.json must parse into a DatasetConfig with
+    consistent client naming and the standard shard layout."""
+    import glob
+    import os
+    from fedmse_tpu.config import DatasetConfig
+
+    paths = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "configs", "*.json")))
+    assert paths, "no dataset configs shipped"
+    for p in paths:
+        ds = DatasetConfig.from_json(p)
+        assert ds.devices_list, p
+        for dev in ds.devices_list:
+            assert dev.normal_data_path.endswith("/normal"), (p, dev)
+            assert dev.abnormal_data_path.endswith("/abnormal"), (p, dev)
+            assert dev.test_normal_data_path.endswith("/test_normal"), (p, dev)
+        assert len({d.id for d in ds.devices_list}) == len(ds.devices_list), p
