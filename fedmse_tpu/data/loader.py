@@ -33,6 +33,15 @@ from fedmse_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def _csv_files(path: str) -> List[str]:
+    """The shard-file listing shared by the loaders (reference
+    dataloader.py:24-26: any file containing '.csv', sorted)."""
+    if not os.path.isdir(path):
+        return []
+    return [os.path.join(path, f) for f in sorted(os.listdir(path))
+            if ".csv" in f]
+
+
 def load_data(path: str, header: Optional[int] = None,
               use_native: bool = True) -> pd.DataFrame:
     """Concatenate every CSV file in `path` (reference dataloader.py:22-30).
@@ -52,13 +61,12 @@ def load_data(path: str, header: Optional[int] = None,
         except Exception as e:
             logger.info("native CSV path failed for %s (%s); using pandas",
                         path, e)
-    frames = []
-    for file in sorted(os.listdir(path)):
-        if ".csv" in file:
-            # round_trip = correctly-rounded strtod parsing, bit-identical to
-            # the native path (pandas' default fast parser is ~1e-13 off)
-            frames.append(pd.read_csv(os.path.join(path, file), header=header,
-                                      float_precision="round_trip"))
+    # round_trip = correctly-rounded strtod parsing, bit-identical to the
+    # native path (pandas' default fast parser is ~1e-13 off)
+    frames = [pd.read_csv(f, header=header, float_precision="round_trip")
+              for f in _csv_files(path)]
+    if not frames:
+        raise FileNotFoundError(f"no CSV files in {path}")
     return pd.concat(frames, ignore_index=True)
 
 
@@ -147,8 +155,7 @@ def prepare_clients(
         devices = [devices[i] for i in idx]  # random.sample analog (main.py:126)
 
     def has_csvs(rel_path: str) -> bool:
-        p = os.path.join(dataset.data_path, rel_path)
-        return os.path.isdir(p) and any(".csv" in f for f in os.listdir(p))
+        return bool(_csv_files(os.path.join(dataset.data_path, rel_path)))
 
     clients: List[ClientData] = []
     for device in devices:

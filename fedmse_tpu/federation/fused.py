@@ -34,7 +34,7 @@ tie-break disabled: bit-identical round outputs).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, NamedTuple, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -91,7 +91,7 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                     aggregate: Callable, verify: Callable,
                     evaluate_all: Callable, data, ver_x: jax.Array,
                     ver_m: jax.Array, max_threshold: int,
-                    poison_fn: Callable = None) -> Callable:
+                    poison_fn: Optional[Callable] = None) -> Callable:
     """Build the traceable round body (jit-wrapped by make_fused_round,
     scanned directly by make_fused_rounds_scan):
 

@@ -9,8 +9,9 @@ chips' ICI via `parallel/mesh.py`; this module extends the same 1-D
   * `initialize()` wraps `jax.distributed.initialize` and MUST run before
     any other JAX API touches a backend (coordinator address/process env
     comes from the launcher — GKE/TPU-VM metadata — or explicit args);
-  * `global_client_mesh()` builds the 1-D mesh over ALL devices in the pod
-    slice, so the client axis spans hosts. XLA then routes the aggregation
+  * `client_mesh()` (parallel/mesh.py) builds the 1-D mesh over ALL devices
+    in the pod slice (jax.devices() is pod-global in a multi-controller
+    run), so the client axis spans hosts. XLA then routes the aggregation
     all-reduce hierarchically: ICI within a host's chips, DCN between hosts
     — exactly the layered topology the scaling playbook prescribes;
   * placement is the SAME API as single-host: `shard_clients` / `replicate`
