@@ -23,32 +23,47 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 
-def _ensure_live_backend(timeout_s: int = 150) -> None:
-    """Fall back to CPU when the TPU tunnel is wedged.
+def _ensure_live_backend(timeout_s: int = 150, attempts: int = 3,
+                         backoff_s: int = 30) -> None:
+    """Fall back to CPU when the TPU tunnel is wedged — but fight for the
+    TPU first (VERDICT r1 #2): retry the probe with backoff, and record the
+    final failure reason so it lands in the output JSON.
 
     The container's axon TPU backend can hang device initialization
     indefinitely if its tunnel is in a bad state; a hung benchmark is worse
     than a CPU number. Probe device init in a subprocess (a hung in-process
-    init cannot be interrupted) and re-exec on CPU if it times out. No-op
-    once a fallback already happened or no tunnel is configured."""
+    init cannot be interrupted) and re-exec on CPU only after every retry
+    fails. No-op once a fallback already happened or no tunnel is
+    configured."""
     if os.environ.get("FEDMSE_BENCH_CPU_FALLBACK") or \
             not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return
-    detail = f"device init exceeded {timeout_s}s"
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True)
-        if probe.returncode == 0:
-            return
-        detail = probe.stderr.decode(errors="replace").strip()[-500:]
-    except subprocess.TimeoutExpired:
-        pass
+    detail = ""
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(backoff_s)
+        detail = f"device init exceeded {timeout_s}s"
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s, capture_output=True)
+            if probe.returncode == 0:
+                return
+            detail = probe.stderr.decode(errors="replace").strip()[-500:]
+        except subprocess.TimeoutExpired:
+            pass
+        sys.stderr.write(f"bench: TPU probe {attempt + 1}/{attempts} "
+                         f"failed ({detail})\n")
     sys.stderr.write(
-        f"bench: TPU backend unreachable ({detail}); falling back to CPU\n")
-    env = dict(os.environ, JAX_PLATFORMS="cpu", FEDMSE_BENCH_CPU_FALLBACK="1")
+        f"bench: TPU backend unreachable after {attempts} probes; "
+        f"falling back to CPU\n")
+    reason = f"TPU unreachable after {attempts}x{timeout_s}s probes: {detail}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FEDMSE_BENCH_CPU_FALLBACK=reason[:900])
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    # the Pallas TPU kernel cannot lower on the CPU fallback backend
+    argv = [a for a in sys.argv if a != "--pallas"]
+    os.execve(sys.executable, [sys.executable] + argv, env)
 
 # Reference torch implementation, measured 2026-07-29 on this container's CPU:
 # hybrid+mse_avg, 3 rounds, 5 epochs/round, 10 clients, batch 12 -> round
@@ -87,8 +102,11 @@ def main():
     from fedmse_tpu.federation import RoundEngine
     from fedmse_tpu.models import make_model
 
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
     fused = "--unfused" not in sys.argv
-    cfg = ExperimentConfig()  # reference quick-run defaults
+    fused_eval = "pallas" if "--pallas" in sys.argv else "off"
+    cfg = ExperimentConfig(fused_eval=fused_eval)  # reference quick-run defaults
     data, n_real, rngs = build_data(cfg)
 
     model = make_model("hybrid", cfg.dim_features,
@@ -98,28 +116,38 @@ def main():
                          fused=fused)
 
     timed_rounds = 3
-    if fused:
-        # whole 3-round schedule = ONE dispatch (federation/fused.py);
-        # warm-up run compiles the scan, the timed run restarts the federation
-        # from scratch so the reported AUC is a 3-round result like the
-        # reference's quick run (state reset, same compiled program)
-        engine.run_rounds(0, timed_rounds)
+    # AUC protocol (VERDICT r1 #3/#5): mean +/- std over num_runs independent
+    # federations — the reference's own reporting is mean over runs
+    # (src/main.py:51 num_runs, results_visualization.ipynb cells 0-5).
+    # Wall-clock is timed on run 0 only (later runs reuse compiled programs,
+    # same speed).
+    num_runs = 3
+    aucs = []
+    sec_per_round = None
+    for run in range(num_runs):
+        engine.rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed)
         engine.reset_federation()
-        t0 = time.time()
-        results = engine.run_rounds(0, timed_rounds)
-        sec_per_round = (time.time() - t0) / timed_rounds
-        result = results[-1]
-    else:
-        # warm-up round triggers every jit compile (train/score/agg/verify/eval)
-        engine.run_round(0)
-        engine.reset_federation()
-        t0 = time.time()
-        result = None
-        for r in range(timed_rounds):
-            result = engine.run_round(r)
-        sec_per_round = (time.time() - t0) / timed_rounds
+        if fused:
+            if run == 0:  # warm-up compiles the 3-round scan
+                engine.run_rounds(0, timed_rounds)
+                engine.reset_federation()
+            t0 = time.time()
+            results = engine.run_rounds(0, timed_rounds)
+            elapsed = time.time() - t0
+            result = results[-1]
+        else:
+            if run == 0:  # warm-up triggers every per-phase jit compile
+                engine.run_round(0)
+                engine.reset_federation()
+            t0 = time.time()
+            result = None
+            for r in range(timed_rounds):
+                result = engine.run_round(r)
+            elapsed = time.time() - t0
+        if run == 0:
+            sec_per_round = elapsed / timed_rounds
+        aucs.append(float(np.nanmean(result.client_metrics)))
 
-    auc = float(np.nanmean(result.client_metrics))
     device = jax.devices()[0]
     out = {
         "metric": "sec/federated-round (N-BaIoT 10-client, hybrid SAE-CEN + "
@@ -127,14 +155,21 @@ def main():
         "value": round(sec_per_round, 4),
         "unit": "s",
         "vs_baseline": round(BASELINE_SEC_PER_ROUND / sec_per_round, 2),
-        "auc_mean": round(auc, 5),
+        "auc_mean": round(float(np.mean(aucs)), 5),
+        "auc_std": round(float(np.std(aucs)), 5),
+        "auc_runs": [round(a, 5) for a in aucs],
+        "num_runs": num_runs,
         "auc_baseline": BASELINE_AUC,
         "baseline_sec_per_round": BASELINE_SEC_PER_ROUND,
         "baseline_source": "reference torch run on this machine's CPU",
         "device": str(device),
         "platform": device.platform,
         "mode": "fused-scan" if fused else "per-phase",
+        "fused_eval": fused_eval,
     }
+    reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
+    if reason and reason != "1":
+        out["tpu_fallback_reason"] = reason
     print(json.dumps(out))
 
 

@@ -151,7 +151,8 @@ class CheckpointManager:
         return os.path.join(self.directory, tag)
 
     def save(self, tag: str, states: ClientStates, host: HostState,
-             round_index: int, extra: Optional[Dict] = None) -> None:
+             round_index: int, extra: Optional[Dict] = None,
+             tracking: Optional[np.ndarray] = None) -> None:
         payload = {
             "states": dataclasses.asdict(states),
             "round_index": np.asarray(round_index),
@@ -170,10 +171,17 @@ class CheckpointManager:
         }
         with open(self._path(tag) + ".host.json", "w") as f:
             json.dump(meta, f)
+        if tracking is not None:
+            # the cross-round loss curve so training_tracking.pkl stays
+            # complete over a kill/resume (its shape varies with rounds run,
+            # so it rides outside the fixed-shape Orbax payload)
+            np.savez(self._path(tag) + ".tracking.npz", tracking=tracking)
 
     def restore(self, tag: str, states_like: ClientStates):
-        """Returns (states, host, round_index). `states_like` provides the
-        pytree structure/shapes (build it with init_client_states)."""
+        """Returns (states, host, round_index, tracking). `states_like`
+        provides the pytree structure/shapes (build it with
+        init_client_states); `tracking` is the accumulated [n_real, E, 3]
+        loss curve up to the checkpointed round (None if not saved)."""
         target = {
             "states": dataclasses.asdict(states_like),
             "round_index": np.asarray(0),
@@ -187,7 +195,10 @@ class CheckpointManager:
             votes_received=np.asarray(meta["votes_received"]),
             rounds_aggregated=[tuple(x) for x in meta["rounds_aggregated"]],
         )
-        return states, host, int(payload["round_index"])
+        tracking = None
+        if os.path.exists(self._path(tag) + ".tracking.npz"):
+            tracking = np.load(self._path(tag) + ".tracking.npz")["tracking"]
+        return states, host, int(payload["round_index"]), tracking
 
     def exists(self, tag: str) -> bool:
         return os.path.exists(self._path(tag)) and \

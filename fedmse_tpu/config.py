@@ -190,10 +190,18 @@ class ExperimentConfig:
     # 'xla' ('auto' = pallas on TPU, XLA-fused elsewhere; ops/pallas_ae.py)
     fused_eval: str = "off"
     # single-dispatch rounds (federation/fused.py): the whole round compiles
-    # into one XLA program. Same math as the per-phase path (bit-identical
-    # when compat.vote_tie_break is off; with it on, only the tie-break
-    # jitter's key derivation differs — statistically identical).
+    # into one XLA program. Same math as the per-phase path (numerically
+    # equivalent to rtol=1e-4 when compat.vote_tie_break is off — XLA fusion
+    # may reorder float ops; with it on, only the tie-break jitter's key
+    # derivation differs — statistically identical).
     fused_rounds: bool = True
+    # whole-schedule scan (federation/fused.py make_fused_rounds_scan) wired
+    # into the driver: rounds run in chunks of fused_schedule_chunk per XLA
+    # dispatch, with early stopping checked per round from the stacked
+    # outputs (a mid-chunk stop restores a snapshot and replays the prefix
+    # with identical selections/keys — main.py:run_combination).
+    fused_schedule: bool = False
+    fused_schedule_chunk: int = 8
 
     compat: CompatConfig = dataclasses.field(default_factory=CompatConfig)
 
@@ -219,20 +227,30 @@ def paper_scale(cfg: ExperimentConfig) -> ExperimentConfig:
     return cfg.replace(epochs=100, num_rounds=20, lr_rate=1e-5, shrink_lambda=10.0)
 
 
+def _parse_bool(s: str) -> bool:
+    return s.lower() in ("1", "true", "yes")
+
+
 def add_cli_overrides(parser) -> None:
-    """Register every scalar ExperimentConfig field as a --flag override."""
+    """Register every scalar ExperimentConfig field as a --flag override,
+    plus every CompatConfig quirk switch as --compat-<name> (so the driver
+    can run fixed-mode experiments: e.g. --compat-shared-last-client-val
+    false flips SURVEY.md §2 quirk 6 off)."""
     for f in dataclasses.fields(ExperimentConfig):
         if f.name == "compat":
             continue
         ftype = f.type if isinstance(f.type, type) else None
         name = "--" + f.name.replace("_", "-")
         if ftype is bool or isinstance(f.default, bool):
-            parser.add_argument(name, type=lambda s: s.lower() in ("1", "true", "yes"),
-                                default=None)
+            parser.add_argument(name, type=_parse_bool, default=None)
         elif isinstance(f.default, (int, float, str)):
             parser.add_argument(name, type=type(f.default), default=None)
         elif isinstance(f.default, tuple) and f.default and isinstance(f.default[0], str):
             parser.add_argument(name, type=lambda s: tuple(s.split(",")), default=None)
+    for f in dataclasses.fields(CompatConfig):
+        parser.add_argument("--compat-" + f.name.replace("_", "-"),
+                            dest="compat_" + f.name, type=_parse_bool,
+                            default=None)
 
 
 def apply_cli_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
@@ -243,4 +261,11 @@ def apply_cli_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         val = getattr(args, f.name, None)
         if val is not None:
             updates[f.name] = val
+    compat_updates = {}
+    for f in dataclasses.fields(CompatConfig):
+        val = getattr(args, "compat_" + f.name, None)
+        if val is not None:
+            compat_updates[f.name] = val
+    if compat_updates:
+        updates["compat"] = dataclasses.replace(cfg.compat, **compat_updates)
     return cfg.replace(**updates) if updates else cfg

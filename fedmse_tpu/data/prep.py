@@ -2,15 +2,32 @@
 (Notebook/N-BaIoT/Data-Examination.ipynb, SURVEY.md §2 #9 / §3.5) as a
 scriptable tool instead of a notebook.
 
-The reference samples each source device's benign traffic, holds out a
-'new device' test_normal share, and shards normal/abnormal/test_normal across
-K clients with FedArtML's SplitAsFederatedData — IID, or label-skewed non-IID
-where the 'label' is the device of origin. Reproduced here without fedartml:
+The reference's notebook pipeline (Data-Examination.ipynb):
+  1. cells 2-5: walk the RAW per-device N-BaIoT tree
+     `<root>/<device>/{normal,abnormal}/*.csv` ('benign' files are normal,
+     'mirai'/'gafgyt' files are attacks), sample 5% of each device's benign
+     rows and 0.5% of each attack file's rows;
+  2. cells 10-13: the non-IID 'label' is the DEVICE OF ORIGIN, integer-encoded;
+  3. cell 14: hold out 40% of the pooled normal rows as the 'new device'
+     test_normal split (random_state=42);
+  4. cells 22/28/35: shard normal/abnormal/test_normal across K clients with
+     FedArtML `SplitAsFederatedData(random_state=42).create_clients(...,
+     method="dirichlet", alpha=...)`;
+  5. cells 26/30/37: per client, drop origin-classes with < 10 rows, write
+     headerless `Client-k/{normal,abnormal,test_normal}/data.csv`.
 
+Reproduced here without fedartml, as a scriptable tool:
+
+  * `--raw`: ingest the raw per-device tree (steps 1-3) — use this to rebuild
+    the federation from the original N-BaIoT/Kitsune downloads;
+  * `--source`: pool EXISTING Client-k shards back together (rows keep their
+    source client as origin label) — use this to re-shard committed layouts;
   * IID: a uniform random partition of the pooled rows into K shards.
-  * non-IID: per-client Dirichlet(alpha) mixture over origin-device labels
-    (the standard label-skew construction; alpha -> inf recovers IID,
-    alpha -> 0 gives one-device-per-client extremes).
+  * non-IID: per-origin-label Dirichlet(alpha) proportions over clients —
+    the SAME construction FedArtML's `method="dirichlet"` uses, so `--alpha`
+    maps 1:1 onto the notebook's `alpha` (alpha=1000 ~ IID, the committed
+    non-IID split's stacked-bar chart reports Jensen-Shannon distance 0.83,
+    reproduced by alpha ~= 0.5 — see `js_distance`, printed for every split).
 
 Output layout is exactly what the data layer consumes (and what the reference
 notebook writes, Data-Examination.ipynb cells 26-38):
@@ -19,6 +36,8 @@ notebook writes, Data-Examination.ipynb cells 26-38):
 CLI:
   python -m fedmse_tpu.data.prep --source <dir-with-Client-k-shards> \
       --n-clients 50 --mode noniid --alpha 0.5 --out Data/nbaiot-50
+  python -m fedmse_tpu.data.prep --raw <dir-with-device-folders> \
+      --n-clients 10 --mode noniid --alpha 0.5 --out Data/nbaiot-noniid
 """
 
 from __future__ import annotations
@@ -59,6 +78,102 @@ def pool_source_shards(source_dir: str) -> Dict[str, Tuple[pd.DataFrame, np.ndar
     return pooled
 
 
+def pool_raw_devices(
+    raw_dir: str,
+    benign_frac: float = 0.05,
+    abnormal_frac: float = 0.005,
+    holdout_frac: float = 0.4,
+    seed: int = 42,
+) -> Dict[str, Tuple[pd.DataFrame, np.ndarray]]:
+    """Ingest the RAW per-device N-BaIoT tree (Data-Examination.ipynb
+    cells 2-14): sample `benign_frac` of each device's 'benign' files and
+    `abnormal_frac` of each 'mirai'/'gafgyt' file, label rows by integer-
+    encoded device of origin, and hold out `holdout_frac` of the pooled
+    normal rows as the new-device test_normal split.
+
+    Returns {split: (features_frame, origin_labels)} for the three splits.
+    Device dirs without a `normal/` subdir (e.g. already-sharded Client
+    layouts living next to the raw tree) are skipped.
+    """
+    rng = np.random.default_rng(seed)
+    devices = sorted(
+        d for d in os.listdir(raw_dir)
+        if os.path.isdir(os.path.join(raw_dir, d, "normal")))
+    if not devices:
+        raise FileNotFoundError(
+            f"no raw device folders (with a normal/ subdir) under {raw_dir}")
+
+    def read_sampled(device_idx: int, path: str, frac: float):
+        df = pd.read_csv(path)
+        n = int(frac * df.shape[0])  # notebook: int(frac * shape[0])
+        take = rng.choice(len(df), size=n, replace=False)
+        return df.iloc[take].reset_index(drop=True), np.full(n, device_idx)
+
+    normal_frames, normal_origins = [], []
+    abnormal_frames, abnormal_origins = [], []
+    for i, dev in enumerate(devices):
+        ndir = os.path.join(raw_dir, dev, "normal")
+        for fname in sorted(os.listdir(ndir)):
+            if "benign" in fname:
+                f, o = read_sampled(i, os.path.join(ndir, fname), benign_frac)
+                normal_frames.append(f)
+                normal_origins.append(o)
+        adir = os.path.join(raw_dir, dev, "abnormal")
+        if os.path.isdir(adir):
+            for fname in sorted(os.listdir(adir)):
+                if "mirai" in fname or "gafgyt" in fname:
+                    f, o = read_sampled(i, os.path.join(adir, fname),
+                                        abnormal_frac)
+                    abnormal_frames.append(f)
+                    abnormal_origins.append(o)
+    normal = pd.concat(normal_frames, ignore_index=True)
+    n_origin = np.concatenate(normal_origins)
+    abnormal = pd.concat(abnormal_frames, ignore_index=True)
+    a_origin = np.concatenate(abnormal_origins)
+
+    # 40% new-device holdout from the pooled normal rows (cell 14)
+    n_hold = int(holdout_frac * len(normal))
+    hold = rng.choice(len(normal), size=n_hold, replace=False)
+    mask = np.zeros(len(normal), dtype=bool)
+    mask[hold] = True
+    test_normal = normal[mask].reset_index(drop=True)
+    t_origin = n_origin[mask]
+    normal = normal[~mask].reset_index(drop=True)
+    n_origin = n_origin[~mask]
+
+    logger.info("raw pool: %d devices, %d normal / %d abnormal / %d "
+                "test_normal rows", len(devices), len(normal), len(abnormal),
+                len(test_normal))
+    return {"normal": (normal, n_origin),
+            "abnormal": (abnormal, a_origin),
+            "test_normal": (test_normal, t_origin)}
+
+
+def js_distance(origins: np.ndarray, parts: List[np.ndarray]) -> float:
+    """Generalized Jensen-Shannon distance of the clients' origin-label
+    distributions (uniform client weights, base-2, normalized by log2 K,
+    then sqrt) — the skew statistic FedArtML reports for its splits; the
+    committed non-IID N-BaIoT split's chart cites 0.83
+    (Data-Examination.ipynb cells 40/42)."""
+    labels = np.unique(origins)
+    dists = []
+    for idx in parts:
+        if len(idx) == 0:
+            continue
+        counts = np.array([(origins[idx] == c).sum() for c in labels], float)
+        dists.append(counts / counts.sum())
+    if len(dists) < 2:  # 0 or 1 non-empty client: no divergence to measure
+        return 0.0
+    p = np.stack(dists)
+
+    def entropy(q):
+        q = q[q > 0]
+        return -(q * np.log2(q)).sum()
+
+    jsd = entropy(p.mean(0)) - np.mean([entropy(row) for row in p])
+    return float(np.sqrt(jsd / np.log2(len(p))))
+
+
 def dirichlet_partition(origins: np.ndarray, n_clients: int, alpha: float,
                         rng: np.random.Generator) -> List[np.ndarray]:
     """Label-skew partition: for each origin label, split its row indices
@@ -80,21 +195,50 @@ def iid_partition(n_rows: int, n_clients: int,
     return list(np.array_split(idx, n_clients))
 
 
+def filter_small_classes(origins: np.ndarray, idx: np.ndarray,
+                         min_rows: int = 10) -> np.ndarray:
+    """Drop a client's origin-classes with < min_rows rows — the notebook's
+    `groupby(label).filter(lambda x: len(x) >= 10)` (cells 26/30/37)."""
+    if len(idx) == 0:
+        return idx
+    labels = origins[idx]
+    keep_labels = {c for c in np.unique(labels)
+                   if (labels == c).sum() >= min_rows}
+    return idx[np.isin(labels, list(keep_labels))]
+
+
 def create_federated_shards(
-    source_dir: str,
+    source_dir: Optional[str],
     out_dir: str,
     n_clients: int,
     mode: str = "iid",
     alpha: float = 0.5,
     seed: int = 42,
     sample_frac: float = 1.0,
-) -> None:
-    """Shard pooled source traffic into n_clients federated clients."""
+    raw_dir: Optional[str] = None,
+    benign_frac: float = 0.05,
+    abnormal_frac: float = 0.005,
+    holdout_frac: float = 0.4,
+    min_class_rows: int = 10,
+) -> Dict[str, float]:
+    """Shard pooled traffic into n_clients federated clients.
+
+    Sources are mutually exclusive: `source_dir` pools existing Client-k
+    shards; `raw_dir` ingests the raw per-device tree (5% benign / 0.5%
+    abnormal sample + 40% test_normal holdout, Data-Examination.ipynb
+    cells 5/14). Returns {split: Jensen-Shannon distance} of the produced
+    partition so non-IID severity can be matched to the notebook's
+    published figure (0.83 for the committed non-IID split)."""
     rng = np.random.default_rng(seed)
-    pooled = pool_source_shards(source_dir)
+    if (source_dir is None) == (raw_dir is None):
+        raise ValueError("exactly one of source_dir / raw_dir is required")
+    pooled = (pool_raw_devices(raw_dir, benign_frac, abnormal_frac,
+                               holdout_frac, seed)
+              if raw_dir else pool_source_shards(source_dir))
+    js: Dict[str, float] = {}
     for split in SPLITS:
         df, origins = pooled[split]
-        if sample_frac < 1.0:  # the notebook samples 5% of benign traffic
+        if sample_frac < 1.0:  # extra subsample of already-pooled shards
             keep = rng.random(len(df)) < sample_frac
             df, origins = df[keep].reset_index(drop=True), origins[keep]
         if mode == "iid":
@@ -103,29 +247,49 @@ def create_federated_shards(
             parts = dirichlet_partition(origins, n_clients, alpha, rng)
         else:
             raise ValueError(f"unknown mode {mode!r}")
+        if mode == "noniid" and min_class_rows > 1:
+            parts = [filter_small_classes(origins, idx, min_class_rows)
+                     for idx in parts]
         for k, idx in enumerate(parts, start=1):
+            if len(idx) == 0:
+                continue  # no shard dir at all — the loader treats a missing
+                # split exactly like the reference's committed data gaps
             d = os.path.join(out_dir, f"Client-{k}", split)
             os.makedirs(d, exist_ok=True)
             df.iloc[idx].to_csv(os.path.join(d, "data.csv"),
                                 index=False, header=False)
         sizes = [len(p) for p in parts]
-        logger.info("%s: %d rows -> %d clients (min %d / max %d)",
-                    split, len(df), n_clients, min(sizes), max(sizes))
+        js[split] = js_distance(origins, parts)
+        logger.info("%s: %d rows -> %d clients (min %d / max %d), "
+                    "JS distance %.3f", split, len(df), n_clients,
+                    min(sizes), max(sizes), js[split])
+    return js
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--source", required=True,
+    p.add_argument("--source", default=None,
                    help="dir containing Client-k/{normal,abnormal,test_normal}")
+    p.add_argument("--raw", default=None,
+                   help="dir containing raw per-device folders "
+                        "(<device>/{normal,abnormal}/*.csv)")
     p.add_argument("--out", required=True)
     p.add_argument("--n-clients", type=int, required=True)
     p.add_argument("--mode", choices=("iid", "noniid"), default="iid")
     p.add_argument("--alpha", type=float, default=0.5)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--sample-frac", type=float, default=1.0)
+    p.add_argument("--benign-frac", type=float, default=0.05)
+    p.add_argument("--abnormal-frac", type=float, default=0.005)
+    p.add_argument("--holdout-frac", type=float, default=0.4)
+    p.add_argument("--min-class-rows", type=int, default=10)
     args = p.parse_args(argv)
     create_federated_shards(args.source, args.out, args.n_clients, args.mode,
-                            args.alpha, args.seed, args.sample_frac)
+                            args.alpha, args.seed, args.sample_frac,
+                            raw_dir=args.raw, benign_frac=args.benign_frac,
+                            abnormal_frac=args.abnormal_frac,
+                            holdout_frac=args.holdout_frac,
+                            min_class_rows=args.min_class_rows)
 
 
 if __name__ == "__main__":

@@ -18,10 +18,10 @@ literature):
   * sign_flip  — broadcast -strength * params (direction reversal);
   * zero       — broadcast an all-zero model (nullification).
 
-Use via `RoundEngine(..., poison_fn=make_poison_fn(spec))`; `every_k` attacks
-only rounds where `round_index % every_k == 0` so accept/reject sequences can
-be scripted. The round RNG is folded in, so noise draws differ per round but
-stay reproducible.
+Use via `RoundEngine(..., poison_fn=make_poison_fn(spec))`; the schedule
+attacks rounds `start_round, start_round + every_k, start_round + 2*every_k,
+...` so accept/reject sequences can be scripted. The round RNG is folded in,
+so noise draws differ per round but stay reproducible.
 """
 
 from __future__ import annotations
@@ -41,8 +41,8 @@ class AttackSpec:
 
     kind: str = "scale"
     strength: float = 10.0
-    every_k: int = 1          # attack rounds where round % every_k == 0
-    start_round: int = 0      # first attacked round
+    every_k: int = 1          # attack every k-th round from start_round
+    start_round: int = 0      # first attacked round (schedule anchor)
 
     def __post_init__(self):
         if self.kind not in ATTACK_KINDS:
@@ -80,7 +80,7 @@ def make_poison_fn(spec: AttackSpec) -> Callable:
                   rng: jax.Array) -> Any:
         round_index = jnp.asarray(round_index)
         active = (round_index >= spec.start_round) & \
-                 ((round_index % spec.every_k) == 0)
+                 (((round_index - spec.start_round) % spec.every_k) == 0)
         return jax.lax.cond(
             active,
             lambda p: poison_params(p, spec, rng),

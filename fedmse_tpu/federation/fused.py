@@ -28,7 +28,9 @@ unfused election draws a fresh key from the host sequence per voter call,
 while here voter i uses `fold_in(round_key, i)`. The tie-break factor these
 keys feed is a ±0.01% jitter (client_trainer.py:243-245), so the two paths
 are statistically identical (verified by tests/test_fused.py with the
-tie-break disabled: bit-identical round outputs).
+tie-break disabled: numerically equivalent round outputs to rtol=1e-4 —
+whole-round XLA fusion may reorder float ops vs the separately jitted
+phases, so exact bitwise equality is not guaranteed).
 """
 
 from __future__ import annotations
@@ -65,6 +67,11 @@ def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
     n = sel_mask.shape[0]
     n_sel = sel_indices.shape[0]
     client_ids = jnp.arange(n)
+    # position of each client in selection order (n_sel = "not selected"):
+    # exact score ties resolve to the EARLIEST selected candidate, matching
+    # the unfused election's stable sort (voting.py:elect_aggregator)
+    sel_pos = jnp.full((n,), n_sel, jnp.int32).at[sel_indices].set(
+        jnp.arange(n_sel, dtype=jnp.int32))
 
     def cond(carry):
         i, agg, _ = carry
@@ -77,8 +84,13 @@ def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
         cand = (sel_mask > 0) & (client_ids != voter) & \
                (agg_count < max_threshold)
         found = jnp.any(cand)
-        pick = jnp.argmin(jnp.where(cand, scores, jnp.inf)).astype(jnp.int32)
-        agg = jnp.where(found, pick, jnp.int32(-1))
+        # NaN scores (diverged training) rank worst; if EVERY candidate is
+        # NaN the earliest selected candidate wins — the pick is always a
+        # genuine candidate
+        masked = jnp.where(cand & ~jnp.isnan(scores), scores, jnp.inf)
+        tie = cand & (masked == jnp.min(masked))  # lexicographic (score, pos)
+        pick = jnp.argmin(jnp.where(tie, sel_pos, jnp.int32(n_sel + 1)))
+        agg = jnp.where(found, pick.astype(jnp.int32), jnp.int32(-1))
         kept = jnp.where(found, scores, kept)
         return i + 1, agg, kept
 
@@ -168,17 +180,21 @@ def make_fused_rounds_scan(*args) -> Callable:
     """Build the whole-schedule runner: `lax.scan` of the raw round body over
     a precomputed selection schedule.
 
-    fn(states, sel_schedule [R, S], sel_masks [R, N], agg_count [N], rng)
+    fn(states, sel_schedule [R, S], sel_masks [R, N], agg_count [N], keys [R])
       -> (states, agg_count, FusedRoundOut stacked on a leading [R] axis)
 
-    One dispatch for R rounds; host early stopping cannot interleave (use
-    make_fused_round per-round when it must).
+    `keys` is one PRNG key per round, drawn from the SAME host stream the
+    per-round path uses — so a chunked schedule consumes the identical key
+    sequence as R successive `run_round_fused` calls. One dispatch for R
+    rounds; host early stopping cannot interleave (the driver scans in chunks
+    and replays the tail of a chunk when a stop fires mid-chunk —
+    main.py:run_combination).
     """
     round_body = make_round_body(*args)
 
     @partial(jax.jit, donate_argnums=(0,))
-    def run_all(states: ClientStates, sel_schedule, sel_masks, agg_count, rng,
-                round_indices):
+    def run_all(states: ClientStates, sel_schedule, sel_masks, agg_count,
+                keys, round_indices):
         def step(carry, xs):
             states, agg_count = carry
             sel_indices, sel_mask, key, round_index = xs
@@ -186,7 +202,6 @@ def make_fused_rounds_scan(*args) -> Callable:
                                                 agg_count, key, round_index)
             return (states, agg_count), out
 
-        keys = jax.random.split(rng, sel_schedule.shape[0])
         (states, agg_count), outs = jax.lax.scan(
             step, (states, agg_count),
             (sel_schedule, sel_masks, keys, round_indices))

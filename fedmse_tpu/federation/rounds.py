@@ -199,34 +199,53 @@ class RoundEngine:
         self.host = HostState.create(self.n_real)
 
     def run_round_fused(self, round_index: int,
-                        selected: Optional[List[int]] = None) -> RoundResult:
+                        selected: Optional[List[int]] = None,
+                        key: Optional[jax.Array] = None) -> RoundResult:
+        """ONE dispatch for one round. `selected`/`key` override the host
+        streams — used by the driver to REPLAY a scanned chunk's prefix with
+        the exact same selections and PRNG keys (main.py:run_combination)."""
         if self._fused_round is None:
             self._build_fused()
         if selected is None:
             selected = self.select_clients()
+        if key is None:
+            key = self.rngs.next_jax()
         sel_indices, sel_mask = self._selection_arrays(selected)
         self.states, _, out = self._fused_round(
             self.states, jnp.asarray(sel_indices), jnp.asarray(sel_mask),
-            self._agg_count_padded(), self.rngs.next_jax(),
+            self._agg_count_padded(), key,
             jnp.asarray(round_index, jnp.int32))
         return self._fused_result(round_index, selected, out)
 
-    def run_rounds(self, start_round: int, n_rounds: int) -> List[RoundResult]:
-        """n_rounds in ONE dispatch (lax.scan schedule; no early stopping)."""
+    def run_schedule_chunk(self, start_round: int, n_rounds: int):
+        """n_rounds in ONE `lax.scan` dispatch.
+
+        Returns (results, schedule, keys): per-round RoundResults plus the
+        host-drawn selections and PRNG keys that produced them, so a caller
+        that must early-stop mid-chunk can restore a snapshot and replay the
+        prefix round-by-round with identical inputs. Selections and keys are
+        drawn from the same host streams, in the same order, as n_rounds
+        successive `run_round_fused` calls."""
         if self._fused_scan is None:
             self._build_fused()
         schedule = [self.select_clients() for _ in range(n_rounds)]
+        keys = [self.rngs.next_jax() for _ in range(n_rounds)]
         arrays = [self._selection_arrays(sel) for sel in schedule]
         sel_idx = jnp.asarray(np.stack([a[0] for a in arrays]))
         masks = jnp.asarray(np.stack([a[1] for a in arrays]))
         self.states, _, outs = self._fused_scan(
             self.states, sel_idx, masks, self._agg_count_padded(),
-            self.rngs.next_jax(),
+            jnp.stack(keys),
             jnp.arange(start_round, start_round + n_rounds, dtype=jnp.int32))
         outs = jax.device_get(outs)
-        return [self._fused_result(start_round + r, schedule[r],
-                                   jax.tree.map(lambda t: t[r], outs))
-                for r in range(n_rounds)]
+        results = [self._fused_result(start_round + r, schedule[r],
+                                      jax.tree.map(lambda t: t[r], outs))
+                   for r in range(n_rounds)]
+        return results, schedule, keys
+
+    def run_rounds(self, start_round: int, n_rounds: int) -> List[RoundResult]:
+        """n_rounds in ONE dispatch (lax.scan schedule; no early stopping)."""
+        return self.run_schedule_chunk(start_round, n_rounds)[0]
 
     # ------------------------------------------------------------------ #
 

@@ -65,6 +65,14 @@ class HostState:
             rounds_aggregated=[],
         )
 
+    def copy(self) -> "HostState":
+        """Deep snapshot (for chunk rewind in the fused-schedule driver)."""
+        return HostState(
+            aggregation_count=self.aggregation_count.copy(),
+            votes_received=self.votes_received.copy(),
+            rounds_aggregated=list(self.rounds_aggregated),
+        )
+
 
 def init_client_states(model, tx: optax.GradientTransformation,
                        rng: jax.Array, n_clients: int) -> ClientStates:

@@ -30,6 +30,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from fedmse_tpu.config import (DatasetConfig, ExperimentConfig,
@@ -105,34 +106,101 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
         engine.data, engine.states = shard_federation(data, engine.states, mesh)
         engine._ver_x, engine._ver_m = engine._verification_tensors()
 
+    round_times: List[float] = []
+    all_tracking: List[np.ndarray] = []  # per-round [n_real, E, 3] curves —
+    # accumulated across ALL rounds like the reference's training_tracking
+    # list (client_trainer.py:405-419), not just the last round's
+    last_result = None
+
     tag = f"{model_type}_{update_type}_run{run}"
     start_round = 0
     if resume is not None and resume.exists(tag):
-        engine.states, engine.host, start_round = resume.restore(
-            tag, engine.states)
+        engine.states, engine.host, start_round, prev_tracking = \
+            resume.restore(tag, engine.states)
+        if prev_tracking is not None:  # keep the pre-kill part of the curve
+            all_tracking.append(prev_tracking)
         logger.info("resumed %s at round %d", tag, start_round)
 
-    round_times: List[float] = []
-    last_result = None
-    for round_index in range(start_round, cfg.num_rounds):
-        t0 = time.time()
-        result = engine.run_round(round_index)
-        round_times.append(time.time() - t0)
+    def bookkeep(result, sec: float) -> bool:
+        """Per-round logging/artifacts; returns True when early stop fires."""
+        nonlocal last_result
+        round_times.append(sec)
         last_result = result
+        all_tracking.append(result.tracking)
         logger.info("[%s/%s run %d] round %d: agg=%s mean %s=%.4f (%.2fs)",
-                    model_type, update_type, run, round_index + 1,
+                    model_type, update_type, run, result.round_index + 1,
                     result.aggregator, cfg.metric,
-                    float(np.nanmean(result.client_metrics)), round_times[-1])
+                    float(np.nanmean(result.client_metrics)), sec)
         if writer is not None:
-            writer.append_round_metrics(run, round_index, result.client_metrics,
+            writer.append_round_metrics(run, result.round_index,
+                                        result.client_metrics,
                                         model_type, update_type)
-            writer.append_verification(run, round_index,
+            writer.append_verification(run, result.round_index,
                                        result.verification_results)
-        if resume is not None:
-            resume.save(tag, engine.states, engine.host, round_index + 1)
-        if early_stop is not None and early_stop.should_stop(result.client_metrics):
+        if early_stop is not None and \
+                early_stop.should_stop(result.client_metrics):
             logger.info("Early stopping in global round!")
-            break
+            return True
+        return False
+
+    use_schedule = (cfg.fused_schedule and cfg.fused_rounds
+                    and engine.fused and not engine.timer.enabled)
+    can_rewind = early_stop is not None
+    if use_schedule and can_rewind and jax.process_count() > 1:
+        # mid-chunk rewind+replay is unvalidated across multi-controller
+        # processes (every host must take the identical stop decision);
+        # stay on the per-round dispatch path there
+        logger.warning("fused_schedule with early stopping is single-process "
+                       "only; using the per-round dispatch path")
+        use_schedule = False
+    if use_schedule:
+        # whole-schedule scan in chunks: K rounds per XLA dispatch. Early
+        # stopping is evaluated per round from the stacked outputs; a stop
+        # at a non-final round of a chunk restores the chunk-entry snapshot
+        # and replays the prefix with the SAME selections/keys, so the final
+        # states match the per-round path's exactly.
+        round_index = start_round
+        stopped = False
+        while round_index < cfg.num_rounds and not stopped:
+            k = min(cfg.fused_schedule_chunk, cfg.num_rounds - round_index)
+            if can_rewind:  # scan donates states: snapshot before dispatch.
+                # On-device copy — keeps shardings, no host round-trip
+                snap_states = jax.tree.map(jnp.copy, engine.states)
+                snap_host = engine.host.copy()
+            t0 = time.time()
+            results, schedule, keys = engine.run_schedule_chunk(round_index, k)
+            sec = (time.time() - t0) / k
+            done = k
+            for j, result in enumerate(results):
+                if bookkeep(result, sec):
+                    stopped = True
+                    done = j + 1
+                    if done < k:  # mid-chunk stop: rewind + replay prefix
+                        engine.states = snap_states
+                        engine.host = snap_host
+                        for jj in range(done):
+                            engine.run_round_fused(round_index + jj,
+                                                   selected=schedule[jj],
+                                                   key=keys[jj])
+                    break
+            if resume is not None:
+                resume.save(tag, engine.states, engine.host,
+                            round_index + done,
+                            tracking=np.concatenate(all_tracking, axis=1)
+                            if all_tracking else None)
+            round_index += k
+    else:
+        for round_index in range(start_round, cfg.num_rounds):
+            t0 = time.time()
+            result = engine.run_round(round_index)
+            sec = time.time() - t0
+            fired = bookkeep(result, sec)
+            if resume is not None:
+                resume.save(tag, engine.states, engine.host, round_index + 1,
+                            tracking=np.concatenate(all_tracking, axis=1)
+                            if all_tracking else None)
+            if fired:
+                break
 
     # final evaluation over every client (src/main.py:368-374)
     final_metrics = np.asarray(jax.device_get(engine.evaluate_all(
@@ -143,9 +211,12 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     if writer is not None and save_checkpoints and device_names:
         save_client_models(writer, run, model_type, update_type, device_names,
                            jax.device_get(engine.states.params))
-        if last_result is not None:
+        if all_tracking:
+            # full cross-round curve: the reference appends every epoch's
+            # (train, valid) loss across ALL rounds (client_trainer.py:405-419)
             save_training_tracking(writer, run, model_type, update_type,
-                                   device_names, last_result.tracking)
+                                   device_names,
+                                   np.concatenate(all_tracking, axis=1))
         if model_type == "hybrid":
             # LatentData pickles for the latent t-SNE notebook parity
             # (the reference reads these but never writes them — SURVEY §2 #10)

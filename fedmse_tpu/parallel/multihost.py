@@ -53,11 +53,26 @@ _initialized = False
 def _launcher_configured() -> bool:
     """True when the environment carries pod-launcher multihost config (so an
     init failure means a broken pod, not a laptop run)."""
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") or \
-            os.environ.get("COORDINATOR_ADDRESS"):
-        return True
+    for var in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                "MEGASCALE_COORDINATOR_ADDRESS"):
+        if os.environ.get(var):
+            return True
     hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     return len([h for h in hosts.split(",") if h.strip()]) > 1
+
+
+def _launcher_hinted() -> bool:
+    """True when weaker pod markers are present (GKE/TPU-VM injected env that
+    *suggests* a multi-process launch without carrying coordinator config).
+    Not enough to raise on — TPU_WORKER_ID=0 exists on single-host TPU-VMs —
+    but enough that a swallowed init failure deserves a WARNING, because the
+    alternative failure mode is silently training disjoint per-host
+    federations."""
+    for var in ("TPU_WORKER_ID", "CLOUD_TPU_TASK_ID", "MEGASCALE_NUM_SLICES",
+                "MEGASCALE_SLICE_ID", "NUM_PROCESSES"):
+        if os.environ.get(var):
+            return True
+    return False
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -87,4 +102,13 @@ def initialize(coordinator_address: Optional[str] = None,
     except Exception as e:
         if explicit:
             raise  # configured pod that failed to join: surface it
-        logger.info("multihost init skipped (%s); running single-process", e)
+        if _launcher_hinted():
+            logger.warning(
+                "multihost init FAILED (%s) on a host with pod-launcher env "
+                "markers; running single-process. If this is a pod launch, "
+                "each host is now training a DISJOINT federation — set "
+                "JAX_COORDINATOR_ADDRESS (or pass coordinator_address) and "
+                "relaunch.", e)
+        else:
+            logger.info("multihost init skipped (%s); running single-process",
+                        e)

@@ -32,13 +32,88 @@ def test_prep_noniid_shards_are_skewed(tmp_path):
     from fedmse_tpu.data.loader import load_data
     src, out = str(tmp_path / "src"), str(tmp_path / "out")
     _write_client_csvs(src, 4, dim=5, n_normal=100, n_abnormal=20)
-    create_federated_shards(src, out, n_clients=4, mode="noniid",
-                            alpha=0.1, seed=0)
+    js = create_federated_shards(src, out, n_clients=4, mode="noniid",
+                                 alpha=0.1, seed=0)
     sizes = [len(load_data(os.path.join(out, f"Client-{k}", "normal")))
              for k in range(1, 5)]
-    assert sum(sizes) == 400
-    # alpha=0.1 must produce strong quantity skew
+    # the notebook's <10-rows-per-class filter (cells 26/30/37) may drop a
+    # few minority-class rows; everything else must survive the partition
+    assert 300 <= sum(sizes) <= 400
+    # alpha=0.1 must produce strong quantity skew, reported as JS distance
     assert max(sizes) - min(sizes) > 30
+    assert js["normal"] > 0.4
+
+
+def test_prep_alpha_controls_js_distance(tmp_path):
+    """--alpha maps onto non-IID severity exactly like FedArtML's dirichlet
+    alpha: big alpha ~ IID (JS -> 0), small alpha ~ strong label skew."""
+    from fedmse_tpu.data.prep import create_federated_shards
+    src = str(tmp_path / "src")
+    _write_client_csvs(src, 6, dim=5, n_normal=200, n_abnormal=30)
+    js_iid = create_federated_shards(src, str(tmp_path / "a"), n_clients=6,
+                                     mode="noniid", alpha=1000.0, seed=0)
+    js_skew = create_federated_shards(src, str(tmp_path / "b"), n_clients=6,
+                                      mode="noniid", alpha=0.2, seed=0)
+    assert js_iid["normal"] < 0.25
+    assert js_skew["normal"] > js_iid["normal"] + 0.2
+
+
+def _write_raw_device_tree(root, n_devices, dim=5, n_benign=400,
+                           n_attack=600):
+    """Raw N-BaIoT-style layout: <root>/<dev>/normal/*benign*.csv +
+    <root>/<dev>/abnormal/{mirai,gafgyt}*.csv, WITH headers (the raw
+    downloads have them; only the sharded outputs are headerless)."""
+    import pandas as pd
+    rng = np.random.default_rng(7)
+    cols = [f"f{j}" for j in range(dim)]
+    for i in range(n_devices):
+        dev = os.path.join(root, f"Device_{i}")
+        os.makedirs(os.path.join(dev, "normal"), exist_ok=True)
+        os.makedirs(os.path.join(dev, "abnormal"), exist_ok=True)
+        pd.DataFrame(rng.normal(i, 1, (n_benign, dim)), columns=cols).to_csv(
+            os.path.join(dev, "normal", "benign_traffic.csv"), index=False)
+        pd.DataFrame(rng.normal(i + 5, 1, (n_attack, dim)),
+                     columns=cols).to_csv(
+            os.path.join(dev, "abnormal", "mirai_udp.csv"), index=False)
+        pd.DataFrame(rng.normal(i + 6, 1, (n_attack, dim)),
+                     columns=cols).to_csv(
+            os.path.join(dev, "abnormal", "gafgyt_tcp.csv"), index=False)
+
+
+def test_prep_raw_ingest(tmp_path):
+    """Raw per-device ingestion reproduces the notebook protocol: fractional
+    per-file sampling, 40% test_normal holdout, and a federation the data
+    layer can consume (Data-Examination.ipynb cells 5/14, VERDICT r1 #4)."""
+    from fedmse_tpu.config import DatasetConfig, ExperimentConfig
+    from fedmse_tpu.data import prepare_clients
+    from fedmse_tpu.data.loader import load_data
+    from fedmse_tpu.data.prep import create_federated_shards, pool_raw_devices
+
+    raw, out = str(tmp_path / "raw"), str(tmp_path / "out")
+    _write_raw_device_tree(raw, 4, n_benign=500, n_attack=400)
+
+    pooled = pool_raw_devices(raw, benign_frac=0.2, abnormal_frac=0.1,
+                              holdout_frac=0.4, seed=42)
+    n_norm, n_ab, n_test = (len(pooled[s][0])
+                            for s in ("normal", "abnormal", "test_normal"))
+    # 20% of 4x500 benign = 400, then 40% held out as test_normal
+    assert n_norm + n_test == 4 * 100
+    assert n_test == int(0.4 * 400)
+    assert n_ab == 4 * 2 * 40  # 10% of each of the 8 attack files
+    # origin labels span the devices
+    assert set(np.unique(pooled["normal"][1])) == {0, 1, 2, 3}
+
+    create_federated_shards(None, out, n_clients=5, mode="noniid", alpha=0.5,
+                            seed=42, raw_dir=raw, benign_frac=0.2,
+                            abnormal_frac=0.1)
+    assert sorted(os.listdir(out))[0] == "Client-1"
+    ds = DatasetConfig.for_client_dirs(out, 5)
+    cfg = ExperimentConfig(dim_features=5, network_size=5)
+    clients = prepare_clients(ds, cfg, np.random.default_rng(0))
+    assert len(clients) == 5
+    # test_normal shards exist and are disjoint from normal (holdout)
+    tn = load_data(os.path.join(out, "Client-1", "test_normal"))
+    assert len(tn) > 0
 
 
 def test_prep_roundtrips_into_pipeline(tmp_path):

@@ -109,3 +109,126 @@ def test_global_early_stop_inverted_compat(dataset_dir, tmp_path):
     assert out["results"]["hybrid/avg/run0"]["round_times"], "ran at least 1 round"
     # it must have stopped early at SOME point under the inverted rule
     assert len(out["results"]["hybrid/avg/run0"]["round_times"]) <= 8
+
+
+def test_fused_schedule_matches_per_round(dataset_dir, tmp_path):
+    """--fused-schedule (whole-schedule lax.scan in chunks, VERDICT r1 #7)
+    must produce the same rounds, metrics, and artifacts as the per-round
+    path — including when early stopping fires mid-chunk (rewind+replay)."""
+    root, cfg_path = dataset_dir
+    common = [
+        "--dataset-config", cfg_path,
+        "--model-types", "hybrid", "--update-types", "avg",
+        "--network-size", "4", "--dim-features", str(DIM),
+        "--epochs", "2", "--num-rounds", "5", "--batch-size", "8",
+        "--no-save",
+    ]
+    out_a = cli_main(common + ["--checkpoint-dir", str(tmp_path / "a"),
+                               "--experiment-name", "sched_a"])
+    out_b = cli_main(common + ["--checkpoint-dir", str(tmp_path / "b"),
+                               "--experiment-name", "sched_b",
+                               "--fused-schedule", "true",
+                               "--fused-schedule-chunk", "2"])
+    ra = out_a["results"]["hybrid/avg/run0"]
+    rb = out_b["results"]["hybrid/avg/run0"]
+    assert len(ra["round_times"]) == len(rb["round_times"])  # same stop round
+    # rtol matches the documented scan-vs-per-round equivalence (config.py:
+    # XLA may reorder float ops between the two compilations)
+    np.testing.assert_allclose(ra["final_metrics"], rb["final_metrics"],
+                               rtol=1e-4)
+
+    def rows(d, exp):
+        path = glob.glob(os.path.join(d, "Results", "Update", "4", exp,
+                                      "Run_0", "AUC", "*.json"))[0]
+        return [json.loads(l) for l in open(path)]
+
+    rows_a = rows(str(tmp_path / "a"), "sched_a")
+    rows_b = rows(str(tmp_path / "b"), "sched_b")
+    assert [r["round"] for r in rows_a] == [r["round"] for r in rows_b]
+    for qa, qb in zip(rows_a, rows_b):
+        np.testing.assert_allclose(qa["client_metrics"], qb["client_metrics"],
+                                   rtol=1e-4)
+
+
+def test_compat_flags_reach_cli():
+    """Every CompatConfig quirk switch is CLI-flippable (VERDICT r1 #9)."""
+    import dataclasses as dc
+
+    from fedmse_tpu.config import (CompatConfig, add_cli_overrides,
+                                   apply_cli_overrides)
+    import argparse
+
+    for f in dc.fields(CompatConfig):
+        p = argparse.ArgumentParser()
+        add_cli_overrides(p)
+        flag = "--compat-" + f.name.replace("_", "-")
+        args = p.parse_args([flag, "false"])
+        cfg = apply_cli_overrides(ExperimentConfig(), args)
+        assert getattr(cfg.compat, f.name) is False, f.name
+        # untouched flags keep their quirk-mode defaults
+        others = [g.name for g in dc.fields(CompatConfig) if g.name != f.name]
+        assert all(getattr(cfg.compat, o) == getattr(CompatConfig(), o)
+                   for o in others)
+
+
+def test_compat_quirk6_changes_verification_data(dataset_dir):
+    """Fixed mode vs quirk mode diverge where expected: quirk 6 off gives
+    each client its OWN valid split as verification data instead of the
+    last client's (src/main.py:264)."""
+    import jax.numpy as jnp
+
+    from fedmse_tpu.config import CompatConfig
+    from fedmse_tpu.data import (build_dev_dataset, prepare_clients,
+                                 stack_clients)
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    root, cfg_path = dataset_dir
+    ds = DatasetConfig.from_json(cfg_path)
+    cfg = ExperimentConfig(dim_features=DIM, network_size=4, epochs=1,
+                           num_rounds=1, batch_size=8)
+    rngs = ExperimentRngs(run=0)
+    clients = prepare_clients(ds, cfg, rngs.data_rng)
+    data = stack_clients(clients, build_dev_dataset(clients, rngs.data_rng),
+                         cfg.batch_size)
+    model = make_model("hybrid", DIM)
+
+    def ver_x(compat):
+        e = RoundEngine(model, cfg.replace(compat=compat), data, n_real=4,
+                        rngs=ExperimentRngs(run=0), model_type="hybrid",
+                        update_type="avg")
+        return e._ver_x
+
+    quirk = ver_x(CompatConfig())
+    fixed = ver_x(CompatConfig(shared_last_client_val=False))
+    # quirk mode: every client sees the LAST client's valid split
+    assert jnp.allclose(quirk[0], quirk[3])
+    # fixed mode: clients see their own (different) splits
+    assert not jnp.allclose(fixed[0], fixed[3])
+
+
+def test_checkpoint_tracking_roundtrip(tmp_path):
+    """Resume keeps the pre-kill training curve so training_tracking.pkl
+    covers ALL rounds, not just post-resume ones (VERDICT r1 #8)."""
+    import jax
+    import optax
+
+    from fedmse_tpu.checkpointing import CheckpointManager
+    from fedmse_tpu.federation.state import HostState, init_client_states
+    from fedmse_tpu.models import make_model
+
+    model = make_model("hybrid", DIM)
+    states = init_client_states(model, optax.adam(1e-3), jax.random.key(0), 3)
+    host = HostState.create(3)
+    curve = np.arange(3 * 4 * 3, dtype=np.float32).reshape(3, 4, 3)
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save("t", states, host, 2, tracking=curve)
+    _, _, rnd, restored = mgr.restore("t", states)
+    assert rnd == 2
+    np.testing.assert_array_equal(restored, curve)
+
+    # tracking is optional: a save without it restores None
+    mgr.save("u", states, host, 1)
+    assert mgr.restore("u", states)[3] is None
