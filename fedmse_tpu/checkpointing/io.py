@@ -176,6 +176,10 @@ class CheckpointManager:
             # complete over a kill/resume (its shape varies with rounds run,
             # so it rides outside the fixed-shape Orbax payload)
             np.savez(self._path(tag) + ".tracking.npz", tracking=tracking)
+        elif os.path.exists(self._path(tag) + ".tracking.npz"):
+            # a stale curve from an earlier checkpoint of this tag must not
+            # be restored against a newer round_index
+            os.remove(self._path(tag) + ".tracking.npz")
 
     def restore(self, tag: str, states_like: ClientStates):
         """Returns (states, host, round_index, tracking). `states_like`

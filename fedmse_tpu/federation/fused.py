@@ -101,25 +101,31 @@ def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
 
 def make_round_body(train_all: Callable, scores_fn: Callable,
                     aggregate: Callable, verify: Callable,
-                    evaluate_all: Callable, data, ver_x: jax.Array,
-                    ver_m: jax.Array, max_threshold: int,
+                    evaluate_all: Callable, max_threshold: int,
                     poison_fn: Optional[Callable] = None) -> Callable:
     """Build the traceable round body (jit-wrapped by make_fused_round,
     scanned directly by make_fused_rounds_scan):
 
-    fn(states, sel_indices [S], sel_mask [N], agg_count [N], rng, round_index)
+    fn(states, data, ver_x [N,V,D], ver_m [N,V], sel_indices [S],
+       sel_mask [N], agg_count [N], rng, round_index)
       -> (states, agg_count, FusedRoundOut)
+
+    `data` (FederatedData) and the verification tensors are ARGUMENTS, not
+    closure captures: jit treats closed-over arrays as compile-time
+    constants, which is both a copy per compilation and — on a
+    multi-controller mesh — an error, since globally-sharded arrays span
+    non-addressable devices and cannot be baked into the program.
 
     `poison_fn(agg_params, round_index, rng)`, when given, tampers with the
     aggregated model between aggregation and broadcast — the malicious-
     aggregator threat the verification subsystem defends against
     (federation/attack.py).
     """
-    n_pad = data.num_clients_padded
-    client_ids = jnp.arange(n_pad)
 
-    def round_body(states: ClientStates, sel_indices, sel_mask, agg_count,
-                   rng, round_index):
+    def round_body(states: ClientStates, data, ver_x, ver_m, sel_indices,
+                   sel_mask, agg_count, rng, round_index):
+        n_pad = data.num_clients_padded
+        client_ids = jnp.arange(n_pad)
         # ---- local training of the selected cohort (src/main.py:276-279) ----
         params, opt_state, best_params, min_valid, tracking = train_all(
             states.params, states.opt_state, states.prev_global, sel_mask,
@@ -180,7 +186,8 @@ def make_fused_rounds_scan(*args) -> Callable:
     """Build the whole-schedule runner: `lax.scan` of the raw round body over
     a precomputed selection schedule.
 
-    fn(states, sel_schedule [R, S], sel_masks [R, N], agg_count [N], keys [R])
+    fn(states, data, ver_x, ver_m, sel_schedule [R, S], sel_masks [R, N],
+       agg_count [N], keys [R])
       -> (states, agg_count, FusedRoundOut stacked on a leading [R] axis)
 
     `keys` is one PRNG key per round, drawn from the SAME host stream the
@@ -193,12 +200,13 @@ def make_fused_rounds_scan(*args) -> Callable:
     round_body = make_round_body(*args)
 
     @partial(jax.jit, donate_argnums=(0,))
-    def run_all(states: ClientStates, sel_schedule, sel_masks, agg_count,
-                keys, round_indices):
+    def run_all(states: ClientStates, data, ver_x, ver_m, sel_schedule,
+                sel_masks, agg_count, keys, round_indices):
         def step(carry, xs):
             states, agg_count = carry
             sel_indices, sel_mask, key, round_index = xs
-            states, agg_count, out = round_body(states, sel_indices, sel_mask,
+            states, agg_count, out = round_body(states, data, ver_x, ver_m,
+                                                sel_indices, sel_mask,
                                                 agg_count, key, round_index)
             return (states, agg_count), out
 

@@ -40,6 +40,7 @@ from fedmse_tpu.federation.local_training import make_local_train_all
 from fedmse_tpu.federation.state import ClientStates, HostState, init_client_states
 from fedmse_tpu.federation.verification import make_verify_fn
 from fedmse_tpu.federation.voting import elect_aggregator, make_mse_scores_fn
+from fedmse_tpu.parallel.mesh import host_fetch
 from fedmse_tpu.utils.logging import get_logger
 from fedmse_tpu.utils.seeding import ExperimentRngs
 
@@ -107,9 +108,11 @@ class RoundEngine:
     def _build_fused(self):
         from fedmse_tpu.federation.fused import (make_fused_round,
                                                  make_fused_rounds_scan)
+        # data / verification tensors are passed at CALL time (sharded
+        # global arrays must be jit arguments, not closure constants)
         args = (self.train_all, self.scores_fn, self.aggregate, self.verify,
-                self.evaluate_all, self.data, self._ver_x, self._ver_m,
-                self.cfg.max_aggregation_threshold, self.poison_fn)
+                self.evaluate_all, self.cfg.max_aggregation_threshold,
+                self.poison_fn)
         self._fused_round = make_fused_round(*args)
         self._fused_scan = make_fused_rounds_scan(*args)
 
@@ -144,7 +147,7 @@ class RoundEngine:
     def _fused_result(self, round_index: int, selected: List[int],
                       out) -> RoundResult:
         """Host bookkeeping + RoundResult from a FusedRoundOut bundle."""
-        out = jax.device_get(out)
+        out = host_fetch(out)  # multi-process-safe (parallel/mesh.py)
         aggregator = int(out.aggregator)
         rejected = np.asarray(out.rejected)
         verification_rows: List[Dict] = []
@@ -212,7 +215,8 @@ class RoundEngine:
             key = self.rngs.next_jax()
         sel_indices, sel_mask = self._selection_arrays(selected)
         self.states, _, out = self._fused_round(
-            self.states, jnp.asarray(sel_indices), jnp.asarray(sel_mask),
+            self.states, self.data, self._ver_x, self._ver_m,
+            jnp.asarray(sel_indices), jnp.asarray(sel_mask),
             self._agg_count_padded(), key,
             jnp.asarray(round_index, jnp.int32))
         return self._fused_result(round_index, selected, out)
@@ -234,10 +238,10 @@ class RoundEngine:
         sel_idx = jnp.asarray(np.stack([a[0] for a in arrays]))
         masks = jnp.asarray(np.stack([a[1] for a in arrays]))
         self.states, _, outs = self._fused_scan(
-            self.states, sel_idx, masks, self._agg_count_padded(),
-            jnp.stack(keys),
+            self.states, self.data, self._ver_x, self._ver_m, sel_idx, masks,
+            self._agg_count_padded(), jnp.stack(keys),
             jnp.arange(start_round, start_round + n_rounds, dtype=jnp.int32))
-        outs = jax.device_get(outs)
+        outs = host_fetch(outs)  # multi-process-safe (parallel/mesh.py)
         results = [self._fused_result(start_round + r, schedule[r],
                                       jax.tree.map(lambda t: t[r], outs))
                    for r in range(n_rounds)]
@@ -277,7 +281,7 @@ class RoundEngine:
         vote_m = data.valid_m[selected[0]]   # split (src/main.py:285)
 
         def fresh_scores() -> np.ndarray:
-            return np.asarray(jax.device_get(self.scores_fn(
+            return np.asarray(host_fetch(self.scores_fn(
                 self.states.params, vote_x, vote_m, self.rngs.next_jax())))
 
         with self.timer.phase("vote"):
@@ -296,7 +300,7 @@ class RoundEngine:
                     agg_params = self.poison_fn(
                         agg_params, jnp.asarray(round_index, jnp.int32),
                         self.rngs.next_jax())
-                agg_weights = np.asarray(jax.device_get(weights))
+                agg_weights = np.asarray(host_fetch(weights))
             self.host.aggregation_count[aggregator] += 1
             self.host.rounds_aggregated.append((round_index, aggregator))
 
@@ -307,7 +311,7 @@ class RoundEngine:
                                       self._ver_m, jnp.asarray(agg_onehot),
                                       data.client_mask)
                 self.states = outcome.states
-                rejected = np.asarray(jax.device_get(self.states.rejected))
+                rejected = np.asarray(host_fetch(self.states.rejected))
             for i in range(self.n_real):
                 if i != aggregator:
                     # reference rows (src/main.py:304-312): is_verified is the
@@ -325,7 +329,7 @@ class RoundEngine:
 
         # ---- evaluation of every client (src/main.py:333-339) ----
         with self.timer.phase("evaluate"):
-            metrics = np.asarray(jax.device_get(self.evaluate_all(
+            metrics = np.asarray(host_fetch(self.evaluate_all(
                 self.states.params, data.test_x, data.test_m, data.test_y,
                 data.train_xb, data.train_mb)))[: self.n_real]
 
@@ -337,6 +341,6 @@ class RoundEngine:
             verification_results=verification_rows,
             mse_scores=None if scores is None else np.asarray(scores)[: self.n_real],
             agg_weights=agg_weights,
-            tracking=np.asarray(jax.device_get(tracking))[: self.n_real],
-            min_valid=np.asarray(jax.device_get(min_valid))[: self.n_real],
+            tracking=np.asarray(host_fetch(tracking))[: self.n_real],
+            min_valid=np.asarray(host_fetch(min_valid))[: self.n_real],
         )

@@ -41,7 +41,8 @@ from fedmse_tpu.checkpointing import (CheckpointManager, ResultsWriter,
 from fedmse_tpu.data import build_dev_dataset, prepare_clients, stack_clients
 from fedmse_tpu.federation import RoundEngine
 from fedmse_tpu.models import make_model
-from fedmse_tpu.parallel import client_mesh, pad_to_multiple, shard_federation
+from fedmse_tpu.parallel import (client_mesh, host_fetch, pad_to_multiple,
+                                 shard_federation)
 from fedmse_tpu.utils.logging import get_logger
 from fedmse_tpu.utils.seeding import ExperimentRngs
 
@@ -203,14 +204,14 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
                 break
 
     # final evaluation over every client (src/main.py:368-374)
-    final_metrics = np.asarray(jax.device_get(engine.evaluate_all(
+    final_metrics = np.asarray(host_fetch(engine.evaluate_all(
         engine.states.params, engine.data.test_x, engine.data.test_m,
         engine.data.test_y, engine.data.train_xb,
         engine.data.train_mb)))[:n_real]
 
     if writer is not None and save_checkpoints and device_names:
         save_client_models(writer, run, model_type, update_type, device_names,
-                           jax.device_get(engine.states.params))
+                           host_fetch(engine.states.params))
         if all_tracking:
             # full cross-round curve: the reference appends every epoch's
             # (train, valid) loss across ALL rounds (client_trainer.py:405-419)
@@ -221,11 +222,11 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
             # LatentData pickles for the latent t-SNE notebook parity
             # (the reference reads these but never writes them — SURVEY §2 #10)
             from fedmse_tpu.visualization import save_latent_data
-            latents = jax.device_get(jax.jit(jax.vmap(
+            latents = host_fetch(jax.jit(jax.vmap(
                 lambda p, x: model.apply({"params": p}, x)[0]))(
                     engine.states.params, engine.data.test_x))
-            mask = np.asarray(jax.device_get(engine.data.test_m)) > 0
-            labels = np.asarray(jax.device_get(engine.data.test_y))
+            mask = np.asarray(host_fetch(engine.data.test_m)) > 0
+            labels = np.asarray(host_fetch(engine.data.test_y))
             lat = np.concatenate([latents[i][mask[i]] for i in range(n_real)])
             lab = np.concatenate([labels[i][mask[i]] for i in range(n_real)])
             save_latent_data(

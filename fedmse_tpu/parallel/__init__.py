@@ -1,5 +1,6 @@
 from fedmse_tpu.parallel.mesh import (
     client_mesh,
+    host_fetch,
     pad_to_multiple,
     replicate,
     shard_clients,
@@ -10,6 +11,7 @@ from fedmse_tpu.parallel.multihost import initialize as initialize_multihost
 
 __all__ = [
     "client_mesh",
+    "host_fetch",
     "initialize_multihost",
     "make_shardmap_aggregate",
     "pad_to_multiple",

@@ -77,6 +77,32 @@ def replicate(tree: Any, mesh: Mesh) -> Any:
         lambda leaf: _place(leaf, NamedSharding(mesh, P())), tree)
 
 
+def host_fetch(tree: Any) -> Any:
+    """Fetch device values to host numpy, multi-process-safe.
+
+    Single-process: plain `device_get`. Multi-controller: the round outputs
+    are sharded over the pod-spanning mesh, so shards on other hosts are not
+    addressable here — `process_allgather` reassembles the global value on
+    every host (each host contributes its shards over the collective
+    fabric). Every process receives the identical full array, which keeps
+    the host-side control plane (election bookkeeping, early stopping)
+    deterministic across the pod."""
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+
+    def fetch(leaf):
+        # only non-fully-addressable global arrays need the collective;
+        # host numpy / local arrays take the plain path (process_allgather
+        # would STACK host data across processes — wrong shape)
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return np.asarray(multihost_utils.process_allgather(leaf,
+                                                                tiled=True))
+        return np.asarray(jax.device_get(leaf))
+
+    return jax.tree.map(fetch, tree)
+
+
 def shard_federation(data, states, mesh: Mesh, axis_name: str = "clients"):
     """Shard a FederatedData + ClientStates pair onto the mesh.
 

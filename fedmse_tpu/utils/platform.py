@@ -1,0 +1,56 @@
+"""Platform pinning for hermetic CPU runs.
+
+This container's sitecustomize force-registers the axon TPU tunnel backend in
+EVERY python process, and merely initializing a backend (any `jax.devices()`
+call) can hang for minutes when the tunnel is wedged — even under
+`JAX_PLATFORMS=cpu`. Tests, the multi-chip dryrun, and multihost workers are
+pure CPU-mesh programs that must never touch the tunnel; they all pin the
+platform through this one helper so the jax-private API it leans on
+(`xla_bridge._backend_factories`, pinned to jax 0.9.x) has a single home.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def force_cpu_platform(n_devices: Optional[int] = None) -> None:
+    """Pin this process to the CPU backend BEFORE any backend initializes;
+    optionally re-init with `n_devices` virtual CPU devices.
+
+    Safe to call late: if a backend already exists (the caller touched jax
+    first) it is dropped and re-created on CPU. Raises RuntimeError only when
+    a virtual device count was requested and could not be realized."""
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:  # private APIs, pinned to jax 0.9.x; guarded for future upgrades
+        import jax._src.xla_bridge as xb
+
+        xb._backend_factories.pop("axon", None)  # the sitecustomize tunnel
+        if xb._backends:  # caller already initialized a backend: drop it so
+            from jax._src import api  # the CPU pin below takes effect
+
+            api.clear_backends()
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+
+    if n_devices is None or len(jax.devices()) >= n_devices:
+        return
+    try:  # too few CPU devices: re-init the CPU client with n virtual ones
+        from jax._src import api
+
+        api.clear_backends()  # must precede the device-count config update
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except Exception as e:
+        raise RuntimeError(
+            f"could not switch to {n_devices} virtual CPU devices in-process "
+            f"({e!r}); launch with JAX_PLATFORMS=cpu "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices} "
+            f"and the axon sitecustomize disabled") from e
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"requested {n_devices} virtual CPU devices, got "
+            f"{len(jax.devices())}")

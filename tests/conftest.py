@@ -11,7 +11,6 @@ we deregister the axon backend factory before any backend is initialized.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,11 +20,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
-import jax  # noqa: E402
-import jax._src.xla_bridge as _xb  # noqa: E402
+from fedmse_tpu.utils.platform import force_cpu_platform  # noqa: E402
 
-_xb._backend_factories.pop("axon", None)  # sitecustomize-registered TPU tunnel
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform()  # deregister the sitecustomize TPU tunnel pre-init
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,86 @@
+"""Worker process for the 2-process multi-controller (DCN-path) validation.
+
+Launched twice by tests/test_parallel.py::test_two_process_federation with
+process ids 0 and 1. Each process joins a localhost coordinator via
+`fedmse_tpu.parallel.initialize_multihost` (the same entry `fedmse_tpu.main`
+calls on pod hosts), contributes 4 virtual CPU devices to an 8-device global
+`clients` mesh, and runs ONE full federated round over the pod-spanning mesh
+— local training, election, aggregation all-reduce (the DCN collective),
+verification, evaluation — asserting identical, finite results on both
+processes. This exercises exactly the multi-process code paths that degrade
+to no-ops on one host: `jax.distributed.initialize`,
+`make_array_from_process_local_data` placement (parallel/mesh.py:_place) and
+`host_fetch`'s `process_allgather` reassembly.
+
+Usage: multihost_worker.py <coordinator_port> <process_id>
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from fedmse_tpu.utils.platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform()  # no device count: backends must not init before
+# jax.distributed.initialize below
+
+import jax  # noqa: E402
+
+
+def main() -> None:
+    port, pid = sys.argv[1], int(sys.argv[2])
+
+    from fedmse_tpu.parallel import initialize_multihost
+    initialize_multihost(coordinator_address=f"localhost:{port}",
+                         num_processes=2, process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+    import numpy as np
+
+    from fedmse_tpu.config import ExperimentConfig
+    from fedmse_tpu.data import (build_dev_dataset, stack_clients,
+                                 synthetic_clients)
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.parallel import client_mesh, shard_federation
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    dim, n_real = 8, 8
+    cfg = ExperimentConfig(dim_features=dim, network_size=n_real, epochs=1,
+                           num_rounds=1, batch_size=4)
+    rngs = ExperimentRngs(run=0)
+    # deterministic in the PRNG keys => every process builds identical
+    # host-side state before placement (parallel/multihost.py docstring)
+    clients = synthetic_clients(n_clients=n_real, dim=dim, n_normal=40,
+                                n_abnormal=16)
+    dev_x = build_dev_dataset(clients, rngs.data_rng)
+    data = stack_clients(clients, dev_x, cfg.batch_size, pad_clients_to=8)
+
+    mesh = client_mesh()  # all 8 global devices: spans both processes
+    assert mesh.devices.size == 8
+    model = make_model("hybrid", dim, shrink_lambda=cfg.shrink_lambda)
+    engine = RoundEngine(model, cfg, data, n_real=n_real, rngs=rngs,
+                         model_type="hybrid", update_type="mse_avg",
+                         fused=True)
+    engine.data, engine.states = shard_federation(data, engine.states, mesh)
+    engine._ver_x, engine._ver_m = engine._verification_tensors()
+
+    result = engine.run_round(0)
+    metrics = np.asarray(result.client_metrics)
+    assert metrics.shape == (n_real,), metrics.shape
+    assert np.all(np.isfinite(metrics)), metrics
+    assert result.aggregator is not None
+    # the host control plane must agree across processes (same seeds, same
+    # allgathered device results) — print for the parent to cross-check
+    print(f"MULTIHOST_OK pid={pid} agg={result.aggregator} "
+          f"mean={float(np.nanmean(metrics)):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -61,3 +61,19 @@ def test_fused_forward_odd_row_count(setup):
                                     mode="interpret")
     np.testing.assert_allclose(np.asarray(lat),
                                np.asarray(latent_ref[:513]), atol=1e-5)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="mode='pallas' lowers Mosaic TPU-only; the CPU "
+                           "suite covers interpret mode. Run tpu_check.py on "
+                           "hardware (writes TPU_CHECK.json).")
+def test_fused_forward_pallas_on_tpu(setup):
+    """The REAL Pallas lowering must match flax on hardware (VERDICT r1 #6)."""
+    model, params, x, latent_ref, recon_ref = setup
+    latent, mse, znorm = fused_forward_stats(params, x, latent_dim=LAT,
+                                             mode="pallas")
+    np.testing.assert_allclose(np.asarray(latent), np.asarray(latent_ref),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(mse),
+                               np.asarray(per_sample_mse(x, recon_ref)),
+                               atol=1e-4)

@@ -2,6 +2,8 @@
 must be numerically identical to single-device execution, and the explicit
 shard_map collective path must match auto-partitioning (SURVEY.md §5.8)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -154,3 +156,44 @@ def test_full_round_on_global_mesh():
     res = eng.run_round(0)
     assert res.client_metrics.shape == (n,)
     assert np.all(np.isfinite(res.client_metrics))
+
+
+def test_two_process_federation():
+    """Real multi-controller run: two local processes join a localhost
+    coordinator (jax.distributed DCN path, VERDICT r1 #10), build one global
+    8-device mesh (4 virtual CPU devices each), and complete a full federated
+    round with identical results — validating initialize_multihost,
+    make_array_from_process_local_data placement, and host_fetch's
+    process_allgather, which single-process tests only exercise in
+    degradation."""
+    import re
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:  # free localhost port for the coordinator
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen([sys.executable, worker, str(port), str(pid)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+        assert p.returncode == 0, out[-2000:]
+    results = [re.search(r"MULTIHOST_OK pid=\d+ (agg=\d+ mean=[\d.]+)", o)
+               for o in outs]
+    assert all(results), [o[-500:] for o in outs]
+    # both processes computed the identical global round
+    assert results[0].group(1) == results[1].group(1)
