@@ -110,7 +110,15 @@ def main():
     from fedmse_tpu.utils.seeding import ExperimentRngs
 
     fused = "--unfused" not in sys.argv
-    fused_eval = "pallas" if "--pallas" in sys.argv else "off"
+    fused_eval = "off"
+    if "--pallas" in sys.argv:
+        fused_eval = "pallas"
+    elif "--fused-eval" in sys.argv:
+        idx = sys.argv.index("--fused-eval") + 1
+        fused_eval = sys.argv[idx] if idx < len(sys.argv) else ""
+        if fused_eval not in ("off", "auto", "pallas", "xla"):
+            sys.exit(f"--fused-eval expects off|auto|pallas|xla, "
+                     f"got {fused_eval!r}")
     cfg = ExperimentConfig(fused_eval=fused_eval)  # reference quick-run defaults
     data, n_real, rngs = build_data(cfg)
 
@@ -168,11 +176,25 @@ def main():
         "auc_baseline_std": BASELINE_AUC_STD,
         "baseline_sec_per_round": BASELINE_SEC_PER_ROUND,
         "baseline_source": "reference torch run on this machine's CPU",
+        # ADVICE r2: make the artifact self-describing — the ratio is
+        # TPU-vs-torch-CPU; the north star's ">=8x vs single-GPU" basis
+        # cannot be measured in this environment (no GPU exists here).
+        "baseline_platform": "cpu",
+        "baseline_note": "no GPU in this environment; vs_baseline is "
+                         "TPU/torch-CPU on identical workload",
         "device": str(device),
         "platform": device.platform,
         "mode": "fused-scan" if fused else "per-phase",
         "fused_eval": fused_eval,
     }
+    if fused_eval == "off":
+        # Measured r3 on v5e (DESIGN.md §3, TPU_CHECK.json): the packed
+        # fused-forward routes lose at whole-round level (0.096 s/round
+        # pallas, 0.215 s xla-packed vs 0.029 s plain vmapped apply), so
+        # off IS the fastest configuration, not an unexercised default.
+        out["fused_eval_note"] = ("off is fastest at round level; pallas "
+                                  "wins only in isolation — see DESIGN.md "
+                                  "§3 and TPU_CHECK.json")
     reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
     if reason and reason != "1":
         out["tpu_fallback_reason"] = reason

@@ -55,10 +55,14 @@ def test_fused_forward_vmaps_over_clients(setup):
 
 
 def test_fused_forward_odd_row_count(setup):
-    """Row padding to the block size must not leak into results."""
+    """Row padding to the block size must not leak into results.
+
+    block_rows is pinned to 512 so 513 rows genuinely span a block boundary
+    (two grid steps + ragged last block) regardless of the shipped
+    BLOCK_ROWS default."""
     model, params, x, latent_ref, _ = setup
     lat, _, _ = fused_forward_stats(params, x[:513], latent_dim=LAT,
-                                    mode="interpret")
+                                    mode="interpret", block_rows=512)
     np.testing.assert_allclose(np.asarray(lat),
                                np.asarray(latent_ref[:513]), atol=1e-5)
 

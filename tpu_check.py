@@ -39,13 +39,30 @@ def probe(timeout_s: int = 150) -> None:
                            f"{r.stderr.decode(errors='replace')[-300:]}")
 
 
-def timed(fn, *args) -> float:
-    fn(*args)[0].block_until_ready()  # compile
+TRIALS = 5
+
+
+def timed_once(fn, *args) -> float:
     t0 = time.perf_counter()
     for _ in range(REPS):
         out = fn(*args)
     out[0].block_until_ready()
     return (time.perf_counter() - t0) / REPS
+
+
+def race(impls: dict, *args) -> dict:
+    """Interleaved min-of-TRIALS per implementation.
+
+    Dispatch through the axon tunnel is noisy at this workload size
+    (~1.5 ms/call); interleaving trials decorrelates slow drift and the min
+    is the standard latency estimator under one-sided noise."""
+    for fn in impls.values():
+        fn(*args)[0].block_until_ready()  # compile
+    best = {k: float("inf") for k in impls}
+    for _ in range(TRIALS):
+        for k, fn in impls.items():
+            best[k] = min(best[k], timed_once(fn, *args))
+    return best
 
 
 def main() -> None:
@@ -94,14 +111,73 @@ def main() -> None:
     xla = jax.jit(lambda p, v: fused_forward_stats(p, v, LAT, "xla"))
     pls = jax.jit(lambda p, v: fused_forward_stats(p, v, LAT, "pallas"))
 
-    out["sec_unfused_flax"] = round(timed(unfused, params, x), 6)
-    out["sec_xla_fused"] = round(timed(xla, params, x), 6)
-    out["sec_pallas"] = round(timed(pls, params, x), 6)
+    best = race({"unfused_flax": unfused, "xla_fused": xla, "pallas": pls},
+                params, x)
+    out["sec_unfused_flax"] = round(best["unfused_flax"], 6)
+    out["sec_xla_fused"] = round(best["xla_fused"], 6)
+    out["sec_pallas"] = round(best["pallas"], 6)
     out["pallas_vs_xla"] = round(out["sec_xla_fused"] / out["sec_pallas"], 3)
     out["pallas_vs_unfused"] = round(
         out["sec_unfused_flax"] / out["sec_pallas"], 3)
     out["rows"] = ROWS
     out["reps"] = REPS
+    out["trials"] = TRIALS
+    out["timing"] = "min over interleaved trials of REPS-call batches"
+
+    # -- device-only race: chain CHAIN iterations inside one dispatch so the
+    # tunnel's ~1.4 ms per-call latency (which dominates the numbers above)
+    # cancels out; what remains is actual on-chip compute per pass.
+    CHAIN = 200
+
+    def chained(one_pass):
+        @jax.jit
+        def run(p, v):
+            def body(acc, _):
+                # acc * 1e-30 is numerically a no-op on ~unit-scale inputs
+                # but makes each iteration depend on the previous one, so
+                # XLA cannot hoist the pass out of the scan.
+                mse = one_pass(p, v + acc * 1e-30)
+                return acc + jnp.sum(mse), None
+            acc, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=CHAIN)
+            return (acc,)
+        return run
+
+    impls = {
+        "unfused_flax": chained(
+            lambda p, v: per_sample_mse(v, model.apply({"params": p}, v)[1])),
+        "xla_fused": chained(
+            lambda p, v: fused_forward_stats(p, v, LAT, "xla")[1]),
+        "pallas": chained(
+            lambda p, v: fused_forward_stats(p, v, LAT, "pallas")[1]),
+    }
+    # block_rows sweep: the evidence behind ops/pallas_ae.py's shipped
+    # BLOCK_ROWS=4096 default ('pallas' above runs the shipped default).
+    for br in (256, 512, 1024, 2048):
+        impls[f"pallas_b{br}"] = chained(
+            lambda p, v, br=br: fused_forward_stats(p, v, LAT, "pallas",
+                                                    block_rows=br)[1])
+    dev = race(impls, params, x)
+    for k, v in dev.items():
+        out[f"device_us_{k}"] = round(v / CHAIN * 1e6, 2)
+    # per-client-size race (~4k test rows): shows the 4096 default also wins
+    # where the evaluator calls with ONE client's tensors.
+    xs = x[:4000]
+    devs = race({
+        "unfused_flax": chained(
+            lambda p, v: per_sample_mse(v, model.apply({"params": p}, v)[1])),
+        "xla_fused": chained(
+            lambda p, v: fused_forward_stats(p, v, LAT, "xla")[1]),
+        "pallas": chained(
+            lambda p, v: fused_forward_stats(p, v, LAT, "pallas")[1]),
+    }, params, xs)
+    for k, v in devs.items():
+        out[f"device_us_small_{k}"] = round(v / CHAIN * 1e6, 2)
+    out["small_rows"] = int(xs.shape[0])
+    out["device_pallas_vs_xla"] = round(
+        dev["xla_fused"] / dev["pallas"], 3)
+    out["device_pallas_vs_unfused"] = round(
+        dev["unfused_flax"] / dev["pallas"], 3)
+    out["chain"] = CHAIN
 
     with open(os.path.join(REPO_ROOT, "TPU_CHECK.json"), "w") as f:
         json.dump(out, f, indent=2)
