@@ -113,9 +113,14 @@ def main():
     fused_eval = "off"
     if "--pallas" in sys.argv:
         fused_eval = "pallas"
-    elif "--fused-eval" in sys.argv:
-        idx = sys.argv.index("--fused-eval") + 1
-        fused_eval = sys.argv[idx] if idx < len(sys.argv) else ""
+    elif any(a == "--fused-eval" or a.startswith("--fused-eval=")
+             for a in sys.argv):
+        if "--fused-eval" in sys.argv:
+            idx = sys.argv.index("--fused-eval") + 1
+            fused_eval = sys.argv[idx] if idx < len(sys.argv) else ""
+        else:
+            fused_eval = next(a.split("=", 1)[1] for a in sys.argv
+                              if a.startswith("--fused-eval="))
         if fused_eval not in ("off", "auto", "pallas", "xla"):
             sys.exit(f"--fused-eval expects off|auto|pallas|xla, "
                      f"got {fused_eval!r}")

@@ -126,6 +126,19 @@ class CompatConfig:
     # 1 + (U(0,1)-0.5)*2e-4. False => deterministic scores.
     vote_tie_break: bool = True
 
+    # Quirk 9 (src/main.py:121-124) has NO switch — intentionally
+    # unreproduced. The reference shadows its config-file path with the open
+    # file handle, so every combination after the first fails to re-open the
+    # config, swallows the exception, and silently reuses the stale dict; it
+    # only "works" because the config never changes mid-sweep. This driver
+    # prepares data once per sweep (fedmse_tpu/main.py), so there is no
+    # reload to get wrong and no behavior to toggle — reproducing it would
+    # mean adding a bug with no observable effect.
+
+    # Quirk 14 (Shrink_Autoencoder.py:134-135 / AutoEncoder.py:131-132), the
+    # dead misspelled `paramaeters()` helper, is likewise dropped: it is
+    # never called by any reference code path.
+
 
 @dataclasses.dataclass(frozen=True)
 class ExperimentConfig:

@@ -87,6 +87,14 @@ class RoundEngine:
         self.aggregate = make_aggregate_fn(model, update_type)
         self.verify = make_verify_fn(model, cfg.verification_threshold,
                                      cfg.performance_threshold)
+        if cfg.metric == "time" and fused:
+            # latency is a host-side wall-clock measurement; it cannot run
+            # inside the fused single-dispatch round program. The per-phase
+            # path calls evaluate_all on the host, where it works.
+            raise ValueError(
+                "metric='time' cannot be used with the fused round engine; "
+                "use fused=False (per-phase path) or the standalone "
+                "Evaluator / make_evaluate_all(metric='time')")
         self.evaluate_all = make_evaluate_all(model, model_type, cfg.metric,
                                               fused=cfg.fused_eval)
 
@@ -108,6 +116,10 @@ class RoundEngine:
     def _build_fused(self):
         from fedmse_tpu.federation.fused import (make_fused_round,
                                                  make_fused_rounds_scan)
+        if self.cfg.metric == "time":
+            raise ValueError(
+                "metric='time' is host-side wall-clock and cannot be traced "
+                "into the fused round/scan programs")
         # data / verification tensors are passed at CALL time (sharded
         # global arrays must be jit arguments, not closure constants)
         args = (self.train_all, self.scores_fn, self.aggregate, self.verify,
