@@ -212,8 +212,16 @@ class ExperimentConfig:
     # into the driver: rounds run in chunks of fused_schedule_chunk per XLA
     # dispatch, with early stopping checked per round from the stacked
     # outputs (a mid-chunk stop restores a snapshot and replays the prefix
-    # with identical selections/keys — main.py:run_combination).
-    fused_schedule: bool = False
+    # with identical selections/keys — main.py:run_combination). Default ON:
+    # this is the fastest path, validated single- and multi-process (the
+    # stop decision is broadcast from process 0 — parallel/multihost.py
+    # uniform_decision; two-process mid-chunk stop covered by
+    # tests/test_parallel.py::test_two_process_midchunk_early_stop).
+    # Durability trade-off: with resume enabled, checkpoints are written per
+    # CHUNK (a chunk is one XLA dispatch), so a crash can lose up to
+    # fused_schedule_chunk-1 rounds of progress; set fused_schedule_chunk=1
+    # (or fused_schedule=False) for per-round checkpoint granularity.
+    fused_schedule: bool = True
     fused_schedule_chunk: int = 8
 
     compat: CompatConfig = dataclasses.field(default_factory=CompatConfig)

@@ -42,7 +42,7 @@ from fedmse_tpu.data import build_dev_dataset, prepare_clients, stack_clients
 from fedmse_tpu.federation import RoundEngine
 from fedmse_tpu.models import make_model
 from fedmse_tpu.parallel import (client_mesh, host_fetch, pad_to_multiple,
-                                 shard_federation)
+                                 shard_federation, uniform_decision)
 from fedmse_tpu.utils.logging import get_logger
 from fedmse_tpu.utils.seeding import ExperimentRngs
 
@@ -138,8 +138,12 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
                                         model_type, update_type)
             writer.append_verification(run, result.round_index,
                                        result.verification_results)
-        if early_stop is not None and \
-                early_stop.should_stop(result.client_metrics):
+        if early_stop is not None and uniform_decision(
+                early_stop.should_stop(result.client_metrics)):
+            # uniform_decision: in a multi-controller run every process must
+            # take the identical stop/rewind decision or the next collective
+            # deadlocks; metrics are already allgathered-identical, and
+            # process 0's decision is broadcast as the guarantee.
             logger.info("Early stopping in global round!")
             return True
         return False
@@ -147,13 +151,6 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     use_schedule = (cfg.fused_schedule and cfg.fused_rounds
                     and engine.fused and not engine.timer.enabled)
     can_rewind = early_stop is not None
-    if use_schedule and can_rewind and jax.process_count() > 1:
-        # mid-chunk rewind+replay is unvalidated across multi-controller
-        # processes (every host must take the identical stop decision);
-        # stay on the per-round dispatch path there
-        logger.warning("fused_schedule with early stopping is single-process "
-                       "only; using the per-round dispatch path")
-        use_schedule = False
     if use_schedule:
         # whole-schedule scan in chunks: K rounds per XLA dispatch. Early
         # stopping is evaluated per round from the stacked outputs; a stop

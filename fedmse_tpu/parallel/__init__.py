@@ -8,11 +8,13 @@ from fedmse_tpu.parallel.mesh import (
 )
 from fedmse_tpu.parallel.collectives import make_shardmap_aggregate
 from fedmse_tpu.parallel.multihost import initialize as initialize_multihost
+from fedmse_tpu.parallel.multihost import uniform_decision
 
 __all__ = [
     "client_mesh",
     "host_fetch",
     "initialize_multihost",
+    "uniform_decision",
     "make_shardmap_aggregate",
     "pad_to_multiple",
     "replicate",

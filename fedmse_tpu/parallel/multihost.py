@@ -112,3 +112,20 @@ def initialize(coordinator_address: Optional[str] = None,
         else:
             logger.info("multihost init skipped (%s); running single-process",
                         e)
+
+
+def uniform_decision(flag: bool) -> bool:
+    """Make a host-side control decision identical on every process.
+
+    The driver's early-stop decision derives from `host_fetch`'d global
+    arrays, which process_allgather already makes identical everywhere — but
+    divergence here would be catastrophic (processes disagreeing on whether
+    to rewind a fused-schedule chunk deadlocks the collective at the next
+    dispatch), so process 0's decision is broadcast and every process
+    follows it. No-op in single-process runs."""
+    if jax.process_count() == 1:
+        return flag
+    import numpy as np
+    from jax.experimental import multihost_utils
+    return bool(multihost_utils.broadcast_one_to_all(
+        np.asarray(flag, dtype=np.bool_)))

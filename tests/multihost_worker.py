@@ -12,7 +12,14 @@ to no-ops on one host: `jax.distributed.initialize`,
 `make_array_from_process_local_data` placement (parallel/mesh.py:_place) and
 `host_fetch`'s `process_allgather` reassembly.
 
-Usage: multihost_worker.py <coordinator_port> <process_id>
+Usage: multihost_worker.py <coordinator_port> <process_id> [mode]
+
+mode 'round' (default): one federated round over the pod mesh.
+mode 'midstop': the fused-schedule chunk path with an early stop firing
+MID-chunk — the rewind+replay must produce the per-round path's exact
+state on BOTH processes (the decision is broadcast from process 0,
+parallel/multihost.py::uniform_decision), validating that the fused
+schedule is safe as the multi-controller default.
 """
 
 import os
@@ -32,14 +39,75 @@ force_cpu_platform()  # no device count: backends must not init before
 import jax  # noqa: E402
 
 
+class _StopAtCall:
+    """Deterministic early-stop stub: fires on the n-th should_stop call
+    (call counts are identical on every process, so the rigged decision is
+    uniform before the broadcast even runs)."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.calls = 0
+
+    def should_stop(self, metrics) -> bool:
+        self.calls += 1
+        return self.calls >= self.n
+
+
+def run_midstop(pid: int) -> None:
+    import numpy as np
+
+    from fedmse_tpu.config import CompatConfig, ExperimentConfig
+    from fedmse_tpu.data import (build_dev_dataset, stack_clients,
+                                 synthetic_clients)
+    from fedmse_tpu.main import run_combination
+    from fedmse_tpu.parallel import client_mesh
+
+    dim, n_real = 8, 8
+    base = ExperimentConfig(dim_features=dim, network_size=n_real, epochs=1,
+                            num_rounds=4, batch_size=4,
+                            fused_schedule_chunk=4,
+                            compat=CompatConfig(vote_tie_break=False))
+    rng_clients = synthetic_clients(n_clients=n_real, dim=dim, n_normal=40,
+                                    n_abnormal=16)
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+    dev_x = build_dev_dataset(rng_clients, ExperimentRngs(run=0).data_rng)
+    data = stack_clients(rng_clients, dev_x, base.batch_size, pad_clients_to=8)
+    mesh = client_mesh()
+    assert mesh.devices.size == 8
+
+    # stop fires on the 2nd bookkeep call -> mid-chunk of the 4-round chunk
+    sched = run_combination(base.replace(fused_schedule=True), data, n_real,
+                            "hybrid", "mse_avg", run=0,
+                            early_stop=_StopAtCall(2), mesh=mesh)
+    per_round = run_combination(base.replace(fused_schedule=False), data,
+                                n_real, "hybrid", "mse_avg", run=0,
+                                early_stop=_StopAtCall(2), mesh=mesh)
+    assert sched["rounds_run"] == per_round["rounds_run"] == 2, (
+        sched["rounds_run"], per_round["rounds_run"])
+    # tight atol on purpose: a MID-chunk stop rewinds to the chunk-entry
+    # snapshot and replays the prefix through run_round_fused — the very
+    # same per-round program the fused_schedule=False path runs, with the
+    # same selections/keys — so the final states must agree bit-for-bit,
+    # not merely to the scan-vs-per-round rtol=1e-4 (test_driver.py:137).
+    np.testing.assert_allclose(sched["final_metrics"],
+                               per_round["final_metrics"], atol=1e-6)
+    print(f"MIDSTOP_OK pid={pid} rounds={sched['rounds_run']} "
+          f"mean={float(np.nanmean(sched['final_metrics'])):.6f}", flush=True)
+
+
 def main() -> None:
     port, pid = sys.argv[1], int(sys.argv[2])
+    mode = sys.argv[3] if len(sys.argv) > 3 else "round"
 
     from fedmse_tpu.parallel import initialize_multihost
     initialize_multihost(coordinator_address=f"localhost:{port}",
                          num_processes=2, process_id=pid)
     assert jax.process_count() == 2, jax.process_count()
     assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+    if mode == "midstop":
+        run_midstop(pid)
+        return
 
     import numpy as np
 

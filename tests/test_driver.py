@@ -123,8 +123,11 @@ def test_fused_schedule_matches_per_round(dataset_dir, tmp_path):
         "--epochs", "2", "--num-rounds", "5", "--batch-size", "8",
         "--no-save",
     ]
+    # fused_schedule now defaults True, so path A must opt OUT explicitly
+    # to keep this a per-round-vs-schedule equivalence test
     out_a = cli_main(common + ["--checkpoint-dir", str(tmp_path / "a"),
-                               "--experiment-name", "sched_a"])
+                               "--experiment-name", "sched_a",
+                               "--fused-schedule", "false"])
     out_b = cli_main(common + ["--checkpoint-dir", str(tmp_path / "b"),
                                "--experiment-name", "sched_b",
                                "--fused-schedule", "true",

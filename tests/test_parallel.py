@@ -158,14 +158,9 @@ def test_full_round_on_global_mesh():
     assert np.all(np.isfinite(res.client_metrics))
 
 
-def test_two_process_federation():
-    """Real multi-controller run: two local processes join a localhost
-    coordinator (jax.distributed DCN path, VERDICT r1 #10), build one global
-    8-device mesh (4 virtual CPU devices each), and complete a full federated
-    round with identical results — validating initialize_multihost,
-    make_array_from_process_local_data placement, and host_fetch's
-    process_allgather, which single-process tests only exercise in
-    degradation."""
+def _launch_two_process_workers(mode, ok_pattern):
+    """Run tests/multihost_worker.py twice against a localhost coordinator
+    and return the regex captures from both processes' output."""
     import re
     import socket
     import subprocess
@@ -178,9 +173,10 @@ def test_two_process_federation():
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
     env = {k: v for k, v in os.environ.items()
            if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS", "JAX_PLATFORMS")}
-    procs = [subprocess.Popen([sys.executable, worker, str(port), str(pid)],
-                              stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, text=True, env=env)
+    procs = [subprocess.Popen(
+                [sys.executable, worker, str(port), str(pid), mode],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, env=env)
              for pid in (0, 1)]
     outs = []
     for p in procs:
@@ -192,8 +188,33 @@ def test_two_process_federation():
             raise
         outs.append(out)
         assert p.returncode == 0, out[-2000:]
-    results = [re.search(r"MULTIHOST_OK pid=\d+ (agg=\d+ mean=[\d.]+)", o)
-               for o in outs]
+    results = [re.search(ok_pattern, o) for o in outs]
     assert all(results), [o[-500:] for o in outs]
+    return results
+
+
+def test_two_process_federation():
+    """Real multi-controller run: two local processes join a localhost
+    coordinator (jax.distributed DCN path, VERDICT r1 #10), build one global
+    8-device mesh (4 virtual CPU devices each), and complete a full federated
+    round with identical results — validating initialize_multihost,
+    make_array_from_process_local_data placement, and host_fetch's
+    process_allgather, which single-process tests only exercise in
+    degradation."""
+    results = _launch_two_process_workers(
+        "round", r"MULTIHOST_OK pid=\d+ (agg=\d+ mean=[\d.]+)")
     # both processes computed the identical global round
+    assert results[0].group(1) == results[1].group(1)
+
+
+def test_two_process_midchunk_early_stop():
+    """The fused-schedule path's mid-chunk rewind+replay under a REAL
+    2-process multi-controller runtime (VERDICT r2 #3): an early stop firing
+    mid-chunk must produce the per-round path's exact final state on both
+    processes, with the stop decision broadcast from process 0
+    (parallel/multihost.py::uniform_decision). This is the validation that
+    lets fused_schedule default to True with no multi-process fallback."""
+    results = _launch_two_process_workers(
+        "midstop", r"MIDSTOP_OK pid=\d+ (rounds=\d+ mean=[\d.]+)")
+    # the rewound+replayed schedule state agrees across processes
     assert results[0].group(1) == results[1].group(1)
