@@ -1,0 +1,70 @@
+"""Shared runtime harness for measuring the torch reference implementation.
+
+Used by torch_baseline.py (wall-clock per round) and torch_paper_check.py
+(paper-scale AUC). Copies `/root/reference/src` to a temp dir, applies
+regex overrides to the reference's edited-in-source globals
+(reference src/main.py:37-71), writes a reference-format config pointing at
+a Client-k shard dir, and runs `python main.py` there. Nothing from the
+reference enters this repo; the copy lives and dies in a temp dir.
+"""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REFERENCE_SRC = "/root/reference/src"
+
+
+def run_reference(shard_dir: str, overrides, n_clients: int,
+                  timeout: int = 14000, extra_fmt=None):
+    """Copy + override + run the reference on `shard_dir`.
+
+    `overrides` is a list of (regex, replacement) applied to main.py; each
+    replacement may use {n} (client count) and {cfg} (config path) plus any
+    keys in `extra_fmt`. Returns (run_dir, combined_log) with the temp tree
+    still on disk — callers parse artifacts, then must clean up the returned
+    tmp root (first element of the tuple's dirname chain) themselves via
+    `cleanup()`.
+    """
+    shard_dir = os.path.abspath(shard_dir)
+    tmp = tempfile.mkdtemp(prefix="refrun_")
+    run_dir = os.path.join(tmp, "src")
+    shutil.copytree(REFERENCE_SRC, run_dir)
+    # the reference repo commits old experiment artifacts under
+    # src/Checkpoint/ — drop them so result parsing only sees THIS run
+    shutil.rmtree(os.path.join(run_dir, "Checkpoint"), ignore_errors=True)
+
+    cfg_path = os.path.join(tmp, "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump({
+            "data_path": shard_dir,
+            "devices_list": [
+                {"id": k, "name": f"Client-{k}",
+                 "normal_data_path": f"Client-{k}/normal",
+                 "abnormal_data_path": f"Client-{k}/abnormal",
+                 "test_normal_data_path": f"Client-{k}/test_normal"}
+                for k in range(1, n_clients + 1)],
+        }, f)
+
+    main_py = os.path.join(run_dir, "main.py")
+    src = open(main_py).read()
+    fmt = {"n": n_clients, "cfg": cfg_path, **(extra_fmt or {})}
+    for pat, repl in overrides:
+        repl = repl.format(**fmt)
+        src, cnt = re.subn(pat, repl, src, flags=re.M)
+        assert cnt == 1, f"override {pat!r} matched {cnt} lines"
+    open(main_py, "w").write(src)
+
+    proc = subprocess.run([sys.executable, "main.py"], cwd=run_dir,
+                          capture_output=True, text=True, timeout=timeout)
+    log = proc.stdout + proc.stderr
+    assert proc.returncode == 0, log[-3000:]
+    return run_dir, log
+
+
+def cleanup(run_dir: str) -> None:
+    shutil.rmtree(os.path.dirname(run_dir), ignore_errors=True)

@@ -10,11 +10,24 @@ we deregister the axon backend factory before any backend is initialized.
 
 import os
 import sys
+import tempfile
 
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Persistent XLA compilation cache (VERDICT r2 #7): the suite is dominated by
+# XLA compiles of the driver/fused/parallel round programs (~9 min cold);
+# with a warm cache the same suite runs in a fraction of that. The cache dir
+# survives across pytest invocations on this machine; the 2-process multihost
+# workers inherit it through the environment (concurrent writers are safe —
+# entries land via atomic rename).
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(tempfile.gettempdir(),
+                                   "fedmse_xla_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
