@@ -106,14 +106,22 @@ def _ensure_scaling_shards(n_clients: int) -> str:
     return out_dir
 
 
-def build_data(cfg, n_clients: int = 10):
+def build_data(cfg, n_clients: int = 10, dataset=None):
+    """Stacked federation tensors for a benchmark scenario.
+
+    `dataset` (a DatasetConfig) overrides the default N-BaIoT source —
+    bench_suite.py routes its scenario configs through here so suite
+    artifacts stay comparable with bench.py's (same seeding, same
+    stacking)."""
     from fedmse_tpu.config import DatasetConfig
     from fedmse_tpu.data import (build_dev_dataset, prepare_clients,
                                  stack_clients, synthetic_clients)
     from fedmse_tpu.utils.seeding import ExperimentRngs
 
     rngs = ExperimentRngs(run=0, data_seed=cfg.data_seed)
-    if n_clients != 10:
+    if dataset is not None:
+        clients = prepare_clients(dataset, cfg, rngs.data_rng)
+    elif n_clients != 10:
         shard_dir = _ensure_scaling_shards(n_clients)
         dataset = DatasetConfig.for_client_dirs(shard_dir, n_clients)
         clients = prepare_clients(dataset, cfg, rngs.data_rng)

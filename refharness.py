@@ -50,20 +50,27 @@ def run_reference(shard_dir: str, overrides, n_clients: int,
                 for k in range(1, n_clients + 1)],
         }, f)
 
-    main_py = os.path.join(run_dir, "main.py")
-    src = open(main_py).read()
-    fmt = {"n": n_clients, "cfg": cfg_path, **(extra_fmt or {})}
-    for pat, repl in overrides:
-        repl = repl.format(**fmt)
-        src, cnt = re.subn(pat, repl, src, flags=re.M)
-        assert cnt == 1, f"override {pat!r} matched {cnt} lines"
-    open(main_py, "w").write(src)
+    try:
+        main_py = os.path.join(run_dir, "main.py")
+        src = open(main_py).read()
+        fmt = {"n": n_clients, "cfg": cfg_path, **(extra_fmt or {})}
+        for pat, repl in overrides:
+            repl = repl.format(**fmt)
+            src, cnt = re.subn(pat, repl, src, flags=re.M)
+            if cnt != 1:
+                raise RuntimeError(f"override {pat!r} matched {cnt} lines")
+        open(main_py, "w").write(src)
 
-    proc = subprocess.run([sys.executable, "main.py"], cwd=run_dir,
-                          capture_output=True, text=True, timeout=timeout)
-    log = proc.stdout + proc.stderr
-    assert proc.returncode == 0, log[-3000:]
-    return run_dir, log
+        proc = subprocess.run([sys.executable, "main.py"], cwd=run_dir,
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        log = proc.stdout + proc.stderr
+        if proc.returncode != 0:
+            raise RuntimeError(f"reference run failed: {log[-3000:]}")
+        return run_dir, log
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)  # don't leak the temp copy
+        raise
 
 
 def cleanup(run_dir: str) -> None:
