@@ -116,7 +116,12 @@ def main():
                               "hybrid", "mse_avg", timed_rounds=20)
     rows.append({"scenario": "full P2P FedMSE, 10-client, 50% participation,"
                              " 20 rounds", "sec_per_round": round(sec, 4),
-                 "final_auc": round(auc, 5)})
+                 "final_auc": round(auc, 5),
+                 "note": "late-round AUC drop is reference behavior: the "
+                         "torch reference on the same 20-round quick-run "
+                         "schedule falls 0.999 -> 0.915 at round ~11 when "
+                         "aggregation quotas exhaust and clients drift on "
+                         "local lr=1e-3 training (measured r3)"})
     print(json.dumps(rows[-1]), flush=True)
 
     sec, auc, _ = _run_rounds(ExperimentConfig(), nbaiot10,

@@ -60,6 +60,55 @@ class RoundResult:
     min_valid: np.ndarray               # [n_real] best local valid loss
 
 
+# Program cache: building an engine's jitted callables (train/scores/
+# aggregate/verify/evaluate) means re-tracing large programs, and every
+# (model_type, update_type, run) combination in a sweep — and every test —
+# constructs a fresh engine. The callables only depend on hashable config,
+# so identical engines share ONE set of programs (and one optax transform,
+# so optimizer states stay interchangeable). jax's jit cache then makes the
+# second engine's compiles free. Bounded FIFO (keeping a program set alive
+# is what preserves its jit cache, but a process sweeping MANY distinct
+# configs shouldn't grow without limit).
+_PROGRAM_CACHE: dict = {}
+_PROGRAM_CACHE_MAX = 32
+
+
+def _cache_put(key, value) -> None:
+    if len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+        _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))  # FIFO eviction
+    _PROGRAM_CACHE[key] = value
+
+
+def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
+                     update_type: str):
+    key = (model, cfg.lr_rate, cfg.epochs, cfg.patience, update_type,
+           cfg.fedprox_mu, cfg.compat.no_best_restore,
+           cfg.compat.restandardize_vote_data, cfg.compat.vote_tie_break,
+           cfg.verification_threshold, cfg.performance_threshold,
+           model_type, cfg.metric, cfg.fused_eval)
+    hit = _PROGRAM_CACHE.get(key)
+    if hit is not None:
+        return hit
+    tx = optax.adam(cfg.lr_rate)
+    programs = {
+        "tx": tx,
+        "train_all": make_local_train_all(
+            model, tx, epochs=cfg.epochs, patience=cfg.patience,
+            fedprox=(update_type == "fedprox"), mu=cfg.fedprox_mu,
+            restore_best=not cfg.compat.no_best_restore),
+        "scores_fn": make_mse_scores_fn(
+            model, restandardize=cfg.compat.restandardize_vote_data,
+            tie_break=cfg.compat.vote_tie_break),
+        "aggregate": make_aggregate_fn(model, update_type),
+        "verify": make_verify_fn(model, cfg.verification_threshold,
+                                 cfg.performance_threshold),
+        "evaluate_all": make_evaluate_all(model, model_type, cfg.metric,
+                                          fused=cfg.fused_eval),
+    }
+    _cache_put(key, programs)
+    return programs
+
+
 class RoundEngine:
     """One (model_type, update_type) federation over stacked client state."""
 
@@ -76,17 +125,6 @@ class RoundEngine:
         self.model_type = model_type
         self.update_type = update_type
 
-        self.tx = optax.adam(cfg.lr_rate)
-        self.train_all = make_local_train_all(
-            model, self.tx, epochs=cfg.epochs, patience=cfg.patience,
-            fedprox=(update_type == "fedprox"), mu=cfg.fedprox_mu,
-            restore_best=not cfg.compat.no_best_restore)
-        self.scores_fn = make_mse_scores_fn(
-            model, restandardize=cfg.compat.restandardize_vote_data,
-            tie_break=cfg.compat.vote_tie_break)
-        self.aggregate = make_aggregate_fn(model, update_type)
-        self.verify = make_verify_fn(model, cfg.verification_threshold,
-                                     cfg.performance_threshold)
         if cfg.metric == "time" and fused:
             # latency is a host-side wall-clock measurement; it cannot run
             # inside the fused single-dispatch round program. The per-phase
@@ -95,8 +133,13 @@ class RoundEngine:
                 "metric='time' cannot be used with the fused round engine; "
                 "use fused=False (per-phase path) or the standalone "
                 "Evaluator / make_evaluate_all(metric='time')")
-        self.evaluate_all = make_evaluate_all(model, model_type, cfg.metric,
-                                              fused=cfg.fused_eval)
+        programs = _engine_programs(model, cfg, model_type, update_type)
+        self.tx = programs["tx"]
+        self.train_all = programs["train_all"]
+        self.scores_fn = programs["scores_fn"]
+        self.aggregate = programs["aggregate"]
+        self.verify = programs["verify"]
+        self.evaluate_all = programs["evaluate_all"]
 
         self.states: ClientStates = init_client_states(
             model, self.tx, rngs.next_jax(), self.n_pad)
@@ -125,8 +168,17 @@ class RoundEngine:
         args = (self.train_all, self.scores_fn, self.aggregate, self.verify,
                 self.evaluate_all, self.cfg.max_aggregation_threshold,
                 self.poison_fn)
+        # same sharing rationale as _engine_programs; the builders are keyed
+        # by the already-cached phase callables, so identity works — except
+        # with an attack poison_fn (arbitrary callable, not cache-keyable)
+        key = ("fused",) + args[:-1]
+        if self.poison_fn is None and key in _PROGRAM_CACHE:
+            self._fused_round, self._fused_scan = _PROGRAM_CACHE[key]
+            return
         self._fused_round = make_fused_round(*args)
         self._fused_scan = make_fused_rounds_scan(*args)
+        if self.poison_fn is None:
+            _cache_put(key, (self._fused_round, self._fused_scan))
 
     # ------------------------------------------------------------------ #
 
