@@ -208,11 +208,29 @@ def prepare_clients(
         test_x = np.concatenate([test_x, abnormal_x], axis=0)
         test_y = np.concatenate([test_y, abnormal_y], axis=0)
 
+        def cast32(x, what):
+            # standardization can overflow float32 when a train split has
+            # near-zero variance in a feature other rows exercise hard
+            # ((x-mean)/tiny_std). The reference's sklearn+float32 pipeline
+            # produces the same infs; anomaly scores go through nan_to_num
+            # in the evaluator — surfaced here so pathological splits are
+            # visible, not silent (inf valid values would also poison the
+            # early-stop/best-restore comparisons).
+            with np.errstate(over="ignore"):
+                x32 = x.astype(np.float32)
+            n_nonfinite = int((~np.isfinite(x32)).sum())
+            if n_nonfinite:
+                logger.warning(
+                    "%s: %d non-finite standardized %s values (float32 "
+                    "overflow; near-zero train variance feature)",
+                    device.name, n_nonfinite, what)
+            return x32
+
         clients.append(ClientData(
             name=device.name,
-            train_x=train_x.astype(np.float32),
-            valid_x=valid_x.astype(np.float32),
-            test_x=test_x.astype(np.float32),
+            train_x=cast32(train_x, "train"),
+            valid_x=cast32(valid_x, "valid"),
+            test_x=cast32(test_x, "test"),
             test_y=test_y.astype(np.float32),
             dev_raw=dev_df,
             scaler=proc,
