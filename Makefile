@@ -7,7 +7,7 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -Wall -Wextra
 LIB := fedmse_tpu/native/libfedmse_io.so
 
-.PHONY: native clean test
+.PHONY: native clean test bench bench-paper bench-scaling bench-suite tpu-check
 
 native: $(LIB)
 
@@ -17,6 +17,22 @@ $(LIB): native/fedmse_io.cpp
 
 test:
 	python -m pytest tests/ -x -q
+
+# measurement entry points (each prints JSON; see PARITY.md §4 for results)
+bench:
+	python bench.py
+
+bench-paper:
+	python bench.py --paper-scale
+
+bench-scaling:
+	for n in 10 20 30 40 50; do python bench.py --clients $$n || exit 1; done
+
+bench-suite:
+	python bench_suite.py
+
+tpu-check:
+	python tpu_check.py
 
 clean:
 	rm -f $(LIB)

@@ -95,6 +95,32 @@ def test_sharded_round_matches_single_device():
 
 
 @needs_8_devices
+def test_fifty_clients_on_eight_device_mesh():
+    """The BASELINE pod-scale scenario shape: 50 clients sharded over an
+    8-device mesh (padded to 56, 20% participation) must complete a fused
+    round with finite metrics for every real client — the client axis
+    outnumbering devices is the normal pod regime."""
+    cfg = ExperimentConfig(dim_features=8, network_size=50, epochs=1,
+                           batch_size=8, num_participants=0.2)
+    clients = synthetic_clients(n_clients=50, dim=8, n_normal=24,
+                                n_abnormal=8)
+    rngs = ExperimentRngs(run=0)
+    dev_x = build_dev_dataset(clients, rngs.data_rng)
+    data = stack_clients(clients, dev_x, cfg.batch_size, pad_clients_to=56)
+    mesh = client_mesh(8)
+    model = make_model("hybrid", 8, shrink_lambda=cfg.shrink_lambda)
+    eng = RoundEngine(model, cfg, data, n_real=50, rngs=rngs,
+                      model_type="hybrid", update_type="mse_avg", fused=True)
+    eng.data, eng.states = shard_federation(data, eng.states, mesh)
+    eng._ver_x, eng._ver_m = eng._verification_tensors()
+    res = eng.run_round(0)
+    assert res.client_metrics.shape == (50,)
+    assert np.all(np.isfinite(res.client_metrics))
+    assert len(res.selected) == 10  # ceil(0.2 * 50)
+    assert res.aggregator in res.selected
+
+
+@needs_8_devices
 def test_graft_entry_dryrun():
     import __graft_entry__
     __graft_entry__.dryrun_multichip(8)
