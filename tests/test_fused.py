@@ -69,6 +69,29 @@ def test_fused_scan_matches_per_round():
         assert_results_match(ra, rb)
 
 
+def test_program_cache_shares_and_separates():
+    """Identical engine configs share ONE program set (the cache that makes
+    sweep runs after the first compile-free); any config field a builder
+    consumes must be part of the cache key — differing lr must NOT share.
+    Canary for future builder parameters forgotten in _engine_programs."""
+    a = build_engine(fused=True)
+    b = build_engine(fused=True)
+    assert a.train_all is b.train_all
+    assert a.evaluate_all is b.evaluate_all
+    assert a.tx is b.tx  # shared transform => interchangeable opt states
+
+    import dataclasses as _dc
+    cfg_fast = _dc.replace(a.cfg, lr_rate=1e-2)
+    c = RoundEngine(a.model, cfg_fast, a.data, n_real=N,
+                    rngs=ExperimentRngs(run=0), model_type="hybrid",
+                    update_type="mse_avg", fused=True)
+    assert c.train_all is not a.train_all
+    ra = a.run_round(0, selected=[0, 2])
+    rc = c.run_round(0, selected=[0, 2])
+    # different lr must actually train differently
+    assert not np.allclose(ra.min_valid, rc.min_valid, equal_nan=True)
+
+
 def test_fused_with_padded_clients():
     fus = build_engine(fused=True, pad_to=8)
     res = fus.run_rounds(0, 2)
