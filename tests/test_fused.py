@@ -80,8 +80,7 @@ def test_program_cache_shares_and_separates():
     assert a.evaluate_all is b.evaluate_all
     assert a.tx is b.tx  # shared transform => interchangeable opt states
 
-    import dataclasses as _dc
-    cfg_fast = _dc.replace(a.cfg, lr_rate=1e-2)
+    cfg_fast = dataclasses.replace(a.cfg, lr_rate=1e-2)
     c = RoundEngine(a.model, cfg_fast, a.data, n_real=N,
                     rngs=ExperimentRngs(run=0), model_type="hybrid",
                     update_type="mse_avg", fused=True)
