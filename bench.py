@@ -191,11 +191,15 @@ def main():
     # AUC protocol (VERDICT r1 #3/#5): mean +/- std over num_runs independent
     # federations — the reference's own reporting is mean over runs
     # (src/main.py:51 num_runs, results_visualization.ipynb cells 0-5).
-    # Wall-clock is timed on run 0 only (later runs reuse compiled programs,
-    # same speed).
+    # Wall-clock: EVERY run's schedule is timed and the headline is the MIN
+    # (steady-state; all compiles land in the warm-up). The shared-pool TPU
+    # tunnel's latency is bursty — measured here: the identical cached
+    # program ran a 3-round chunk in 76 ms one day and 0.3-2.0 s the next
+    # under pool congestion — so a single-run sample can be 10x noise. The
+    # per-run list is kept in the JSON so the jitter is visible.
     num_runs = 3
     aucs = []
-    sec_per_round = None
+    run_secs = []
     for run in range(num_runs):
         engine.rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed)
         engine.reset_federation()
@@ -216,9 +220,9 @@ def main():
             for r in range(timed_rounds):
                 result = engine.run_round(r)
             elapsed = time.time() - t0
-        if run == 0:
-            sec_per_round = elapsed / timed_rounds
+        run_secs.append(elapsed / timed_rounds)
         aucs.append(float(np.nanmean(result.client_metrics)))
+    sec_per_round = min(run_secs)
 
     device = jax.devices()[0]
     protocol = ("100 local epochs, 20 rounds, lr 1e-5, lambda 10"
@@ -236,6 +240,8 @@ def main():
                   f"hybrid SAE-CEN + mse_avg, {protocol}, 50% participation)",
         "value": round(sec_per_round, 4),
         "unit": "s",
+        "sec_per_round_runs": [round(s, 4) for s in run_secs],
+        "timing": f"min over {num_runs} timed schedules (warm)",
         "vs_baseline": (round(baseline_sec / sec_per_round, 2)
                         if baseline_sec else None),
         "auc_mean": round(float(np.mean(aucs)), 5),

@@ -297,13 +297,15 @@ class RoundEngine:
         if self._fused_scan is None:
             self._build_fused()
         schedule = [self.select_clients() for _ in range(n_rounds)]
-        keys = [self.rngs.next_jax() for _ in range(n_rounds)]
+        # one dispatch for all R round keys (vs R fold_in round-trips; the
+        # stream is identical — see ExperimentRngs.next_jax_batch)
+        keys = self.rngs.next_jax_batch(n_rounds)
         arrays = [self._selection_arrays(sel) for sel in schedule]
         sel_idx = jnp.asarray(np.stack([a[0] for a in arrays]))
         masks = jnp.asarray(np.stack([a[1] for a in arrays]))
         self.states, _, outs = self._fused_scan(
             self.states, self.data, self._ver_x, self._ver_m, sel_idx, masks,
-            self._agg_count_padded(), jnp.stack(keys),
+            self._agg_count_padded(), keys,
             jnp.arange(start_round, start_round + n_rounds, dtype=jnp.int32))
         outs = host_fetch(outs)  # multi-process-safe (parallel/mesh.py)
         results = [self._fused_result(start_round + r, schedule[r],

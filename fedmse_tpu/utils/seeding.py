@@ -22,6 +22,7 @@ import dataclasses
 import random as _pyrandom
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -54,3 +55,14 @@ class ExperimentRngs:
     def next_jax(self) -> jax.Array:
         self._fold += 1
         return jax.random.fold_in(self.jax_root, self._fold)
+
+    def next_jax_batch(self, n: int) -> jax.Array:
+        """A [n]-stacked key array identical to n successive `next_jax()`
+        draws, produced in ONE device dispatch. `fold_in` is a pure function
+        of (root, count), so batching over the counts preserves the stream
+        exactly; per-call dispatches round-trip the accelerator tunnel, which
+        at remote-TPU latencies is the dominant cost of drawing R round keys
+        (federation/rounds.py:run_schedule_chunk)."""
+        counts = jnp.arange(self._fold + 1, self._fold + n + 1)
+        self._fold += n
+        return jax.vmap(lambda c: jax.random.fold_in(self.jax_root, c))(counts)

@@ -276,6 +276,21 @@ def test_round_engine_phase_timings():
     assert all(v >= 0 for v in t.values())
 
 
+def test_batched_key_draw_matches_sequential_stream():
+    """next_jax_batch(n) must be bit-identical to n next_jax() calls — the
+    fused schedule draws its round keys batched (one dispatch), the replay
+    path draws them one-by-one; a divergence would break mid-chunk
+    early-stop replay (main.py:run_combination)."""
+    import jax
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+    a, b = ExperimentRngs(run=1), ExperimentRngs(run=1)
+    seq = [a.next_jax() for _ in range(5)]
+    # interleave singles and a batch to exercise the shared fold counter
+    mixed = [b.next_jax(), b.next_jax()] + list(b.next_jax_batch(3))
+    for s, m in zip(seq, mixed):
+        assert (jax.random.key_data(s) == jax.random.key_data(m)).all()
+
+
 # ---------------------------- similarity ----------------------------- #
 
 def test_similarity_score_matches_reference_formula(rng):
