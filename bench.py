@@ -106,6 +106,19 @@ def _ensure_scaling_shards(n_clients: int) -> str:
     return out_dir
 
 
+def _timed_pass(engine, fused: bool, timed_rounds: int):
+    """One warm timed schedule from a fresh federation: returns
+    (sec_per_round, results). The single timing protocol shared by the main
+    run loop, the bursty-tunnel extras, and bench_suite._run_rounds."""
+    engine.reset_federation()
+    t0 = time.time()
+    if fused:
+        results = engine.run_rounds(0, timed_rounds)
+    else:
+        results = [engine.run_round(r) for r in range(timed_rounds)]
+    return (time.time() - t0) / timed_rounds, results
+
+
 def build_data(cfg, n_clients: int = 10, dataset=None):
     """Stacked federation tensors for a benchmark scenario.
 
@@ -202,26 +215,25 @@ def main():
     run_secs = []
     for run in range(num_runs):
         engine.rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed)
-        engine.reset_federation()
-        if fused:
-            if run == 0:  # warm-up compiles the 3-round scan
+        if run == 0:  # warm-up triggers every jit compile before timing
+            engine.reset_federation()
+            if fused:
                 engine.run_rounds(0, timed_rounds)
-                engine.reset_federation()
-            t0 = time.time()
-            results = engine.run_rounds(0, timed_rounds)
-            elapsed = time.time() - t0
-            result = results[-1]
-        else:
-            if run == 0:  # warm-up triggers every per-phase jit compile
+            else:
                 engine.run_round(0)
-                engine.reset_federation()
-            t0 = time.time()
-            result = None
-            for r in range(timed_rounds):
-                result = engine.run_round(r)
-            elapsed = time.time() - t0
-        run_secs.append(elapsed / timed_rounds)
-        aucs.append(float(np.nanmean(result.client_metrics)))
+        sec, results = _timed_pass(engine, fused, timed_rounds)
+        run_secs.append(sec)
+        aucs.append(float(np.nanmean(results[-1].client_metrics)))
+    # Bursty-tunnel guard: when the three samples disagree by >2x the slow
+    # ones were congestion, not compute — take a few extra timing-only reps
+    # (identical warm run-0 schedule) so the min has more chances to see an
+    # uncongested window. A CONSISTENTLY slow backend takes no extras and
+    # reports its honest steady state.
+    extra = 0
+    while max(run_secs) / min(run_secs) > 2 and extra < 5:
+        engine.rngs = ExperimentRngs(run=0, data_seed=cfg.data_seed)
+        run_secs.append(_timed_pass(engine, fused, timed_rounds)[0])
+        extra += 1
     sec_per_round = min(run_secs)
 
     device = jax.devices()[0]
@@ -241,7 +253,7 @@ def main():
         "value": round(sec_per_round, 4),
         "unit": "s",
         "sec_per_round_runs": [round(s, 4) for s in run_secs],
-        "timing": f"min over {num_runs} timed schedules (warm)",
+        "timing": f"min over {len(run_secs)} timed schedules (warm)",
         "vs_baseline": (round(baseline_sec / sec_per_round, 2)
                         if baseline_sec else None),
         "auc_mean": round(float(np.mean(aucs)), 5),

@@ -23,7 +23,7 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 from bench import (_ensure_live_backend, _ensure_scaling_shards,  # noqa: E402
-                   build_data)
+                   _timed_pass, build_data)
 
 KITSUNE_CFG = os.path.join(REPO_ROOT, "configs",
                            "kitsune-10clients-noniid.json")
@@ -46,12 +46,16 @@ def _run_rounds(cfg, dataset, model_type, update_type, timed_rounds):
                          model_type=model_type, update_type=update_type,
                          fused=True)
     engine.run_rounds(0, timed_rounds)        # compile + warm
-    engine.reset_federation()
-    t0 = time.time()
-    results = engine.run_rounds(0, timed_rounds)
-    sec = (time.time() - t0) / timed_rounds
+    # min over repeated warm passes (same bursty-tunnel rationale as
+    # bench.py: a single sample under pool congestion can be 10x noise);
+    # extra reps only when the first two disagree by >2x.
+    secs = []
+    results = None
+    while len(secs) < 2 or (max(secs) / min(secs) > 2 and len(secs) < 5):
+        sec, results = _timed_pass(engine, True, timed_rounds)
+        secs.append(sec)
     auc = float(np.nanmean(results[-1].client_metrics))
-    return sec, auc, n_real
+    return min(secs), auc, n_real
 
 
 def scen_single_client():
@@ -101,6 +105,16 @@ def scen_single_client():
 
 
 def main():
+    only = None  # debug: run a single scenario (1-5)
+    if "--only" in sys.argv:  # validate before the (slow) TPU liveness probe
+        idx = sys.argv.index("--only") + 1
+        try:
+            only = int(sys.argv[idx])
+        except (IndexError, ValueError):
+            sys.exit("--only expects a scenario number 1-5")
+        if not 1 <= only <= 5:
+            sys.exit(f"--only expects a scenario number 1-5, got {only}")
+
     _ensure_live_backend()
     import jax
     from fedmse_tpu.config import DatasetConfig, ExperimentConfig
@@ -109,47 +123,53 @@ def main():
         "/root/reference/Data/N-BaIoT/IID-10-Client_Data", 10,
         name_prefix="NBa-Scen2-Client")
 
-    rows = [scen_single_client()]
-    print(json.dumps(rows[-1]), flush=True)
+    rows = []
 
-    sec, auc, _ = _run_rounds(ExperimentConfig(), nbaiot10,
-                              "hybrid", "mse_avg", timed_rounds=20)
-    rows.append({"scenario": "full P2P FedMSE, 10-client, 50% participation,"
-                             " 20 rounds", "sec_per_round": round(sec, 4),
-                 "final_auc": round(auc, 5),
-                 "note": "late-round AUC drop is reference behavior: the "
-                         "torch reference on the same 20-round quick-run "
-                         "schedule falls 0.999 -> 0.915 at round ~11 when "
-                         "aggregation quotas exhaust and clients drift on "
-                         "local lr=1e-3 training (measured r3)"})
-    print(json.dumps(rows[-1]), flush=True)
+    def emit(row):
+        rows.append(row)
+        print(json.dumps(row), flush=True)
 
-    sec, auc, _ = _run_rounds(ExperimentConfig(), nbaiot10,
-                              "hybrid", "avg", timed_rounds=3)
-    rows.append({"scenario": "FedAvg baseline (MSE-weighting off), "
-                             "10-client, 3 rounds",
-                 "sec_per_round": round(sec, 4), "final_auc": round(auc, 5)})
-    print(json.dumps(rows[-1]), flush=True)
+    if only in (None, 1):
+        emit(scen_single_client())
 
-    kitsune = DatasetConfig.from_json(KITSUNE_CFG)
-    sec, auc, n = _run_rounds(ExperimentConfig(), kitsune,
-                              "hybrid", "mse_avg", timed_rounds=3)
-    rows.append({"scenario": f"Kitsune non-IID ({n} trainable clients), "
-                             "hybrid + mse_avg, 3 rounds",
-                 "sec_per_round": round(sec, 4), "final_auc": round(auc, 5)})
-    print(json.dumps(rows[-1]), flush=True)
+    if only in (None, 2):
+        sec, auc, _ = _run_rounds(ExperimentConfig(), nbaiot10,
+                                  "hybrid", "mse_avg", timed_rounds=20)
+        emit({"scenario": "full P2P FedMSE, 10-client, 50% participation,"
+                          " 20 rounds", "sec_per_round": round(sec, 4),
+              "final_auc": round(auc, 5),
+              "note": "late-round AUC drop is reference behavior: the "
+                      "torch reference on the same 20-round quick-run "
+                      "schedule falls 0.999 -> 0.915 at round ~11 when "
+                      "aggregation quotas exhaust and clients drift on "
+                      "local lr=1e-3 training (measured r3)"})
 
-    _ensure_scaling_shards(50)
-    nbaiot50 = DatasetConfig.for_client_dirs(
-        os.path.join(REPO_ROOT, "Data", "nbaiot-50clients-iid"), 50)
-    cfg50 = ExperimentConfig(network_size=50, num_participants=0.2,
-                             num_rounds=50)
-    sec, auc, _ = _run_rounds(cfg50, nbaiot50, "hybrid", "mse_avg",
-                              timed_rounds=50)
-    rows.append({"scenario": "50-client scaled N-BaIoT, 20% participation, "
-                             "50 rounds", "sec_per_round": round(sec, 4),
-                 "final_auc": round(auc, 5)})
-    print(json.dumps(rows[-1]), flush=True)
+    if only in (None, 3):
+        sec, auc, _ = _run_rounds(ExperimentConfig(), nbaiot10,
+                                  "hybrid", "avg", timed_rounds=3)
+        emit({"scenario": "FedAvg baseline (MSE-weighting off), "
+                          "10-client, 3 rounds",
+              "sec_per_round": round(sec, 4), "final_auc": round(auc, 5)})
+
+    if only in (None, 4):
+        kitsune = DatasetConfig.from_json(KITSUNE_CFG)
+        sec, auc, n = _run_rounds(ExperimentConfig(), kitsune,
+                                  "hybrid", "mse_avg", timed_rounds=3)
+        emit({"scenario": f"Kitsune non-IID ({n} trainable clients), "
+                          "hybrid + mse_avg, 3 rounds",
+              "sec_per_round": round(sec, 4), "final_auc": round(auc, 5)})
+
+    if only in (None, 5):
+        _ensure_scaling_shards(50)
+        nbaiot50 = DatasetConfig.for_client_dirs(
+            os.path.join(REPO_ROOT, "Data", "nbaiot-50clients-iid"), 50)
+        cfg50 = ExperimentConfig(network_size=50, num_participants=0.2,
+                                 num_rounds=50)
+        sec, auc, _ = _run_rounds(cfg50, nbaiot50, "hybrid", "mse_avg",
+                                  timed_rounds=50)
+        emit({"scenario": "50-client scaled N-BaIoT, 20% participation, "
+                          "50 rounds", "sec_per_round": round(sec, 4),
+              "final_auc": round(auc, 5)})
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,
@@ -159,9 +179,11 @@ def main():
     reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
     if reason and reason != "1":
         out["tpu_fallback_reason"] = reason
-    out_path = "BENCH_SUITE.json"
-    if "--out" in sys.argv:
+    out_path = None if only is not None else "BENCH_SUITE.json"
+    if "--out" in sys.argv:  # explicit --out writes even in --only debug mode
         out_path = sys.argv[sys.argv.index("--out") + 1]
+    if out_path is None:  # --only without --out: don't clobber the artifact
+        return
     with open(os.path.join(REPO_ROOT, out_path), "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"wrote": out_path, "n_scenarios": len(rows)}))

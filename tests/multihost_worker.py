@@ -20,6 +20,9 @@ MID-chunk — the rewind+replay must produce the per-round path's exact
 state on BOTH processes (the decision is broadcast from process 0,
 parallel/multihost.py::uniform_decision), validating that the fused
 schedule is safe as the multi-controller default.
+mode 'both': 'round' then 'midstop' in one process — the test suite uses
+this so both validations pay the worker-pair spawn (jax import +
+distributed init, ~20 s/process on this 1-core box) only once.
 """
 
 import os
@@ -109,6 +112,12 @@ def main() -> None:
         run_midstop(pid)
         return
 
+    run_round(pid)
+    if mode == "both":
+        run_midstop(pid)
+
+
+def run_round(pid: int) -> None:
     import numpy as np
 
     from fedmse_tpu.config import ExperimentConfig
