@@ -106,6 +106,18 @@ def _ensure_scaling_shards(n_clients: int) -> str:
     return out_dir
 
 
+def _min_over_reps(timed_once):
+    """Bursty-tunnel timing rule shared by every suite scenario: at least 2
+    warm samples, extras (5 total max) only while the spread exceeds 2x.
+    `timed_once()` -> (seconds, payload); returns (min_seconds, payload of
+    the last pass)."""
+    secs, payload = [], None
+    while len(secs) < 2 or (max(secs) / min(secs) > 2 and len(secs) < 5):
+        sec, payload = timed_once()
+        secs.append(sec)
+    return min(secs), payload
+
+
 def _timed_pass(engine, fused: bool, timed_rounds: int):
     """One warm timed schedule from a fresh federation: returns
     (sec_per_round, results). The single timing protocol shared by the main

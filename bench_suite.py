@@ -23,7 +23,7 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 from bench import (_ensure_live_backend, _ensure_scaling_shards,  # noqa: E402
-                   _timed_pass, build_data)
+                   _min_over_reps, _timed_pass, build_data)
 
 KITSUNE_CFG = os.path.join(REPO_ROOT, "configs",
                            "kitsune-10clients-noniid.json")
@@ -46,16 +46,12 @@ def _run_rounds(cfg, dataset, model_type, update_type, timed_rounds):
                          model_type=model_type, update_type=update_type,
                          fused=True)
     engine.run_rounds(0, timed_rounds)        # compile + warm
-    # min over repeated warm passes (same bursty-tunnel rationale as
-    # bench.py: a single sample under pool congestion can be 10x noise);
-    # extra reps only when the first two disagree by >2x.
-    secs = []
-    results = None
-    while len(secs) < 2 or (max(secs) / min(secs) > 2 and len(secs) < 5):
-        sec, results = _timed_pass(engine, True, timed_rounds)
-        secs.append(sec)
+    # min over repeated warm passes (bench._min_over_reps: a single sample
+    # under pool congestion can be 10x noise)
+    sec, results = _min_over_reps(
+        lambda: _timed_pass(engine, True, timed_rounds))
     auc = float(np.nanmean(results[-1].client_metrics))
-    return min(secs), auc, n_real
+    return sec, auc, n_real
 
 
 def scen_single_client():
@@ -86,10 +82,15 @@ def scen_single_client():
             data.valid_xb, data.valid_mb)
     out = train(*args)
     jax.block_until_ready(out[0])              # compile + warm
-    t0 = time.time()
-    out = train(*args)
-    jax.block_until_ready(out[0])
-    sec = time.time() - t0
+
+    def timed_once():
+        t0 = time.time()
+        o = train(*args)
+        jax.block_until_ready(o[0])
+        return time.time() - t0, o
+
+    # min over warm passes (same bursty-tunnel protocol as _run_rounds)
+    sec, out = _min_over_reps(timed_once)
     p0 = jax.tree.map(lambda t: t[0], out[0])
     mask = np.asarray(data.test_m[0]) > 0
     # drop the stacked tensors' zero-padding rows before the centroid fit —
