@@ -1,0 +1,27 @@
+#!/bin/bash
+# Serial on-hardware capture battery. Run when the axon tunnel is healthy
+# (probe first: `timeout 100 python -c "import jax; jax.devices()"`).
+# SERIAL on purpose: two processes initializing the TPU concurrently wedge
+# each other's device init (see PARITY.md §4 timing-protocol note).
+#
+# Usage: bash capture_tpu.sh [outdir]   (default /tmp/tpu_capture)
+set -u
+cd "$(dirname "$0")"
+OUT=${1:-/tmp/tpu_capture}   # relative paths resolve against the repo root
+mkdir -p "$OUT"
+
+run() {  # run <name> <cmd...>: log, never abort the battery on one failure
+    local name=$1; shift
+    echo "=== $name: $* ($(date +%H:%M:%S)) ==="
+    if "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"; then
+        echo "--- $name ok; tail:"; tail -2 "$OUT/$name.out"
+    else
+        echo "--- $name FAILED (rc=$?); tail:"; tail -3 "$OUT/$name.err"
+    fi
+}
+
+run tpu_check   python tpu_check.py
+run bench_quick python bench.py
+run bench_paper python bench.py --paper-scale
+run bench_suite python bench_suite.py --out "$OUT/BENCH_SUITE_tpu.json"
+echo "=== battery done ($(date +%H:%M:%S)); artifacts in $OUT ==="

@@ -22,7 +22,9 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 # with a warm cache the same suite runs in a fraction of that. The cache dir
 # survives across pytest invocations on this machine; the 2-process multihost
 # workers inherit it through the environment (concurrent writers are safe —
-# entries land via atomic rename).
+# entries land via atomic rename). Warm floor on this 1-core box is ~6.5 min:
+# the residual is Python-side tracing/lowering of the many distinct fused
+# round programs, which jax cannot cache across processes.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(tempfile.gettempdir(),
                                    "fedmse_xla_cache"))
