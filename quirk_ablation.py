@@ -1,0 +1,109 @@
+"""Quirk ablation: measure what each reference accidental behavior costs.
+
+The reference's committed behavior includes six accidental-but-load-bearing
+quirks (SURVEY.md §2), each reproduced by default behind a `CompatConfig`
+switch. This harness runs the committed quick-run protocol (hybrid SAE-CEN +
+mse_avg, 10-client N-BaIoT IID, 3 runs) with each switch flipped to its
+FIXED variant individually, against the all-quirks baseline — answering
+"does reproducing the reference's bug matter, and in which direction?"
+with measured AUC rather than speculation.
+
+Quirks ablated (reference citations in fedmse_tpu/config.py:CompatConfig):
+  shared_last_client_val        -> each client verifies on its OWN valid split
+  inverted_global_early_stop    -> higher-is-better global early stopping
+  global_early_stop_state_shared-> fresh early-stop state per run (the
+                                   reference carries `min_val_loss` across
+                                   every run of the sweep, src/main.py:55)
+  no_best_restore               -> restore best local weights after training
+  restandardize_vote_data       -> vote on the already-standardized tensors
+  vote_tie_break                -> deterministic MSE scores (no +/-0.01% jitter)
+
+The baseline reproduces quirk 10b faithfully: ONE GlobalEarlyStop instance
+is shared across the variant's 3 runs (exactly like main.py:run_experiment
+across a sweep), so a low `best` carried out of run 0 can truncate runs 1-2.
+
+Writes one JSON object to ABLATION.json (override with --out) and prints a
+per-variant line. Run on CPU: `env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+python quirk_ablation.py`.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from bench import build_data  # noqa: E402
+
+NUM_RUNS = 3
+
+
+def run_variant(name, cfg, data, n_real):
+    """3 independent federations of hybrid+mse_avg under `cfg`; returns the
+    summary row (mean/std of final-round mean client AUC + rounds run)."""
+    import numpy as np
+
+    from fedmse_tpu.main import GlobalEarlyStop, run_combination
+
+    # quirk 10b faithful: shared early-stop state across runs unless the
+    # variant fixes it (mirrors main.py:run_experiment:264-276)
+    es = GlobalEarlyStop(inverted=cfg.compat.inverted_global_early_stop,
+                         patience=cfg.global_patience)
+    es.reset()
+    finals, rounds_run = [], []
+    for run in range(NUM_RUNS):
+        if not cfg.compat.global_early_stop_state_shared:
+            es.reset()  # fixed mode: per-run state
+        out = run_combination(cfg, data, n_real, "hybrid", "mse_avg", run,
+                              early_stop=es)
+        finals.append(float(np.nanmean(out["final_metrics"])))
+        rounds_run.append(out["rounds_run"])
+    row = {"variant": name,
+           "final_auc_mean": round(float(np.mean(finals)), 5),
+           "final_auc_std": round(float(np.std(finals)), 5),
+           "auc_runs": [round(f, 5) for f in finals],
+           "rounds_run": rounds_run}
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main():
+    from fedmse_tpu.config import ExperimentConfig
+
+    cfg = ExperimentConfig()  # committed quick-run defaults, all quirks ON
+    data, n_real, _ = build_data(cfg, 10)
+
+    rows = [run_variant("baseline (all reference quirks)", cfg, data, n_real)]
+    for field in ("shared_last_client_val", "inverted_global_early_stop",
+                  "global_early_stop_state_shared", "no_best_restore",
+                  "restandardize_vote_data", "vote_tie_break"):
+        fixed = cfg.replace(
+            compat=dataclasses.replace(cfg.compat, **{field: False}))
+        rows.append(run_variant(f"fixed: {field}=False", fixed, data, n_real))
+
+    base = rows[0]["final_auc_mean"]
+    for row in rows[1:]:
+        row["delta_vs_baseline"] = round(row["final_auc_mean"] - base, 5)
+
+    out = {"protocol": "N-BaIoT 10-client IID, hybrid SAE-CEN + mse_avg, "
+                       "committed quick-run defaults (5 epochs, 3 rounds, "
+                       "lr 1e-3, batch 12, 50% participation), "
+                       f"{NUM_RUNS} runs/variant, global early stop active",
+           "metric": "final-round mean client AUC",
+           "variants": rows}
+    out_path = "ABLATION.json"
+    if "--out" in sys.argv:
+        idx = sys.argv.index("--out") + 1
+        if idx >= len(sys.argv):
+            sys.exit("--out expects a path")
+        out_path = sys.argv[idx]
+    with open(os.path.join(REPO_ROOT, out_path), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"wrote": out_path, "n_variants": len(rows)}))
+
+
+if __name__ == "__main__":
+    main()
