@@ -41,7 +41,7 @@ from bench import build_data  # noqa: E402
 NUM_RUNS = 3
 
 
-def run_variant(name, cfg, data, n_real):
+def run_variant(name, cfg, data, n_real, use_early_stop=True):
     """3 independent federations of hybrid+mse_avg under `cfg`; returns the
     summary row (mean/std of final-round mean client AUC + rounds run)."""
     import numpy as np
@@ -58,7 +58,7 @@ def run_variant(name, cfg, data, n_real):
         if not cfg.compat.global_early_stop_state_shared:
             es.reset()  # fixed mode: per-run state
         out = run_combination(cfg, data, n_real, "hybrid", "mse_avg", run,
-                              early_stop=es)
+                              early_stop=es if use_early_stop else None)
         finals.append(float(np.nanmean(out["final_metrics"])))
         rounds_run.append(out["rounds_run"])
     row = {"variant": name,
@@ -74,27 +74,45 @@ def main():
     from fedmse_tpu.config import ExperimentConfig
 
     cfg = ExperimentConfig()  # committed quick-run defaults, all quirks ON
+    protocol = ("N-BaIoT 10-client IID, hybrid SAE-CEN + mse_avg, "
+                "committed quick-run defaults (5 epochs, 3 rounds, lr 1e-3, "
+                "batch 12, 50% participation), "
+                f"{NUM_RUNS} runs/variant, global early stop active")
+    fields = ("shared_last_client_val", "inverted_global_early_stop",
+              "global_early_stop_state_shared", "no_best_restore",
+              "restandardize_vote_data", "vote_tie_break")
+    use_es = True
+    out_default = "ABLATION.json"
+    if "--paper-scale" in sys.argv:
+        # paper protocol has NO global early stop (README.md:30-34), so the
+        # early-stop quirks cannot bind; only quirk 11 (best-weight restore)
+        # remains interesting — ablate just that one.
+        from fedmse_tpu.config import paper_scale
+        cfg = paper_scale(cfg)
+        protocol = ("N-BaIoT 10-client IID, hybrid SAE-CEN + mse_avg, "
+                    "paper-scale (100 epochs, 20 rounds, lr 1e-5, lambda 10),"
+                    f" {NUM_RUNS} runs/variant, no global early stop")
+        fields = ("no_best_restore",)
+        use_es = False
+        out_default = "ABLATION_PAPER.json"  # never clobber the quick-run one
     data, n_real, _ = build_data(cfg, 10)
 
-    rows = [run_variant("baseline (all reference quirks)", cfg, data, n_real)]
-    for field in ("shared_last_client_val", "inverted_global_early_stop",
-                  "global_early_stop_state_shared", "no_best_restore",
-                  "restandardize_vote_data", "vote_tie_break"):
+    rows = [run_variant("baseline (all reference quirks)", cfg, data, n_real,
+                        use_early_stop=use_es)]
+    for field in fields:
         fixed = cfg.replace(
             compat=dataclasses.replace(cfg.compat, **{field: False}))
-        rows.append(run_variant(f"fixed: {field}=False", fixed, data, n_real))
+        rows.append(run_variant(f"fixed: {field}=False", fixed, data, n_real,
+                                use_early_stop=use_es))
 
     base = rows[0]["final_auc_mean"]
     for row in rows[1:]:
         row["delta_vs_baseline"] = round(row["final_auc_mean"] - base, 5)
 
-    out = {"protocol": "N-BaIoT 10-client IID, hybrid SAE-CEN + mse_avg, "
-                       "committed quick-run defaults (5 epochs, 3 rounds, "
-                       "lr 1e-3, batch 12, 50% participation), "
-                       f"{NUM_RUNS} runs/variant, global early stop active",
+    out = {"protocol": protocol,
            "metric": "final-round mean client AUC",
            "variants": rows}
-    out_path = "ABLATION.json"
+    out_path = out_default
     if "--out" in sys.argv:
         idx = sys.argv.index("--out") + 1
         if idx >= len(sys.argv):
