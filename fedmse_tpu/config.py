@@ -199,6 +199,12 @@ class ExperimentConfig:
     # TPU-specific knobs (no reference equivalent)
     mesh_shape: Optional[Tuple[int, ...]] = None  # None => all local devices
     client_axis_name: str = "clients"
+    # compact-cohort training: gather the selected clients' state + data,
+    # train only those S clients, scatter back — compute scales with the
+    # participation ratio instead of the full client axis (identical math;
+    # see local_training.make_local_train_all). False = dense: every stacked
+    # client trains and unselected results are masked away.
+    compact_cohort: bool = True
     # fused single-kernel forward for evaluation: 'off' | 'auto' | 'pallas' |
     # 'xla' ('auto' = pallas on TPU, XLA-fused elsewhere; ops/pallas_ae.py)
     fused_eval: str = "off"

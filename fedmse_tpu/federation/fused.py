@@ -102,6 +102,7 @@ def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
 def make_round_body(train_all: Callable, scores_fn: Callable,
                     aggregate: Callable, verify: Callable,
                     evaluate_all: Callable, max_threshold: int,
+                    compact_cohort: bool = False,
                     poison_fn: Optional[Callable] = None) -> Callable:
     """Build the traceable round body (jit-wrapped by make_fused_round,
     scanned directly by make_fused_rounds_scan):
@@ -129,7 +130,8 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
         # ---- local training of the selected cohort (src/main.py:276-279) ----
         params, opt_state, best_params, min_valid, tracking = train_all(
             states.params, states.opt_state, states.prev_global, sel_mask,
-            data.train_xb, data.train_mb, data.valid_xb, data.valid_mb)
+            data.train_xb, data.train_mb, data.valid_xb, data.valid_mb,
+            sel_idx=sel_indices if compact_cohort else None)
         states = ClientStates(
             params=params, opt_state=opt_state, prev_global=states.prev_global,
             hist_params=states.hist_params, hist_perf=states.hist_perf,

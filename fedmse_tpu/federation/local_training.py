@@ -122,17 +122,49 @@ def make_local_train_all(model, tx: optax.GradientTransformation,
                          donate: bool = True, restore_best: bool = False) -> Callable:
     """Jitted, vmapped training of all clients with a selection mask.
 
-    Returns fn(states_params, states_opt, prev_global, sel_mask, data) ->
+    Returns fn(states_params, states_opt, prev_global, sel_mask, data,
+    sel_idx=None) ->
     (params, opt_state, best_params, min_valid [N], tracking [N, E, 3]).
     Unselected clients keep params/opt unchanged (reference trains only the
     selected cohort, src/main.py:276-279).
+
+    Two execution strategies, identical per-client math:
+      * dense (sel_idx=None): every stacked client trains, unselected
+        results are discarded by mask — zero data movement, the vmap width
+        is the full padded client axis. Right when compute per lane is
+        ~free (wide accelerators) or the cohort IS the federation.
+      * compact (sel_idx = static-shape [S] indices of the selected
+        cohort, no duplicates): gather the cohort's state + data, train S
+        clients, scatter results back (`.at[sel_idx].set` aliases the
+        donated buffers). Cuts training compute by the participation ratio
+        — a 2x round-time win at 50% participation on lane-starved
+        backends (the 1-core CPU fallback), and what keeps the 20%-
+        participation 50-client scenario from training 5x too much work.
     """
     train_one = make_local_train_one(model, tx, epochs, patience, fedprox, mu)
     train_vmapped = jax.vmap(train_one)
 
     @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def train_all(params, opt_state, prev_global, sel_mask,
-                  train_xb, train_mb, valid_xb, valid_mb):
+                  train_xb, train_mb, valid_xb, valid_mb, sel_idx=None):
+        if sel_idx is not None:
+            # ---- compact cohort: gather -> train [S] -> scatter back ----
+            gather = lambda t: jnp.take(t, sel_idx, axis=0)  # noqa: E731
+            res = train_vmapped(
+                jax.tree.map(gather, params), jax.tree.map(gather, opt_state),
+                jax.tree.map(gather, prev_global), gather(train_xb),
+                gather(train_mb), gather(valid_xb), gather(valid_mb))
+            final = res.best_params if restore_best else res.params
+            scatter = lambda full, sub: full.at[sel_idx].set(sub)  # noqa: E731
+            n = sel_mask.shape[0]
+            return (jax.tree.map(scatter, params, final),
+                    jax.tree.map(scatter, opt_state, res.opt_state),
+                    jax.tree.map(scatter, params, res.best_params),
+                    jnp.full((n,), jnp.nan, jnp.float32)
+                       .at[sel_idx].set(res.min_valid),
+                    jnp.full((n,) + res.tracking.shape[1:], jnp.nan,
+                             jnp.float32).at[sel_idx].set(res.tracking))
+
         res = train_vmapped(params, opt_state, prev_global,
                             train_xb, train_mb, valid_xb, valid_mb)
         sel = sel_mask > 0
@@ -143,9 +175,12 @@ def make_local_train_all(model, tx: optax.GradientTransformation,
         out_opt = tree_select_clients(sel, res.opt_state, opt_state)
         # unselected clients never trained this round: blank their curves so
         # consumers don't read phantom training (their weights were untouched)
+        # — and mask best_params the same way (their dense-lane "training"
+        # is discarded everywhere, matching the compact path's contract)
+        best = tree_select_clients(sel, res.best_params, params)
         nanmask = jnp.where(sel, 1.0, jnp.nan)
         min_valid = res.min_valid * nanmask
         tracking = res.tracking * nanmask[:, None, None]
-        return out_params, out_opt, res.best_params, min_valid, tracking
+        return out_params, out_opt, best, min_valid, tracking
 
     return train_all

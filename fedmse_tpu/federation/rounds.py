@@ -167,7 +167,7 @@ class RoundEngine:
         # global arrays must be jit arguments, not closure constants)
         args = (self.train_all, self.scores_fn, self.aggregate, self.verify,
                 self.evaluate_all, self.cfg.max_aggregation_threshold,
-                self.poison_fn)
+                self.cfg.compact_cohort, self.poison_fn)
         # same sharing rationale as _engine_programs; the builders are keyed
         # by the already-cached phase callables, so identity works — except
         # with an attack poison_fn (arbitrary callable, not cache-keyable)
@@ -332,9 +332,12 @@ class RoundEngine:
 
         # ---- local training (all selected clients in parallel) ----
         with self.timer.phase("train"):
+            sel_idx = (jnp.asarray(sorted(selected), jnp.int32)
+                       if cfg.compact_cohort else None)
             params, opt_state, best_params, min_valid, tracking = self.train_all(
                 self.states.params, self.states.opt_state, self.states.prev_global,
-                sel_mask, data.train_xb, data.train_mb, data.valid_xb, data.valid_mb)
+                sel_mask, data.train_xb, data.train_mb, data.valid_xb,
+                data.valid_mb, sel_idx=sel_idx)
             if self.timer.enabled:
                 jax.block_until_ready(params)
         self.states = dataclasses.replace(self.states, params=params,

@@ -254,6 +254,33 @@ def test_unselected_clients_unchanged(model):
     assert np.isnan(np.asarray(min_valid)[1])
 
 
+def test_compact_cohort_matches_dense(model):
+    """sel_idx gather->train->scatter must reproduce the dense masked path
+    exactly: same trained params/opt for the cohort, untouched state and
+    NaN curves for the rest (local_training.make_local_train_all)."""
+    tx = optax.adam(1e-2)
+    train_all = make_local_train_all(model, tx, epochs=3, patience=3,
+                                     fedprox=False, mu=0.0, donate=False)
+    states = _mk_states(model, n=4)
+    rng = np.random.default_rng(11)
+    xb = jnp.asarray(rng.normal(size=(4, 5, 8, DIM)).astype(np.float32))
+    mb = jnp.ones((4, 5, 8))
+    sel = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    args = (states.params, states.opt_state, states.prev_global, sel,
+            xb, mb, xb, mb)
+    dense = train_all(*args)
+    compact = train_all(*args, sel_idx=jnp.asarray([0, 2], jnp.int32))
+    for out in (0, 1, 2):  # params, opt_state, best_params
+        for d, c in zip(jax.tree.leaves(dense[out]),
+                        jax.tree.leaves(compact[out])):
+            np.testing.assert_allclose(np.asarray(d), np.asarray(c),
+                                       atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dense[3]), np.asarray(compact[3]),
+                               atol=1e-6)  # min_valid incl. NaN slots
+    np.testing.assert_allclose(np.asarray(dense[4]), np.asarray(compact[4]),
+                               atol=1e-6)  # tracking incl. NaN rows
+
+
 def test_early_stopping_freezes_params(model):
     """With patience=1 and a validation set the model can't improve on
     (constant zeros after convergence), later epochs must be no-ops."""
