@@ -13,7 +13,9 @@ mkdir -p "$OUT"
 run() {  # run <name> <cmd...>: log, never abort the battery on one failure
     local name=$1; shift
     echo "=== $name: $* ($(date +%H:%M:%S)) ==="
-    if "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"; then
+    # per-step timeout: the tunnel can wedge MID-battery; a hung step must
+    # not stop the remaining captures (or the watcher driving this script)
+    if timeout 1200 "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"; then
         echo "--- $name ok; tail:"; tail -2 "$OUT/$name.out"
     else
         echo "--- $name FAILED (rc=$?); tail:"; tail -3 "$OUT/$name.err"
