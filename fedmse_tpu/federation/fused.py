@@ -147,7 +147,9 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
 
         # ---- aggregate + broadcast + verify (src/main.py:291-312) ----
         def do_aggregate(states):
-            agg_params, weights = aggregate(states.params, sel_mask, data.dev_x)
+            agg_params, weights = aggregate(
+                states.params, sel_mask, data.dev_x,
+                sel_idx=sel_indices if compact_cohort else None)
             if poison_fn is not None:  # malicious-aggregator tampering point
                 # fold constant is any index the voter loop can't reach
                 agg_params = poison_fn(agg_params, round_index,

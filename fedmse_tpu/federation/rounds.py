@@ -364,7 +364,8 @@ class RoundEngine:
                 self.host.aggregation_count[aggregator] < cfg.max_aggregation_threshold:
             with self.timer.phase("aggregate"):
                 agg_params, weights = self.aggregate(self.states.params,
-                                                     sel_mask, data.dev_x)
+                                                     sel_mask, data.dev_x,
+                                                     sel_idx=sel_idx)
                 if self.poison_fn is not None:  # attack simulation
                     agg_params = self.poison_fn(
                         agg_params, jnp.asarray(round_index, jnp.int32),

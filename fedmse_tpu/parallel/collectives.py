@@ -28,11 +28,17 @@ from fedmse_tpu.ops.losses import mse_loss
 
 def make_shardmap_aggregate(model, update_type: str, mesh: Mesh,
                             axis_name: str = "clients") -> Callable:
-    """Build fn(stacked_params, sel_mask, dev_x) -> (agg_params, weights[N]).
+    """Build fn(stacked_params, sel_mask, dev_x, sel_idx=None) ->
+    (agg_params, weights[N]).
 
     Semantics identical to federation.aggregation.make_aggregate_fn (fed_avg /
     fedprox = masked mean, fed_mse_avg = 1/MSE(dev) weights — reference
-    client_trainer.py:107-134); execution is explicit SPMD.
+    client_trainer.py:107-134); execution is explicit SPMD. `sel_idx` is
+    accepted for drop-in signature parity with make_aggregate_fn but
+    ignored: this form scores each shard's clients locally (already
+    embarrassingly parallel), whereas a compact gather by global indices
+    would cross shards and turn zero-communication scoring into an
+    all-to-all. Weights are identical either way.
     """
 
     def dev_mse(params, dev_x):
@@ -61,7 +67,9 @@ def make_shardmap_aggregate(model, update_type: str, mesh: Mesh,
         return jax.tree.map(lambda _: P(axis_name), tree)
 
     @jax.jit
-    def aggregate(stacked_params, sel_mask, dev_x) -> Tuple[Any, jax.Array]:
+    def aggregate(stacked_params, sel_mask, dev_x,
+                  sel_idx=None) -> Tuple[Any, jax.Array]:
+        del sel_idx  # see docstring: per-shard scoring is already local
         fn = shard_map(
             per_device, mesh=mesh,
             in_specs=(in_specs_for(stacked_params), spec_clients, P()),

@@ -254,6 +254,22 @@ def test_unselected_clients_unchanged(model):
     assert np.isnan(np.asarray(min_valid)[1])
 
 
+def test_compact_aggregate_matches_dense(model):
+    """fed_mse_avg with sel_idx scores only the cohort; weights and the
+    aggregated model must equal the dense scoring path exactly."""
+    agg = make_aggregate_fn(model, "mse_avg")
+    states = _mk_states(model, n=4)
+    rng = np.random.default_rng(12)
+    dev = jnp.asarray(rng.normal(size=(20, DIM)).astype(np.float32))
+    sel = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    p_d, w_d = agg(states.params, sel, dev)
+    p_c, w_c = agg(states.params, sel, dev,
+                   sel_idx=jnp.asarray([0, 2], jnp.int32))
+    np.testing.assert_allclose(np.asarray(w_d), np.asarray(w_c), atol=1e-7)
+    for d, c in zip(jax.tree.leaves(p_d), jax.tree.leaves(p_c)):
+        np.testing.assert_allclose(np.asarray(d), np.asarray(c), atol=1e-7)
+
+
 def test_compact_cohort_matches_dense(model):
     """sel_idx gather->train->scatter must reproduce the dense masked path
     exactly: same trained params/opt for the cohort, untouched state and

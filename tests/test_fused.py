@@ -18,10 +18,10 @@ N = 4
 
 
 def build_engine(fused: bool, update_type: str = "mse_avg",
-                 model_type: str = "hybrid", pad_to: int = None):
+                 model_type: str = "hybrid", pad_to: int = None, **cfg_kw):
     cfg = ExperimentConfig(
         dim_features=DIM, network_size=N, epochs=2, batch_size=8,
-        compat=CompatConfig(vote_tie_break=False))
+        compat=CompatConfig(vote_tie_break=False), **cfg_kw)
     clients = synthetic_clients(n_clients=N, dim=DIM, n_normal=120,
                                 n_abnormal=60)
     rngs = ExperimentRngs(run=0)
@@ -89,6 +89,26 @@ def test_program_cache_shares_and_separates():
     rc = c.run_round(0, selected=[0, 2])
     # different lr must actually train differently
     assert not np.allclose(ra.min_valid, rc.min_valid, equal_nan=True)
+
+
+def test_whole_round_compact_matches_dense():
+    """compact_cohort toggles the gather/scatter strategy in training AND
+    fed_mse_avg scoring; a full fused round must produce the same elected
+    aggregator and near-ulp-identical metrics/state either way (the bench's
+    run-to-run AUC wiggle across recompiles is chaotic amplification of
+    ulp noise, not a semantic difference — this pins the semantics)."""
+    import jax
+    dense = build_engine(fused=True, compact_cohort=False)
+    compact = build_engine(fused=True, compact_cohort=True)
+    for r, sel in enumerate(([0, 2], [1, 3])):
+        rd = dense.run_round_fused(r, selected=sel)
+        rc = compact.run_round_fused(r, selected=sel)
+        assert rd.aggregator == rc.aggregator
+        np.testing.assert_allclose(rd.client_metrics, rc.client_metrics,
+                                   rtol=1e-4, atol=1e-5)
+    for d, c in zip(jax.tree.leaves(jax.device_get(dense.states.params)),
+                    jax.tree.leaves(jax.device_get(compact.states.params))):
+        np.testing.assert_allclose(d, c, atol=1e-6)
 
 
 def test_fused_with_padded_clients():
