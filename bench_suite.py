@@ -117,6 +117,8 @@ def main():
             sys.exit(f"--only expects a scenario number 1-5, got {only}")
 
     _ensure_live_backend()
+    from fedmse_tpu.utils.platform import enable_compilation_cache
+    enable_compilation_cache()  # persistent XLA cache across suite runs
     import jax
     from fedmse_tpu.config import DatasetConfig, ExperimentConfig
 
@@ -177,6 +179,9 @@ def main():
            "scenarios": rows,
            "provenance": "BASELINE.json configs checklist, fused-scan "
                          "engine, warmed timing"}
+    if only is not None:  # a --only file must never pass as the full suite
+        out["partial"] = True
+        out["only"] = only
     reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
     if reason and reason != "1":
         out["tpu_fallback_reason"] = reason

@@ -24,6 +24,13 @@ run() {  # run <name> <cmd...>: log, never abort the battery on one failure
 
 run tpu_check   python tpu_check.py
 run bench_quick python bench.py
-run bench_paper python bench.py --paper-scale
+run bench_paper python bench.py --paper-scale          # num_runs=5 default
+run bench_c25   python bench.py --clients 25
+run bench_c50   python bench.py --clients 50
+run bench_c100  python bench.py --clients 100          # first 100-client TPU point
+# device-time accounting of one fused chunk (VERDICT r3 #3)
+if [ -f profile_fused.py ]; then
+    run profile python profile_fused.py --out "$OUT/PROFILE_tpu.json"
+fi
 run bench_suite python bench_suite.py --out "$OUT/BENCH_SUITE_tpu.json"
 echo "=== battery done ($(date +%H:%M:%S)); artifacts in $OUT ==="

@@ -203,7 +203,9 @@ class ExperimentConfig:
     # train only those S clients, scatter back — compute scales with the
     # participation ratio instead of the full client axis (identical math;
     # see local_training.make_local_train_all). False = dense: every stacked
-    # client trains and unselected results are masked away.
+    # client trains and unselected results are masked away. The engine
+    # auto-falls back to dense when the client axis is sharded across
+    # devices (compact gathers would cross shards — RoundEngine.compact).
     compact_cohort: bool = True
     # fused single-kernel forward for evaluation: 'off' | 'auto' | 'pallas' |
     # 'xla' ('auto' = pallas on TPU, XLA-fused elsewhere; ops/pallas_ae.py)

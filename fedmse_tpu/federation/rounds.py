@@ -109,6 +109,18 @@ def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
     return programs
 
 
+def _client_axis_is_sharded(arr) -> bool:
+    """True when axis 0 (the client axis) of a stacked tensor is split
+    across devices (host numpy and single-device arrays are not)."""
+    sharding = getattr(arr, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        return sharding.shard_shape(arr.shape)[0] != arr.shape[0]
+    except Exception:
+        return False
+
+
 class RoundEngine:
     """One (model_type, update_type) federation over stacked client state."""
 
@@ -149,9 +161,11 @@ class RoundEngine:
         self.timer = PhaseTimer(enabled=profile)
 
         self.fused = fused
+        self._warned_compact_off = False  # log the compact fallback once
         self.poison_fn = poison_fn  # attack simulation (federation/attack.py)
         self._fused_round = None
         self._fused_scan = None
+        self._fused_compact = None  # compact value baked into the programs
         if fused and profile:
             logger.warning("profile=True forces the per-phase (unfused) round "
                            "path; fused dispatch is not phase-attributable")
@@ -165,9 +179,10 @@ class RoundEngine:
                 "into the fused round/scan programs")
         # data / verification tensors are passed at CALL time (sharded
         # global arrays must be jit arguments, not closure constants)
+        self._fused_compact = self.compact  # value baked into the programs
         args = (self.train_all, self.scores_fn, self.aggregate, self.verify,
                 self.evaluate_all, self.cfg.max_aggregation_threshold,
-                self.cfg.compact_cohort, self.poison_fn)
+                self._fused_compact, self.poison_fn)
         # same sharing rationale as _engine_programs; the builders are keyed
         # by the already-cached phase callables, so identity works — except
         # with an attack poison_fn (arbitrary callable, not cache-keyable)
@@ -179,6 +194,27 @@ class RoundEngine:
         self._fused_scan = make_fused_rounds_scan(*args)
         if self.poison_fn is None:
             _cache_put(key, (self._fused_round, self._fused_scan))
+
+    @property
+    def compact(self) -> bool:
+        """Effective compact-cohort switch, evaluated at USE time: callers
+        replace `engine.data` with mesh-sharded arrays AFTER construction
+        (main.py:run_combination, shard_federation), so a value frozen in
+        __init__ would miss the sharding. Compact gathers (jnp.take by
+        global client index) cross shards when the client axis is split
+        over devices — exactly the cross-device traffic the dense path
+        avoids (ADVICE r3) — so fall back to dense there; compact stays
+        the default off-mesh."""
+        if not self.cfg.compact_cohort:
+            return False
+        if _client_axis_is_sharded(self.data.train_xb):
+            if not self._warned_compact_off:
+                self._warned_compact_off = True
+                logger.info("compact_cohort disabled: client axis is "
+                            "sharded across devices; dense masked training "
+                            "avoids cross-shard gathers")
+            return False
+        return True
 
     # ------------------------------------------------------------------ #
 
@@ -271,8 +307,8 @@ class RoundEngine:
         """ONE dispatch for one round. `selected`/`key` override the host
         streams — used by the driver to REPLAY a scanned chunk's prefix with
         the exact same selections and PRNG keys (main.py:run_combination)."""
-        if self._fused_round is None:
-            self._build_fused()
+        if self._fused_round is None or self._fused_compact != self.compact:
+            self._build_fused()  # rebuild when a data swap flipped compact
         if selected is None:
             selected = self.select_clients()
         if key is None:
@@ -294,8 +330,8 @@ class RoundEngine:
         prefix round-by-round with identical inputs. Selections and keys are
         drawn from the same host streams, in the same order, as n_rounds
         successive `run_round_fused` calls."""
-        if self._fused_scan is None:
-            self._build_fused()
+        if self._fused_scan is None or self._fused_compact != self.compact:
+            self._build_fused()  # rebuild when a data swap flipped compact
         schedule = [self.select_clients() for _ in range(n_rounds)]
         # one dispatch for all R round keys (vs R fold_in round-trips; the
         # stream is identical — see ExperimentRngs.next_jax_batch)
@@ -333,7 +369,7 @@ class RoundEngine:
         # ---- local training (all selected clients in parallel) ----
         with self.timer.phase("train"):
             sel_idx = (jnp.asarray(sorted(selected), jnp.int32)
-                       if cfg.compact_cohort else None)
+                       if self.compact else None)
             params, opt_state, best_params, min_valid, tracking = self.train_all(
                 self.states.params, self.states.opt_state, self.states.prev_global,
                 sel_mask, data.train_xb, data.train_mb, data.valid_xb,

@@ -58,8 +58,11 @@ class GlobalEarlyStop:
 
     inverted: bool = True
     patience: int = 1
-    best: float = math.inf
-    worse: int = 0
+    best: float = dataclasses.field(init=False)
+    worse: int = dataclasses.field(init=False)
+
+    def __post_init__(self):
+        self.reset()  # the one home of the best/worse init invariant
 
     def reset(self):
         self.best, self.worse = (math.inf if self.inverted else -math.inf), 0
@@ -264,7 +267,6 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
     early_stop = GlobalEarlyStop(
         inverted=cfg.compat.inverted_global_early_stop,
         patience=cfg.global_patience)
-    early_stop.reset()
 
     best_metrics = {mt: {ut: float("-inf") for ut in cfg.update_types}
                     for mt in cfg.model_types}
@@ -314,7 +316,9 @@ def main(argv: Optional[List[str]] = None) -> Dict:
     # join the multi-controller runtime first (no-op on single hosts; must
     # run before any backend is touched — parallel/multihost.py)
     from fedmse_tpu.parallel import initialize_multihost
+    from fedmse_tpu.utils.platform import enable_compilation_cache
     initialize_multihost()
+    enable_compilation_cache()  # persistent XLA cache across driver runs
     args = build_parser().parse_args(argv)
     cfg = apply_cli_overrides(ExperimentConfig(), args)
     if args.paper_scale:

@@ -15,6 +15,38 @@ import os
 from typing import Optional
 
 
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
+    """Point jax at a persistent on-disk compilation cache (VERDICT r3 #6).
+
+    Every entry point (driver, bench, suite, ablation, tpu_check) and the
+    test conftest call this so recompiles of the same round programs are
+    disk hits across processes and sessions. Entries land via atomic rename,
+    so concurrent writers (multihost workers) are safe. Honors an existing
+    JAX_COMPILATION_CACHE_DIR; min-entry thresholds are zeroed because this
+    workload is many small programs."""
+    import tempfile
+
+    cache_dir = (cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                 or os.path.join(tempfile.gettempdir(), "fedmse_xla_cache"))
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    # re-read after setdefault so operator-exported thresholds stay in force
+    min_bytes = int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"])
+    min_secs = float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"])
+    try:  # jax may already be imported: apply the config directly too
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          min_bytes)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_secs)
+    except Exception:
+        pass  # pre-import call: the env vars above are picked up at import
+
+
 def force_cpu_platform(n_devices: Optional[int] = None) -> None:
     """Pin this process to the CPU backend BEFORE any backend initializes;
     optionally re-init with `n_devices` virtual CPU devices.

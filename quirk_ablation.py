@@ -52,7 +52,6 @@ def run_variant(name, cfg, data, n_real, use_early_stop=True):
     # variant fixes it (mirrors main.py:run_experiment:264-276)
     es = GlobalEarlyStop(inverted=cfg.compat.inverted_global_early_stop,
                          patience=cfg.global_patience)
-    es.reset()
     finals, rounds_run = [], []
     for run in range(NUM_RUNS):
         if not cfg.compat.global_early_stop_state_shared:
@@ -71,6 +70,8 @@ def run_variant(name, cfg, data, n_real, use_early_stop=True):
 
 
 def main():
+    from fedmse_tpu.utils.platform import enable_compilation_cache
+    enable_compilation_cache()
     from fedmse_tpu.config import ExperimentConfig
 
     cfg = ExperimentConfig()  # committed quick-run defaults, all quirks ON

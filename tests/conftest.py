@@ -10,7 +10,6 @@ we deregister the axon backend factory before any backend is initialized.
 
 import os
 import sys
-import tempfile
 
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
@@ -25,17 +24,14 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 # entries land via atomic rename). Warm floor on this 1-core box is ~6.5 min:
 # the residual is Python-side tracing/lowering of the many distinct fused
 # round programs, which jax cannot cache across processes.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(tempfile.gettempdir(),
-                                   "fedmse_xla_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
-
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
-from fedmse_tpu.utils.platform import force_cpu_platform  # noqa: E402
+from fedmse_tpu.utils.platform import (enable_compilation_cache,  # noqa: E402
+                                       force_cpu_platform)
+
+enable_compilation_cache()  # before any jax import reads the env
 
 force_cpu_platform()  # deregister the sitecustomize TPU tunnel pre-init
 

@@ -101,6 +101,8 @@ def run_midstop(pid: int) -> None:
 def main() -> None:
     port, pid = sys.argv[1], int(sys.argv[2])
     mode = sys.argv[3] if len(sys.argv) > 3 else "round"
+    if mode not in ("round", "midstop", "both"):  # a typo must fail loudly,
+        sys.exit(f"unknown mode {mode!r}")        # not silently run 'round'
 
     from fedmse_tpu.parallel import initialize_multihost
     initialize_multihost(coordinator_address=f"localhost:{port}",

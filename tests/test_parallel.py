@@ -113,6 +113,10 @@ def test_fifty_clients_on_eight_device_mesh():
                       model_type="hybrid", update_type="mse_avg", fused=True)
     eng.data, eng.states = shard_federation(data, eng.states, mesh)
     eng._ver_x, eng._ver_m = eng._verification_tensors()
+    # compact_cohort defaults True but must auto-fall back to dense once the
+    # client axis is sharded (compact gathers cross shards — ADVICE r3);
+    # the property reads CURRENT data, so post-construction sharding counts
+    assert cfg.compact_cohort and not eng.compact
     res = eng.run_round(0)
     assert res.client_metrics.shape == (50,)
     assert np.all(np.isfinite(res.client_metrics))

@@ -67,6 +67,8 @@ def race(impls: dict, *args) -> dict:
 
 def main() -> None:
     probe()
+    from fedmse_tpu.utils.platform import enable_compilation_cache
+    enable_compilation_cache()
     import jax
     import jax.numpy as jnp
     import numpy as np
