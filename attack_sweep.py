@@ -1,0 +1,137 @@
+"""Defense operating-point sweep (VERDICT r3 #7): accept/reject rates and
+rejected-counter trajectories vs attack kind x strength, INCLUDING strengths
+below the verification thresholds where the verifier should (and does) fail
+open.
+
+The verification subsystem (federation/verification.py) mirrors the
+reference's ModelVerifier: accept iff sum of per-tensor Frobenius update
+norms <= 3.0 AND the broadcast model's loss on the verification data does
+not exceed the client's history best by > 0.002
+(reference src/Trainer/model_verifier.py:72-75); a client whose consecutive
+rejections reach 3 logs "possible attack" (client_trainer.py:201-203).
+This sweep measures WHERE that operating point sits: which (kind, strength)
+cells are blocked, which sail through, and what each costs in final AUC.
+
+Protocol: committed quick-run config (10-client N-BaIoT IID, hybrid SAE-CEN
++ mse_avg), 8 fused rounds, round 0 clean (establishes verification
+history), rounds 1-7 attacked every round by a malicious elected aggregator
+(federation/attack.py tampers between aggregation and broadcast). One
+federation per cell, plus a no-attack baseline.
+
+Writes ATTACK.json (override with --out) and prints one line per cell.
+Run on CPU: `env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+python attack_sweep.py`.
+"""
+
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from bench import _ensure_live_backend, build_data  # noqa: E402
+
+ROUNDS = 8
+START = 1  # first attacked round; round 0 builds the verification history
+
+# kind -> strengths, spanning both sides of the 3.0 / 0.002 thresholds
+GRID = {
+    "scale": [1.001, 1.01, 1.05, 1.2, 2.0, 10.0],
+    "noise": [1e-4, 1e-3, 1e-2, 0.1, 1.0],
+    "sign_flip": [0.01, 1.0],
+    "zero": [1.0],
+}
+
+
+def run_cell(cfg, data, n_real, kind, strength):
+    import numpy as np
+
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.federation.attack import AttackSpec, make_poison_fn
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    poison = (None if kind is None else make_poison_fn(
+        AttackSpec(kind=kind, strength=strength, every_k=1,
+                   start_round=START)))
+    model = make_model("hybrid", cfg.dim_features,
+                       shrink_lambda=cfg.shrink_lambda)
+    engine = RoundEngine(model, cfg, data, n_real=n_real,
+                         rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                         model_type="hybrid", update_type="mse_avg",
+                         fused=True, poison_fn=poison)
+    results = engine.run_rounds(0, ROUNDS)
+
+    accept_events = reject_events = 0
+    max_rejected = 0
+    mean_rejected_curve = []
+    for res in results[START:]:
+        rows = res.verification_results
+        if not rows:  # no aggregator elected: nothing broadcast this round
+            mean_rejected_curve.append(None)
+            continue
+        # rejected_updates resets to 0 on accept, increments on reject —
+        # so ==0 means THIS round's broadcast was accepted by that client
+        acc = sum(1 for r in rows if r["rejected_updates"] == 0)
+        accept_events += acc
+        reject_events += len(rows) - acc
+        max_rejected = max(max_rejected,
+                           max(r["rejected_updates"] for r in rows))
+        mean_rejected_curve.append(round(
+            float(np.mean([r["rejected_updates"] for r in rows])), 3))
+    total = accept_events + reject_events
+    auc_curve = [round(float(np.nanmean(r.client_metrics)), 5)
+                 for r in results]
+    return {
+        "kind": kind or "none", "strength": strength,
+        "attacked_rounds": ROUNDS - START if kind else 0,
+        "accept_rate": round(accept_events / total, 4) if total else None,
+        "mean_rejected_curve": mean_rejected_curve,
+        "max_rejected_counter": max_rejected,
+        "possible_attack_flagged": bool(max_rejected >= 3),
+        "final_auc": auc_curve[-1],
+        "auc_curve": auc_curve,
+    }
+
+
+def main():
+    _ensure_live_backend()
+    from fedmse_tpu.utils.platform import enable_compilation_cache
+    enable_compilation_cache()
+    import jax
+
+    from fedmse_tpu.config import ExperimentConfig
+
+    out_path = "ATTACK.json"
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+
+    cfg = ExperimentConfig()
+    data, n_real, _ = build_data(cfg, 10)
+
+    cells = [run_cell(cfg, data, n_real, None, 0.0)]  # no-attack baseline
+    print(json.dumps(cells[0]), flush=True)
+    for kind, strengths in GRID.items():
+        for s in strengths:
+            cells.append(run_cell(cfg, data, n_real, kind, s))
+            print(json.dumps(cells[-1]), flush=True)
+
+    device = jax.devices()[0]
+    out = {
+        "protocol": f"quick-run 10-client N-BaIoT IID, hybrid+mse_avg, "
+                    f"{ROUNDS} fused rounds, rounds {START}-{ROUNDS - 1} "
+                    f"attacked every round; thresholds: Frobenius-sum 3.0, "
+                    f"perf-drop 0.002 (reference model_verifier.py:72-75)",
+        "device": str(device), "platform": device.platform,
+        "baseline": cells[0],
+        "cells": cells[1:],
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"wrote": out_path, "n_cells": len(cells) - 1}))
+
+
+if __name__ == "__main__":
+    main()

@@ -50,8 +50,8 @@ def _run_rounds(cfg, dataset, model_type, update_type, timed_rounds):
     # under pool congestion can be 10x noise)
     sec, results = _min_over_reps(
         lambda: _timed_pass(engine, True, timed_rounds))
-    auc = float(np.nanmean(results[-1].client_metrics))
-    return sec, auc, n_real
+    curve = [round(float(np.nanmean(r.client_metrics)), 5) for r in results]
+    return sec, curve[-1], n_real, curve
 
 
 def scen_single_client():
@@ -136,28 +136,31 @@ def main():
         emit(scen_single_client())
 
     if only in (None, 2):
-        sec, auc, _ = _run_rounds(ExperimentConfig(), nbaiot10,
-                                  "hybrid", "mse_avg", timed_rounds=20)
+        sec, auc, _, curve = _run_rounds(ExperimentConfig(), nbaiot10,
+                                         "hybrid", "mse_avg",
+                                         timed_rounds=20)
         emit({"scenario": "full P2P FedMSE, 10-client, 50% participation,"
                           " 20 rounds", "sec_per_round": round(sec, 4),
-              "final_auc": round(auc, 5),
+              "final_auc": round(auc, 5), "auc_curve": curve,
               "note": "late-round AUC drop is reference behavior: the "
                       "torch reference on the same 20-round quick-run "
-                      "schedule falls 0.999 -> 0.915 at round ~11 when "
-                      "aggregation quotas exhaust and clients drift on "
-                      "local lr=1e-3 training (measured r3)"})
+                      "schedule shows the same fall when aggregation "
+                      "quotas exhaust and clients drift on local lr=1e-3 "
+                      "training — side-by-side torch trajectory in "
+                      "TORCH_DRIFT_r04.json (torch_paper_check.py "
+                      "--quick --rounds 20)"})
 
     if only in (None, 3):
-        sec, auc, _ = _run_rounds(ExperimentConfig(), nbaiot10,
-                                  "hybrid", "avg", timed_rounds=3)
+        sec, auc, _, _ = _run_rounds(ExperimentConfig(), nbaiot10,
+                                     "hybrid", "avg", timed_rounds=3)
         emit({"scenario": "FedAvg baseline (MSE-weighting off), "
                           "10-client, 3 rounds",
               "sec_per_round": round(sec, 4), "final_auc": round(auc, 5)})
 
     if only in (None, 4):
         kitsune = DatasetConfig.from_json(KITSUNE_CFG)
-        sec, auc, n = _run_rounds(ExperimentConfig(), kitsune,
-                                  "hybrid", "mse_avg", timed_rounds=3)
+        sec, auc, n, _ = _run_rounds(ExperimentConfig(), kitsune,
+                                     "hybrid", "mse_avg", timed_rounds=3)
         emit({"scenario": f"Kitsune non-IID ({n} trainable clients), "
                           "hybrid + mse_avg, 3 rounds",
               "sec_per_round": round(sec, 4), "final_auc": round(auc, 5)})
@@ -168,8 +171,8 @@ def main():
             os.path.join(REPO_ROOT, "Data", "nbaiot-50clients-iid"), 50)
         cfg50 = ExperimentConfig(network_size=50, num_participants=0.2,
                                  num_rounds=50)
-        sec, auc, _ = _run_rounds(cfg50, nbaiot50, "hybrid", "mse_avg",
-                                  timed_rounds=50)
+        sec, auc, _, _ = _run_rounds(cfg50, nbaiot50, "hybrid", "mse_avg",
+                                     timed_rounds=50)
         emit({"scenario": "50-client scaled N-BaIoT, 20% participation, "
                           "50 rounds", "sec_per_round": round(sec, 4),
               "final_auc": round(auc, 5)})

@@ -121,6 +121,84 @@ def test_prep_cluster_labels_recover_modes(tmp_path):
     assert 300 <= out_rows <= 400  # <10-rows filter may trim minorities
 
 
+def test_apportion_largest_remainder():
+    from fedmse_tpu.data.prep import _apportion
+    w = np.array([3.0, 0.0, 1.0, 1.0])
+    c = _apportion(w, 10)
+    assert c.sum() == 10 and c[1] == 0       # exact total, zero stays zero
+    assert c[0] == 6 and c[2] == 2 and c[3] == 2
+    assert _apportion(np.zeros(3), 5).sum() == 0   # no mass -> no rows
+
+
+def test_prep_target_matrix_reconstruction(tmp_path):
+    """--target-matrix: the normal split realizes the count matrix CELL FOR
+    CELL (over feature-space modes), abnormal follows the matrix row shares,
+    test_normal follows the per-mode client proportions (zero cells stay
+    zero). Mirrors the published-split reconstruction of PARITY §2c
+    (Data-Examination.ipynb cells 40/42)."""
+    from fedmse_tpu.data.prep import create_federated_shards
+    from fedmse_tpu.data.loader import load_data
+
+    rng = np.random.default_rng(0)
+    src = str(tmp_path / "src")
+    # two source clients, each an even mixture of two separated modes
+    for k in (1, 2):
+        for split, n in (("normal", 200), ("abnormal", 80),
+                         ("test_normal", 100)):
+            d = os.path.join(src, f"Client-{k}", split)
+            os.makedirs(d)
+            a = rng.normal(0.0, 0.1, size=(n // 2, 5))
+            b = rng.normal(8.0, 0.1, size=(n // 2, 5))
+            np.savetxt(os.path.join(d, "data.csv"),
+                       np.concatenate([a, b]), delimiter=",")
+
+    M = np.array([[120, 0], [30, 60], [50, 100]])  # 3 clients x 2 modes
+    create_federated_shards(src, str(tmp_path / "out"), n_clients=3,
+                            mode="noniid", seed=0, cluster_labels=2,
+                            target_matrix=M)
+
+    def rows(k, split):
+        d = os.path.join(tmp_path, "out", f"Client-{k}", split)
+        return load_data(d).values if os.path.isdir(d) else np.zeros((0, 5))
+
+    # normal: cell-for-cell (mode -> column is a bijection shared by all
+    # clients, so the low-feature-mode counts equal one matrix column)
+    low = np.array([(rows(k, "normal").mean(axis=1) < 4).sum()
+                    for k in (1, 2, 3)])
+    high = np.array([(rows(k, "normal").mean(axis=1) > 4).sum()
+                     for k in (1, 2, 3)])
+    assert (np.array_equal(low, M[:, 0]) and np.array_equal(high, M[:, 1])) \
+        or (np.array_equal(low, M[:, 1]) and np.array_equal(high, M[:, 0]))
+    # abnormal: row-share apportionment of the whole 160-row pool
+    ab = np.array([len(rows(k, "abnormal")) for k in (1, 2, 3)])
+    want = np.round(M.sum(axis=1) / M.sum() * 160).astype(int)
+    assert ab.sum() == 160 and np.abs(ab - want).max() <= 1
+    # test_normal: correlated proportions — client 1's zero cell stays zero
+    t1 = rows(1, "test_normal").mean(axis=1)
+    zero_mode_rows = ((t1 > 4).sum() if np.array_equal(low, M[:, 0])
+                      else (t1 < 4).sum())
+    assert zero_mode_rows == 0
+    assert len(t1) > 0  # but the client IS tested on its trained mode
+
+    # uniform-tests variant (matrix_tests='uniform', the committed cells
+    # 28/35 alpha=1000 construction): normal stays cell-for-cell, but
+    # abnormal/test_normal are near-equal IID partitions
+    create_federated_shards(src, str(tmp_path / "out_uni"), n_clients=3,
+                            mode="noniid", seed=0, cluster_labels=2,
+                            target_matrix=M, matrix_tests="uniform")
+
+    def rows_uni(k, split):
+        d = os.path.join(tmp_path, "out_uni", f"Client-{k}", split)
+        return load_data(d).values if os.path.isdir(d) else np.zeros((0, 5))
+
+    low_u = np.array([(rows_uni(k, "normal").mean(axis=1) < 4).sum()
+                      for k in (1, 2, 3)])
+    assert sorted(low_u.tolist()) in (sorted(M[:, 0].tolist()),
+                                      sorted(M[:, 1].tolist()))
+    ab_u = np.array([len(rows_uni(k, "abnormal")) for k in (1, 2, 3)])
+    assert ab_u.sum() == 160 and ab_u.max() - ab_u.min() <= 1
+
+
 def test_prep_alpha_controls_js_distance(tmp_path):
     """--alpha maps onto non-IID severity exactly like FedArtML's dirichlet
     alpha: big alpha ~ IID (JS -> 0), small alpha ~ strong label skew."""

@@ -11,7 +11,12 @@ neutralize the global early stop (patience 1e9) so all 20 rounds run, run
 hybrid + mse_avg, then parse the per-round AUC json-lines the reference
 appends (src/main.py:342-355).
 
-Usage: python torch_paper_check.py <shard_dir> [runs=1]  -> one JSON line
+Usage: python torch_paper_check.py <shard_dir> [runs=1] [--quick]
+  -> one JSON line
+--quick keeps the reference's committed quick-run protocol (5 epochs,
+3 rounds, lr 1e-3, lambda 5 — src/main.py:37-57) instead of paper scale;
+used for the Kitsune anchor (PARITY §1), where the paper protocol was
+never published.
 """
 
 import glob
@@ -21,53 +26,76 @@ import sys
 
 from refharness import cleanup, run_reference
 
-_OVERRIDES = [
+_COMMON = [
     (r'^model_types = .*$', 'model_types = ["hybrid"]'),
     (r'^update_types = .*$', 'update_types = ["mse_avg"]'),
     (r'^network_size = .*$', 'network_size = {n}'),
-    (r'^num_rounds = .*$', 'num_rounds = 20'),
     (r'^num_runs = .*$', 'num_runs = {runs}'),
-    (r'^epoch = .*$', 'epoch = 100'),
-    (r'^lr_rate = .*$', 'lr_rate = 1e-5'),
-    (r'^shrink_lambda = .*$', 'shrink_lambda = 10'),
     (r'^global_patience = .*$', 'global_patience = 10**9'),
     (r'^config_file = .*$', 'config_file = "{cfg}"'),
 ]
+_PAPER = _COMMON + [
+    (r'^num_rounds = .*$', 'num_rounds = 20'),
+    (r'^epoch = .*$', 'epoch = 100'),
+    (r'^lr_rate = .*$', 'lr_rate = 1e-5'),
+    (r'^shrink_lambda = .*$', 'shrink_lambda = 10'),
+]
+_QUICK = _COMMON  # committed globals ARE the quick-run protocol
 
 
-def measure(shard_dir: str, runs: int = 1) -> dict:
+def measure(shard_dir: str, runs: int = 1, quick: bool = False,
+            rounds: int = 0) -> dict:
+    """rounds > 0 overrides the protocol's round count — e.g. the 20-round
+    quick-run drift scenario of BENCH_SUITE (bench_suite.py scenario 2)."""
     import numpy as np
 
     n_clients = len(glob.glob(os.path.join(shard_dir, "Client-*")))
     assert n_clients, f"no Client-* dirs under {shard_dir}"
-    run_dir, log = run_reference(shard_dir, _OVERRIDES, n_clients,
+    overrides = list(_QUICK if quick else _PAPER)
+    if rounds:
+        overrides = [o for o in overrides if "num_rounds" not in o[1]]
+        overrides.append((r'^num_rounds = .*$', f'num_rounds = {rounds}'))
+    run_dir, log = run_reference(shard_dir, overrides, n_clients,
                                  extra_fmt={"runs": runs})
     try:
         per_run = []
         for rfile in sorted(glob.glob(os.path.join(
                 run_dir, "Checkpoint", "Results", "Update", "*", "*",
                 "Run_*", "AUC", "*_results.json"))):
-            rounds = [json.loads(l) for l in open(rfile) if l.strip()]
-            means = [float(np.nanmean(r["client_metrics"])) for r in rounds]
+            rows = [json.loads(l) for l in open(rfile) if l.strip()]
+            means = [float(np.nanmean(r["client_metrics"])) for r in rows]
             per_run.append({"rounds_run": len(means),
                             "best_round_mean": round(max(means), 5),
-                            "final_mean": round(means[-1], 5)})
+                            "final_mean": round(means[-1], 5),
+                            "round_means": [round(m, 5) for m in means]})
         assert len(per_run) == runs, (per_run, log[-3000:])
         return {
             "shard_dir": os.path.abspath(shard_dir),
             "n_clients": n_clients,
+            "rounds_override": rounds or None,
             "runs": per_run,
             "best_round_mean_avg": round(
                 float(np.mean([r["best_round_mean"] for r in per_run])), 5),
             "final_mean_avg": round(
                 float(np.mean([r["final_mean"] for r in per_run])), 5),
-            "protocol": "torch reference, hybrid+mse_avg, 100 epochs, "
-                        "20 rounds, lr 1e-5, lambda 10, no global early stop",
+            "protocol": ("torch reference, hybrid+mse_avg, "
+                         + (f"5 epochs, {rounds or 3} rounds, lr 1e-3, "
+                            f"lambda 5" if quick else
+                            f"100 epochs, {rounds or 20} rounds, lr 1e-5, "
+                            f"lambda 10")
+                         + ", no global early stop"),
         }
     finally:
         cleanup(run_dir)
 
 
 if __name__ == "__main__":
-    runs = int(sys.argv[2]) if len(sys.argv) > 2 else 1
-    print(json.dumps(measure(sys.argv[1], runs)), flush=True)
+    rounds = 0
+    if "--rounds" in sys.argv:
+        i = sys.argv.index("--rounds")
+        rounds = int(sys.argv[i + 1])
+        del sys.argv[i:i + 2]
+    args = [a for a in sys.argv[1:] if a != "--quick"]
+    runs = int(args[1]) if len(args) > 1 else 1
+    print(json.dumps(measure(args[0], runs, quick="--quick" in sys.argv,
+                             rounds=rounds)), flush=True)
