@@ -96,16 +96,24 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
                     device_names: Optional[List[str]] = None,
                     mesh=None,
                     resume: Optional[CheckpointManager] = None,
-                    save_checkpoints: bool = False) -> Dict:
+                    save_checkpoints: bool = False,
+                    attack=None) -> Dict:
     """One (model_type, update_type, run): the reference round loop
-    (src/main.py:267-365) + final evaluation (src/main.py:368-374)."""
+    (src/main.py:267-365) + final evaluation (src/main.py:368-374).
+    `attack` (an AttackSpec) simulates a malicious aggregator tampering
+    with the broadcast (federation/attack.py) — the adversary the
+    verification subsystem defends against."""
     rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed,
                           run_seed_stride=cfg.run_seed_stride)
     model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
                        cfg.latent_dim, cfg.shrink_lambda)
+    poison_fn = None
+    if attack is not None:
+        from fedmse_tpu.federation.attack import make_poison_fn
+        poison_fn = make_poison_fn(attack)
     engine = RoundEngine(model, cfg, data, n_real=n_real, rngs=rngs,
                          model_type=model_type, update_type=update_type,
-                         fused=cfg.fused_rounds)
+                         fused=cfg.fused_rounds, poison_fn=poison_fn)
     if mesh is not None:
         engine.data, engine.states = shard_federation(data, engine.states, mesh)
         engine._ver_x, engine._ver_m = engine._verification_tensors()
@@ -248,7 +256,8 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
 def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                    use_mesh: bool = False,
                    save_checkpoints: bool = True,
-                   resume_dir: Optional[str] = None) -> Dict:
+                   resume_dir: Optional[str] = None,
+                   attack=None) -> Dict:
     """The full sweep (src/main.py:108-399) -> training summary dict."""
     mesh = None
     pad_multiple = None
@@ -280,7 +289,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                     cfg, data, n_real, model_type, update_type, run,
                     writer=writer, early_stop=early_stop,
                     device_names=device_names, mesh=mesh, resume=resume,
-                    save_checkpoints=save_checkpoints)
+                    save_checkpoints=save_checkpoints, attack=attack)
                 best_metrics[model_type][update_type] = max(
                     best_metrics[model_type][update_type], out["best_final"])
                 all_results[f"{model_type}/{update_type}/run{run}"] = {
@@ -290,8 +299,11 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
 
     summary_path = writer.write_summary(best_metrics, cfg.num_runs)
     logger.info("Saved training summary to %s", summary_path)
-    return {"best_metrics": best_metrics, "results": all_results,
-            "summary_path": summary_path}
+    out = {"best_metrics": best_metrics, "results": all_results,
+           "summary_path": summary_path}
+    if attack is not None:  # record the adversary in the run's own summary
+        out["attack"] = dataclasses.asdict(attack)
+    return out
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -308,6 +320,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip per-client model/tracking artifacts")
     p.add_argument("--paper-scale", action="store_true",
                    help="epochs=100 rounds=20 lr=1e-5 lambda=10 (README.md:30-34)")
+    p.add_argument("--attack-kind", default=None,
+                   choices=("scale", "noise", "sign_flip", "zero"),
+                   help="simulate a malicious aggregator tampering with the "
+                        "broadcast (federation/attack.py); exercises the "
+                        "verification defense end-to-end")
+    p.add_argument("--attack-strength", type=float, default=10.0)
+    p.add_argument("--attack-every-k", type=int, default=1,
+                   help="attack every k-th round from --attack-start")
+    p.add_argument("--attack-start", type=int, default=1,
+                   help="first attacked round (default 1: round 0 builds "
+                        "the verification history)")
     add_cli_overrides(p)
     return p
 
@@ -325,9 +348,22 @@ def main(argv: Optional[List[str]] = None) -> Dict:
         from fedmse_tpu.config import paper_scale
         cfg = paper_scale(cfg)
     dataset = DatasetConfig.from_json(args.dataset_config, args.data_root)
+    attack = None
+    if args.attack_kind:
+        from fedmse_tpu.federation.attack import AttackSpec
+        attack = AttackSpec(kind=args.attack_kind,
+                            strength=args.attack_strength,
+                            every_k=args.attack_every_k,
+                            start_round=args.attack_start)
+        # attacked artifacts must never commingle with (or be resumed as)
+        # clean ones: tag the experiment so ResultsWriter/checkpoints land
+        # in their own tree
+        cfg = cfg.replace(experiment_name=(
+            f"{cfg.experiment_name}_attack-{attack.kind}"
+            f"-{attack.strength:g}"))
     return run_experiment(cfg, dataset, use_mesh=args.use_mesh,
                           save_checkpoints=not args.no_save,
-                          resume_dir=args.resume_dir)
+                          resume_dir=args.resume_dir, attack=attack)
 
 
 if __name__ == "__main__":
