@@ -360,7 +360,7 @@ def main(argv: Optional[List[str]] = None) -> Dict:
         # in their own tree
         cfg = cfg.replace(experiment_name=(
             f"{cfg.experiment_name}_attack-{attack.kind}"
-            f"-{attack.strength:g}"))
+            f"-{attack.strength:g}-k{attack.every_k}s{attack.start_round}"))
     return run_experiment(cfg, dataset, use_mesh=args.use_mesh,
                           save_checkpoints=not args.no_save,
                           resume_dir=args.resume_dir, attack=attack)

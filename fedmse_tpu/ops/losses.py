@@ -46,8 +46,17 @@ def mse_loss(x: jax.Array, recon: jax.Array,
 def shrink_loss(x: jax.Array, recon: jax.Array, latent: jax.Array,
                 shrink_lambda: float, mask: Optional[jax.Array] = None
                 ) -> jax.Array:
-    """MSE + λ·mean_rows ‖latent‖₂ (reference Shrink_Autoencoder.py:138-156)."""
-    norms = jnp.linalg.norm(latent, axis=-1)
+    """MSE + λ·mean_rows ‖latent‖₂ (reference Shrink_Autoencoder.py:138-156).
+
+    Safe norm: ‖·‖₂'s gradient at an exactly-zero vector is NaN, and a
+    zero-PADDED row has an exactly-zero latent at init (all biases start 0,
+    so a zero input maps to latent 0). The mask zeroes the padded row's
+    contribution to the VALUE, but 0·NaN = NaN would still poison the
+    whole gradient. Guarding the sqrt argument leaves every nonzero-latent
+    row bit-identical and gives padded rows a finite (then masked-out)
+    gradient."""
+    sq = jnp.sum(jnp.square(latent), axis=-1)
+    norms = jnp.sqrt(jnp.where(sq > 0, sq, 1.0)) * (sq > 0)
     return mse_loss(x, recon, mask) + shrink_lambda * masked_mean(norms, mask)
 
 

@@ -44,6 +44,36 @@ def test_shrink_loss_matches_reference_formula(rng):
     assert got == pytest.approx(want, rel=1e-6)
 
 
+def test_shrink_loss_grad_finite_with_zero_padded_rows():
+    """A zero-PADDED row has an exactly-zero latent at init (zero biases),
+    where the naive ‖·‖₂ gradient is NaN — and 0·NaN poisons the whole
+    batch gradient. The safe-norm guard must keep gradients finite while
+    leaving real-row values untouched (bit-identical to linalg.norm)."""
+    from fedmse_tpu.models import make_model
+
+    model = make_model("hybrid", 5, shrink_lambda=5.0)
+    p = model.init(jax.random.key(0), jnp.zeros((1, 5)))["params"]
+    x = jnp.array([[1., 2, 3, 4, 5], [0, 0, 0, 0, 0]])  # real + zero pad
+    m = jnp.array([1., 0.])
+
+    def loss(p):
+        lat, rec = model.apply({"params": p}, x)
+        return shrink_loss(x, rec, lat, 5.0, m)
+
+    g = jax.grad(loss)(p)
+    assert all(bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(g))
+    # real (nonzero-latent) rows: the PRODUCTION loss must equal the naive
+    # linalg.norm formula bit-for-bit — exercise shrink_loss itself so a
+    # future epsilon-style drift in losses.py fails here
+    rng2 = np.random.default_rng(0)
+    x2 = jnp.asarray(rng2.normal(size=(7, 5)), dtype=jnp.float32)
+    r2 = jnp.asarray(rng2.normal(size=(7, 5)), dtype=jnp.float32)
+    z2 = jnp.asarray(rng2.normal(size=(7, 3)), dtype=jnp.float32)
+    want = (mse_loss(x2, r2)
+            + 5.0 * jnp.mean(jnp.linalg.norm(z2, axis=-1)))
+    assert float(shrink_loss(x2, r2, z2, 5.0)) == float(want)
+
+
 def test_prox_term(rng):
     p = {"a": jnp.asarray(rng.normal(size=(3, 2)).astype(np.float32)),
          "b": jnp.asarray(rng.normal(size=(4,)).astype(np.float32))}
