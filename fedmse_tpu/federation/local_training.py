@@ -18,10 +18,14 @@ src/Trainer/client_trainer.py:360-419):
 
 TPU-first design: the reference trains selected clients sequentially
 (src/main.py:276-279). Here `make_local_train_all` vmaps one client's
-epoch/batch `lax.scan` over the stacked client axis, so all clients train
-simultaneously; per-client early stopping becomes a masked `done` flag
-(no Python breaks — SURVEY.md §7 hard part #4), and clients with fewer
-batches skip trailing padded batches via row masks. Selection is applied by
+training over the stacked client axis, so all clients train
+simultaneously; the batch loop is a `lax.scan` and the epoch loop a
+`lax.while_loop` whose condition is the per-client early stop (no Python
+breaks — SURVEY.md §7 hard part #4; under vmap, XLA's while batching
+freezes stopped lanes and iterates only until the LAST client stops, so
+early-stopped clients stop paying for epochs just like the reference's
+`break`), and clients with fewer batches skip trailing padded batches via
+row masks. Selection is applied by
 the caller (round engine) with a per-client select mask — unselected clients'
 state passes through unchanged, keeping shapes static (§7: 'selection masking
 instead of Python subsetting').
@@ -89,29 +93,41 @@ def make_local_train_one(model, tx: optax.GradientTransformation,
             _, losses = jax.lax.scan(vstep, None, (valid_xb, valid_mb))
             return jnp.sum(losses) / nvb
 
-        def epoch_body(carry, _):
-            p, o, min_v, worse, done, best_p = carry
-            (p_new, o_new), losses = jax.lax.scan(batch_step, (p, o),
-                                                  (train_xb, train_mb))
-            # a finished (early-stopped) client's epoch is a no-op
-            p = tree_select(done, p, p_new)
-            o = tree_select(done, o, o_new)
+        # Epochs run under lax.while_loop, NOT a fixed-length scan: an
+        # early-stopped client must stop PAYING for epochs, not just stop
+        # updating. Under the client vmap, XLA's while batching freezes
+        # finished lanes and iterates only until the LAST client stops —
+        # at paper scale (100 epochs, patience 1) clients typically stop
+        # within the first ~10, so the round's training compute drops by
+        # the same factor (the reference's python `break` does exactly
+        # this, client_trainer.py:414-417; the round-2/3 fixed-length scan
+        # silently trained 100 masked epochs regardless). Executed-epoch
+        # math is identical to the scan version; unexecuted tracking rows
+        # are zeros with active=0.
+        def epoch_cond(carry):
+            _, _, _, worse, epoch, _, _ = carry
+            # first epoch always runs (scan-version parity for patience=0)
+            return (epoch < epochs) & ((worse < patience) | (epoch == 0))
+
+        def epoch_body(carry):
+            p, o, min_v, worse, epoch, tracking, best_p = carry
+            (p, o), losses = jax.lax.scan(batch_step, (p, o),
+                                          (train_xb, train_mb))
             train_loss = jnp.sum(losses) / nb
             v_loss = valid_loss_of(p)
-
-            active = ~done
             improved = v_loss < min_v
-            min_v = jnp.where(active & improved, v_loss, min_v)
-            best_p = tree_select(active & improved, p, best_p)
-            worse = jnp.where(active, jnp.where(improved, 0, worse + 1), worse)
-            done = done | (active & (worse >= patience))
-            track = jnp.stack([train_loss, v_loss, active.astype(jnp.float32)])
-            return (p, o, min_v, worse, done, best_p), track
+            min_v = jnp.where(improved, v_loss, min_v)
+            best_p = tree_select(improved, p, best_p)
+            worse = jnp.where(improved, 0, worse + 1)
+            tracking = tracking.at[epoch].set(
+                jnp.stack([train_loss, v_loss, jnp.float32(1)]))
+            return (p, o, min_v, worse, epoch + 1, tracking, best_p)
 
         init = (params, opt_state, jnp.asarray(jnp.inf, jnp.float32),
-                jnp.asarray(0, jnp.int32), jnp.asarray(False), params)
-        (p, o, min_v, _, _, best_p), tracking = jax.lax.scan(
-            epoch_body, init, None, length=epochs)
+                jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+                jnp.zeros((epochs, 3), jnp.float32), params)
+        p, o, min_v, _, _, tracking, best_p = jax.lax.while_loop(
+            epoch_cond, epoch_body, init)
         return LocalTrainResult(p, o, best_p, min_v, tracking)
 
     return train_one
