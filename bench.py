@@ -70,10 +70,18 @@ def _ensure_live_backend(timeout_s: int = 150, attempts: int = 3,
 # wall-clock [4.0, 3.0, 3.0] s (training of 5 selected clients + voting +
 # aggregation + verification + evaluation of all 10).
 BASELINE_SEC_PER_ROUND = 3.33
-# Paper-scale torch baseline on the same CPU (100 epochs/round, 20 rounds,
-# lr 1e-5, lambda 10 — reference README.md:30-34), measured round 2:
-# ~66 s/round (PARITY.md §4).
-PAPER_BASELINE_SEC_PER_ROUND = 66.0
+# Paper-scale torch baselines on the same CPU (100 epochs/round, 20
+# rounds, lr 1e-5, lambda 10 — reference README.md:30-34). TWO variants,
+# both reported (PARITY.md §4):
+#   * committed behavior (local early stop, patience=1 — what the
+#     reference actually runs): 247 s wall / 20 rounds, measured round 4
+#     via the fixed harness -> 12.35 s/round upper bound. This is the
+#     apples-to-apples number now that the engine's epoch while_loop also
+#     stops early.
+#   * full-100-epoch variant (early stop disabled; matches the fixed
+#     compute the round-2/3 engine paid): ~66 s/round, measured round 2.
+PAPER_BASELINE_SEC_PER_ROUND = 12.35
+PAPER_BASELINE_SEC_PER_ROUND_FULL_EPOCHS = 66.0
 # Final-round mean per-client AUC of the reference over the SAME 3-run
 # protocol this bench uses (runs seeded run*10000, 3 full rounds each,
 # measured 2026-07-29 on this machine): [0.99890, 0.97140, 0.99857]
@@ -273,8 +281,8 @@ def main():
     protocol = ("100 local epochs, 20 rounds, lr 1e-5, lambda 10"
                 if paper else "5 local epochs, batch 12")
     if n_clients != 10:
-        # both measured torch baselines (quick-run 3.33, paper-scale 66)
-        # are 10-client numbers; per-N baselines come from torch_baseline.py
+        # the measured torch baselines are 10-client numbers; per-N
+        # baselines come from torch_baseline.py
         baseline_sec = None
     elif paper:
         baseline_sec = PAPER_BASELINE_SEC_PER_ROUND
@@ -301,7 +309,13 @@ def main():
         "auc_baseline_std":
             None if (paper or n_clients != 10) else BASELINE_AUC_STD,
         "baseline_sec_per_round": baseline_sec,
-        "baseline_source": "reference torch run on this machine's CPU",
+        "baseline_sec_per_round_full_epochs": (
+            PAPER_BASELINE_SEC_PER_ROUND_FULL_EPOCHS if paper else None),
+        "baseline_source": ("reference torch run on this machine's CPU"
+                            + (", committed behavior (local early stop "
+                               "active); baseline_sec_per_round_full_"
+                               "epochs is the forced-100-epoch variant"
+                               if paper else "")),
         "n_clients": n_clients,
         "paper_scale": paper,
         # ADVICE r2: make the artifact self-describing — the ratio is

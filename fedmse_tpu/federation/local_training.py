@@ -21,11 +21,13 @@ TPU-first design: the reference trains selected clients sequentially
 training over the stacked client axis, so all clients train
 simultaneously; the batch loop is a `lax.scan` and the epoch loop a
 `lax.while_loop` whose condition is the per-client early stop (no Python
-breaks — SURVEY.md §7 hard part #4; under vmap, XLA's while batching
-freezes stopped lanes and iterates only until the LAST client stops, so
-early-stopped clients stop paying for epochs just like the reference's
-`break`), and clients with fewer batches skip trailing padded batches via
-row masks. Selection is applied by
+breaks — SURVEY.md §7 hard part #4). Under vmap, XLA's while batching
+iterates until the LAST client stops with frozen lanes select-masked, so
+the cohort's epoch count is the MAX stop epoch over clients rather than
+the static epoch budget — not the reference's per-client sum (a straggler
+keeps every lane running), but the whole-cohort win is what the fixed-
+length scan could never give. Clients with fewer batches skip trailing
+padded batches via row masks. Selection is applied by
 the caller (round engine) with a per-client select mask — unselected clients'
 state passes through unchanged, keeping shapes static (§7: 'selection masking
 instead of Python subsetting').
