@@ -34,3 +34,30 @@ if [ -f profile_fused.py ]; then
 fi
 run bench_suite python bench_suite.py --out "$OUT/BENCH_SUITE_tpu.json"
 echo "=== battery done ($(date +%H:%M:%S)); artifacts in $OUT ==="
+
+# Land the on-chip artifacts in the repo even if the battery finishes
+# unattended (the tunnel can recover at any hour; see watch_tpu.sh).
+land() {  # land <src-in-$OUT> <dest-name>: only real TPU captures
+    [ -s "$OUT/$1" ] || return 0
+    if grep -q '"platform": "tpu"' "$OUT/$1"; then
+        cp "$OUT/$1" "$2"
+        git add "$2"
+    fi
+}
+land bench_quick.out  BENCH_TPU_r04.json
+land bench_paper.out  BENCH_PAPER_r04.json
+land bench_c25.out    BENCH_C25_r04_tpu.json
+land bench_c50.out    BENCH_C50_r04_tpu.json
+land bench_c100.out   BENCH_C100_r04_tpu.json
+[ -s TPU_CHECK.json ] && git add TPU_CHECK.json
+[ -s "$OUT/PROFILE_tpu.json" ] && grep -q '"platform": "tpu"' "$OUT/PROFILE_tpu.json" && \
+    cp "$OUT/PROFILE_tpu.json" PROFILE_r04.json && git add PROFILE_r04.json
+[ -s "$OUT/BENCH_SUITE_tpu.json" ] && grep -q '"platform": "tpu"' "$OUT/BENCH_SUITE_tpu.json" && \
+    cp "$OUT/BENCH_SUITE_tpu.json" BENCH_SUITE_r04.json && git add BENCH_SUITE_r04.json
+git diff --cached --quiet || git commit -m "On-chip round-4 capture battery artifacts
+
+Serial battery (capture_tpu.sh) run on tunnel recovery: quick-run bench,
+paper-scale (num_runs=5, pinned statistic), 25/50/100-client scaling,
+fused-chunk profile, scenario suite - all with platform:tpu recorded.
+
+No-Verification-Needed: artifacts only, no product code changed"
