@@ -366,5 +366,14 @@ def main(argv: Optional[List[str]] = None) -> Dict:
                           resume_dir=args.resume_dir, attack=attack)
 
 
+def cli() -> int:
+    """Console-script entry (pyproject.toml). main() returns the results
+    dict for programmatic callers; the setuptools wrapper does
+    `sys.exit(entry())`, and sys.exit with a dict prints it to stderr and
+    exits 1 — so discard it and return a real status code."""
+    main()
+    return 0
+
+
 if __name__ == "__main__":
     main()

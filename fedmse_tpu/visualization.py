@@ -27,11 +27,18 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-import matplotlib
-matplotlib.use("Agg")
-import matplotlib.pyplot as plt  # noqa: E402
-
 from fedmse_tpu.utils.logging import get_logger
+
+
+def _plt():
+    """Lazy matplotlib import: the driver calls save_latent_data (a pure
+    pickle writer) at the end of every hybrid run, and matplotlib is only a
+    `viz` extra (pyproject.toml) — a base install must not crash after an
+    expensive training run just because the plotting backend is absent."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    return plt
 
 logger = get_logger(__name__)
 
@@ -50,6 +57,7 @@ def load_round_results(results_dir: str) -> Dict[str, List[dict]]:
 
 def plot_results(results_dir: str, out_dir: str) -> List[str]:
     """Per-client final metric bars + per-round mean curves per combination."""
+    plt = _plt()
     os.makedirs(out_dir, exist_ok=True)
     combos = load_round_results(results_dir)
     if not combos:
@@ -104,6 +112,7 @@ def plot_latent_tsne(latent_files: Sequence[str], out_path: str,
     (latent_visualization.ipynb parity)."""
     from sklearn.manifold import TSNE
 
+    plt = _plt()
     n = len(latent_files)
     fig = plt.figure(figsize=(5 * n, 4.5))
     rng = np.random.default_rng(seed)
