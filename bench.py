@@ -132,11 +132,22 @@ def _min_over_reps(timed_once):
 def _timed_pass(engine, fused: bool, timed_rounds: int):
     """One warm timed schedule from a fresh federation: returns
     (sec_per_round, results). The single timing protocol shared by the main
-    run loop, the bursty-tunnel extras, and bench_suite._run_rounds."""
+    run loop, the bursty-tunnel extras, and bench_suite._run_rounds.
+
+    The fused schedule dispatches in chunks of cfg.fused_schedule_chunk,
+    exactly like the driver's round loop (main.py:run_combination) — NOT
+    one whole-schedule dispatch. Timing the latter would overstate the
+    shipped path whenever chunk < timed_rounds (and made the --chunk flag
+    inert: a code-review catch this round — the original chunk-8-vs-32
+    'A/B' timed byte-identical programs across tunnel windows)."""
     engine.reset_federation()
     t0 = time.time()
     if fused:
-        results = engine.run_rounds(0, timed_rounds)
+        results, start = [], 0
+        while start < timed_rounds:
+            k = min(engine.cfg.fused_schedule_chunk, timed_rounds - start)
+            results.extend(engine.run_rounds(start, k))
+            start += k
     else:
         results = [engine.run_round(r) for r in range(timed_rounds)]
     return (time.time() - t0) / timed_rounds, results
@@ -205,20 +216,31 @@ def main():
     # --clients N = the N-client IID scaling point (shards regenerated with
     # the prep tool when absent).
     paper = "--paper-scale" in sys.argv
-    n_clients = 10
-    num_runs = None
-    for i, a in enumerate(sys.argv):
-        if a == "--clients" and i + 1 < len(sys.argv):
-            n_clients = int(sys.argv[i + 1])
-        elif a.startswith("--clients="):
-            n_clients = int(a.split("=", 1)[1])
-        elif a == "--num-runs" and i + 1 < len(sys.argv):
-            num_runs = int(sys.argv[i + 1])
-        elif a.startswith("--num-runs="):
-            num_runs = int(a.split("=", 1)[1])
+
+    def _int_flag(name, default):
+        value = default  # last occurrence wins, like argparse
+        for i, a in enumerate(sys.argv):
+            if a == name and i + 1 < len(sys.argv):
+                value = int(sys.argv[i + 1])
+            elif a.startswith(name + "="):
+                value = int(a.split("=", 1)[1])
+        return value
+
+    n_clients = _int_flag("--clients", 10)
+    num_runs = _int_flag("--num-runs", None)
+    chunk = _int_flag("--chunk", None)
+    if chunk is not None and chunk < 1:
+        sys.exit(f"--chunk expects a positive integer, got {chunk}")
 
     cfg = ExperimentConfig(fused_eval=fused_eval,
                            network_size=n_clients)  # quick-run defaults
+    if chunk is not None:
+        cfg = cfg.replace(fused_schedule_chunk=chunk)
+    if "--no-compact" in sys.argv:
+        # A/B the compact-cohort gather/scatter against dense masked
+        # training in the same tunnel window (the tunnel's burstiness makes
+        # cross-day comparisons meaningless — see the timing note below)
+        cfg = cfg.replace(compact_cohort=False)
     if paper:
         from fedmse_tpu.config import paper_scale
         cfg = paper_scale(cfg)
@@ -253,10 +275,12 @@ def main():
     for run in range(num_runs):
         engine.rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed)
         if run == 0:  # warm-up triggers every jit compile before timing
-            engine.reset_federation()
             if fused:
-                engine.run_rounds(0, timed_rounds)
+                # same chunk split as the timed pass, so the chunk program
+                # AND any shorter remainder program both compile here
+                _timed_pass(engine, fused, timed_rounds)
             else:
+                engine.reset_federation()
                 engine.run_round(0)
         sec, results = _timed_pass(engine, fused, timed_rounds)
         run_secs.append(sec)
@@ -328,6 +352,8 @@ def main():
         "platform": device.platform,
         "mode": "fused-scan" if fused else "per-phase",
         "fused_eval": fused_eval,
+        "compact_cohort": cfg.compact_cohort,
+        "fused_schedule_chunk": cfg.fused_schedule_chunk,
     }
     if fused_eval == "off":
         # Measured r3 on v5e (DESIGN.md §3, TPU_CHECK.json): the packed

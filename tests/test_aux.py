@@ -421,3 +421,32 @@ def test_repo_dataset_configs_are_valid():
             assert dev.abnormal_data_path.endswith("/abnormal"), (p, dev)
             assert dev.test_normal_data_path.endswith("/test_normal"), (p, dev)
         assert len({d.id for d in ds.devices_list}) == len(ds.devices_list), p
+
+
+def test_bench_timed_pass_uses_driver_chunk_split():
+    """bench._timed_pass must dispatch the fused schedule in
+    cfg.fused_schedule_chunk-sized chunks exactly like the driver loop
+    (main.py:run_combination) — a whole-schedule dispatch would overstate
+    the shipped path and make `--chunk` inert (the round-4 A/B bug: two
+    'different-chunk' invocations timed byte-identical programs)."""
+    import bench
+
+    calls = []
+
+    class FakeCfg:
+        fused_schedule_chunk = 2
+
+    class FakeEngine:
+        cfg = FakeCfg()
+
+        def reset_federation(self):
+            calls.append("reset")
+
+        def run_rounds(self, start, k):
+            calls.append((start, k))
+            return [f"r{start + i}" for i in range(k)]
+
+    sec, results = bench._timed_pass(FakeEngine(), True, 5)
+    assert calls == ["reset", (0, 2), (2, 2), (4, 1)]
+    assert results == ["r0", "r1", "r2", "r3", "r4"]
+    assert sec >= 0
