@@ -1,0 +1,39 @@
+#!/bin/bash
+# Second-wave single-shot watcher (round 4): the first battery landed the
+# full artifact set but the tunnel wedged before the 200-client point and
+# the chunk-32 paper capture. When the tunnel recovers, serially capture:
+#   1. quick-run bench (chunk-32 engine; a quieter window than the 0.0663
+#      battery capture would also improve the headline row)
+#   2. paper-scale with the shipped chunk-32 default
+#   3. the first 200-client on-chip point
+# Launch detached: setsid nohup bash watch_tpu_r04b.sh & — exits after one
+# battery so it cannot collide with the driver's end-of-round bench.
+set -u
+cd "$(dirname "$0")"
+OUT=${1:-/tmp/tpu_capture_r04b}
+LOG=${OUT}.watch.log
+mkdir -p "$OUT"
+echo "watcher-b start $(date +%F\ %T)" >> "$LOG"
+while true; do
+    if timeout 120 python -c "import jax; d=jax.devices()[0]; \
+assert d.platform=='tpu', d.platform" >> "$LOG" 2>&1; then
+        echo "tunnel healthy $(date +%F\ %T); capturing" >> "$LOG"
+        for step in "bench_quick:python bench.py" \
+                    "bench_paper32:python bench.py --paper-scale" \
+                    "bench_c200:python bench.py --clients 200"; do
+            name=${step%%:*}; cmd=${step#*:}
+            echo "=== $name ($(date +%H:%M:%S))" >> "$LOG"
+            timeout 1500 $cmd >"$OUT/$name.out" 2>"$OUT/$name.err" \
+                || echo "--- $name FAILED rc=$?" >> "$LOG"
+        done
+        break
+    fi
+    echo "probe failed $(date +%F\ %T); sleeping 300s" >> "$LOG"
+    sleep 300
+done
+# land only real TPU captures; commit nothing (the session reviews + lands)
+for f in bench_quick bench_paper32 bench_c200; do
+    [ -s "$OUT/$f.out" ] && grep -q '"platform": "tpu"' "$OUT/$f.out" \
+        && echo "landed-candidate $f" >> "$LOG"
+done
+echo "watcher-b done $(date +%F\ %T)" >> "$LOG"
