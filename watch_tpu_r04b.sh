@@ -12,9 +12,19 @@ set -u
 cd "$(dirname "$0")"
 OUT=${1:-/tmp/tpu_capture_r04b}
 LOG=${OUT}.watch.log
+DEADLINE=$(( $(date +%s) + ${2:-21600} ))  # default 6 h, then give up —
+# the watcher must be long gone before the driver's end-of-round bench
+# touches the device (round 3 lost its TPU capture to exactly that race).
+# The deadline bounds the WHOLE run, so stop probing while a worst-case
+# battery (3 steps x 1500 s timeouts + slack = 4800 s) still fits.
+BATTERY_BUDGET=4800
 mkdir -p "$OUT"
 echo "watcher-b start $(date +%F\ %T)" >> "$LOG"
 while true; do
+    if [ "$(( $(date +%s) + BATTERY_BUDGET ))" -ge "$DEADLINE" ]; then
+        echo "deadline headroom exhausted $(date +%F\ %T); giving up" >> "$LOG"
+        exit 0
+    fi
     if timeout 120 python -c "import jax; d=jax.devices()[0]; \
 assert d.platform=='tpu', d.platform" >> "$LOG" 2>&1; then
         echo "tunnel healthy $(date +%F\ %T); capturing" >> "$LOG"
