@@ -1,0 +1,176 @@
+"""Paired-trajectory trainer-parity probe: OUR engine vs a reference-faithful
+torch replica from IDENTICAL init on IDENTICAL data.
+
+Statistical parity comparisons on pathological data (Kitsune features reach
+2.8e17; per-run AUC swings +/-2-8 points with the partition/init draw) need
+n in the hundreds to resolve a 2-point mean gap. This probe removes the
+stochastics instead: export one client's partition through OUR data
+pipeline, copy OUR fan-in-uniform init into a torch module that mirrors the
+reference's Shrink-AE and trainer line by line
+(/root/reference/src/Model/Shrink_Autoencoder.py:20-60 architecture+init,
+/root/reference/src/Trainer/client_trainer.py:314-365 loop: sequential
+batches, epoch-mean train loss, batch-mean valid loss, patience early stop),
+train both, and compare per-epoch loss curves and the reference-exact
+centroid AUC (src/Model/Centroid.py:6-39: StandardScaler on train latents,
+L2 distance to origin).
+
+Round-4 result (PARITY_PROBE_r04.json): loss curves agree to 2e-5 per epoch
+and AUC to 4 decimals on the hardest Kitsune partition found — the trainers
+are mathematically equivalent, so any framework-vs-framework AUC deltas on
+Kitsune are draw luck, not implementation drift (PARITY.md section 1).
+
+Usage:
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python parity_probe.py [--shards /tmp/kitsune8] [--client 5] \
+            [--data-seed 4] [--epochs 5] [--out PARITY_PROBE.json]
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def _arg(name, default):
+    for i, a in enumerate(sys.argv):
+        if a == name and i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+        if a.startswith(name + "="):
+            return a.split("=", 1)[1]
+    return default
+
+
+def main():
+    import jax
+    import torch
+    import torch.nn as nn
+    from sklearn.metrics import roc_auc_score
+    from sklearn.preprocessing import StandardScaler
+
+    from fedmse_tpu.config import DatasetConfig, ExperimentConfig
+    from fedmse_tpu.data import (build_dev_dataset, prepare_clients,
+                                 stack_clients)
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.utils.platform import enable_compilation_cache
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    enable_compilation_cache()
+    shards = _arg("--shards", "/tmp/kitsune8")
+    client = int(_arg("--client", "5"))
+    data_seed = int(_arg("--data-seed", "4"))
+    epochs = int(_arg("--epochs", "5"))
+
+    # ---- one client's partition through OUR pipeline ----
+    cfg = ExperimentConfig(network_size=1, num_participants=1.0,
+                           epochs=epochs, num_rounds=1, data_seed=data_seed)
+    n_avail = len(__import__("glob").glob(shards + "/Client-*"))
+    ds = DatasetConfig.for_client_dirs(shards, n_avail)
+    ds = type(ds)(data_path=ds.data_path,
+                  devices_list=[ds.devices_list[client]])
+    rngs = ExperimentRngs(run=0, data_seed=data_seed)
+    clients = prepare_clients(ds, cfg, rngs.data_rng)
+    c = clients[0]
+    train, valid, test_x, test_y = c.train_x, c.valid_x, c.test_x, c.test_y
+    data = stack_clients(clients, build_dev_dataset(clients, rngs.data_rng),
+                         cfg.batch_size)
+
+    # ---- OUR engine: capture init, train one round, read tracking ----
+    model = make_model("hybrid", cfg.dim_features,
+                       shrink_lambda=cfg.shrink_lambda)
+    eng = RoundEngine(model, cfg, data, n_real=1, rngs=rngs,
+                      model_type="hybrid", update_type="mse_avg")
+    p0 = jax.tree_util.tree_map(lambda x: np.asarray(x).copy()[0],
+                                eng.states.params)
+    res = eng.run_round(0)
+    tr = np.asarray(res.tracking[0])
+    act = tr[:, 2] > 0
+    ours = {"train_loss": [round(float(x), 5) for x in tr[act, 0]],
+            "valid_loss": [round(float(x), 5) for x in tr[act, 1]],
+            "auc": round(float(res.client_metrics[0]), 4)}
+
+    # ---- reference-faithful torch replica from the SAME init ----
+    lam = cfg.shrink_lambda
+
+    class SAE(nn.Module):
+        def __init__(self):
+            super().__init__()
+            dim, hid, lat = cfg.dim_features, cfg.hidden_neus, cfg.latent_dim
+            self.e1 = nn.Linear(dim, hid); self.e2 = nn.Linear(hid, lat)
+            self.d1 = nn.Linear(lat, hid); self.d2 = nn.Linear(hid, dim)
+
+        def forward(self, x):
+            z = self.e2(torch.relu(self.e1(x)))
+            r = self.d2(torch.relu(self.d1(z)))
+            loss = (nn.MSELoss()(x, r) + lam *
+                    torch.linalg.vector_norm(z, dim=1).sum() / z.shape[0])
+            return z, r, loss
+
+    m = SAE()
+    flax_names = {"e1": "encoder/Dense_0", "e2": "encoder/Dense_1",
+                  "d1": "decoder/Dense_0", "d2": "decoder/Dense_1"}
+
+    def leaf(path):
+        v = p0
+        for p in path.split("/"):
+            v = v[p]
+        return np.asarray(v)
+
+    for tn, fp in flax_names.items():
+        getattr(m, tn).weight.data = torch.tensor(leaf(fp + "/kernel").T.copy())
+        getattr(m, tn).bias.data = torch.tensor(leaf(fp + "/bias").copy())
+
+    tr_t, va_t = torch.tensor(train), torch.tensor(valid)
+    opt = torch.optim.Adam(m.parameters(), lr=cfg.lr_rate)
+    B = cfg.batch_size
+    minv, worse = float("inf"), 0
+    th = {"train_loss": [], "valid_loss": []}
+    for ep in range(epochs):
+        m.train(); el, nb = 0.0, 0
+        for i in range(0, len(tr_t), B):
+            _, _, loss = m(tr_t[i:i + B])
+            loss.backward(); opt.step(); opt.zero_grad()
+            el += loss.item(); nb += 1
+        m.eval()
+        with torch.no_grad():
+            vl = float(np.mean([m(va_t[i:i + B])[2].item()
+                                for i in range(0, len(va_t), B)]))
+        th["train_loss"].append(round(el / nb, 5))
+        th["valid_loss"].append(round(vl, 5))
+        if vl < minv:
+            minv, worse = vl, 0
+        else:
+            worse += 1
+            if worse >= cfg.patience:
+                break
+    with torch.no_grad():
+        zt = m(torch.tensor(train))[0].numpy()
+        zx = m(torch.tensor(test_x))[0].numpy()
+    sc = StandardScaler().fit(zt)
+    th["auc"] = round(roc_auc_score(
+        test_y, np.nan_to_num(np.linalg.norm(sc.transform(zx), axis=1))), 4)
+
+    same_stop = (len(ours["train_loss"]) == len(th["train_loss"])
+                 and len(ours["valid_loss"]) == len(th["valid_loss"]))
+    if same_stop:
+        max_dl = max(max(abs(a - b) for a, b in zip(ours[k], th[k]))
+                     for k in ("train_loss", "valid_loss"))
+    else:
+        max_dl = float("inf")  # different stop epochs IS a divergence
+    out = {
+        "shards": shards, "client": client, "data_seed": data_seed,
+        "epochs_protocol": epochs, "ours": ours, "torch_replica": th,
+        "same_stop_epoch": same_stop,
+        "max_abs_loss_delta": (round(max_dl, 6) if same_stop else None),
+        "auc_delta": round(abs(ours["auc"] - th["auc"]), 4),
+        "verdict": ("equivalent" if same_stop and max_dl < 1e-3 and
+                    abs(ours["auc"] - th["auc"]) < 5e-3 else "DIVERGED"),
+    }
+    outp = _arg("--out", None)
+    if outp:
+        json.dump(out, open(outp, "w"), indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
