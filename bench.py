@@ -231,9 +231,18 @@ def main():
     chunk = _int_flag("--chunk", None)
     if chunk is not None and chunk < 1:
         sys.exit(f"--chunk expects a positive integer, got {chunk}")
+    # partition draw: both frameworks hold the data split fixed across runs
+    # (reference src/main.py:115-117), so multi-run means ride on ONE
+    # partition draw — sweeping --data-seed is how PARITY §1's Kitsune
+    # partition-draw experiments vary it reproducibly
+    data_seed = _int_flag("--data-seed", None)
+    if data_seed is not None and data_seed < 0:
+        sys.exit(f"--data-seed expects a non-negative integer, got {data_seed}")
 
     cfg = ExperimentConfig(fused_eval=fused_eval,
                            network_size=n_clients)  # quick-run defaults
+    if data_seed is not None:
+        cfg = cfg.replace(data_seed=data_seed)
     if chunk is not None:
         cfg = cfg.replace(fused_schedule_chunk=chunk)
     if "--no-compact" in sys.argv:
@@ -354,6 +363,7 @@ def main():
         "fused_eval": fused_eval,
         "compact_cohort": cfg.compact_cohort,
         "fused_schedule_chunk": cfg.fused_schedule_chunk,
+        "data_seed": cfg.data_seed,
     }
     if fused_eval == "off":
         # Measured r3 on v5e (DESIGN.md §3, TPU_CHECK.json): the packed

@@ -21,8 +21,9 @@ Kitsune are draw luck, not implementation drift (PARITY.md section 1).
 
 Usage:
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-        python parity_probe.py [--shards /tmp/kitsune8] [--client 5] \
-            [--data-seed 4] [--epochs 5] [--out PARITY_PROBE.json]
+        python parity_probe.py [--shards Data/kitsune-8clients-anchor] \
+            [--client 5] [--data-seed 4] [--epochs 5] \
+            [--out PARITY_PROBE.json]
 """
 
 import json
@@ -56,7 +57,9 @@ def main():
     from fedmse_tpu.utils.seeding import ExperimentRngs
 
     enable_compilation_cache()
-    shards = _arg("--shards", "/tmp/kitsune8")
+    # default: the persistent 8-complete-client Kitsune anchor tree
+    # (regen: PARITY_DATA.json regen_commands.kitsune_anchor)
+    shards = _arg("--shards", "Data/kitsune-8clients-anchor")
     client = int(_arg("--client", "5"))
     data_seed = int(_arg("--data-seed", "4"))
     epochs = int(_arg("--epochs", "5"))
