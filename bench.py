@@ -185,7 +185,8 @@ def build_data(cfg, n_clients: int = 10, dataset=None):
 
 def main():
     _ensure_live_backend()
-    from fedmse_tpu.utils.platform import enable_compilation_cache
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
     enable_compilation_cache()  # persistent XLA cache across bench runs
     import numpy as np
     import jax
@@ -400,6 +401,7 @@ def main():
     reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
     if reason and reason != "1":
         out["tpu_fallback_reason"] = reason
+    out.update(capture_provenance())
     print(json.dumps(out))
 
 

@@ -121,7 +121,8 @@ def main():
             sys.exit(f"--only expects a scenario number 1-5, got {only}")
 
     _ensure_live_backend()
-    from fedmse_tpu.utils.platform import enable_compilation_cache
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
     enable_compilation_cache()  # persistent XLA cache across suite runs
     import jax
     from fedmse_tpu.config import DatasetConfig, ExperimentConfig
@@ -192,6 +193,7 @@ def main():
     reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
     if reason and reason != "1":
         out["tpu_fallback_reason"] = reason
+    out.update(capture_provenance())
     out_path = None if only is not None else "BENCH_SUITE.json"
     if "--out" in sys.argv:  # explicit --out writes even in --only debug mode
         out_path = sys.argv[sys.argv.index("--out") + 1]

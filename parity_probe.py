@@ -27,6 +27,7 @@ Usage:
 """
 
 import json
+import os
 import sys
 
 import numpy as np
@@ -53,13 +54,17 @@ def main():
                                  stack_clients)
     from fedmse_tpu.federation import RoundEngine
     from fedmse_tpu.models import make_model
-    from fedmse_tpu.utils.platform import enable_compilation_cache
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
     from fedmse_tpu.utils.seeding import ExperimentRngs
 
     enable_compilation_cache()
     # default: the persistent 8-complete-client Kitsune anchor tree
-    # (regen: PARITY_DATA.json regen_commands.kitsune_anchor)
-    shards = _arg("--shards", "Data/kitsune-8clients-anchor")
+    # (regen: PARITY_DATA.json regen_commands.kitsune_anchor), resolved
+    # against the repo root so the probe works from any cwd
+    shards = _arg("--shards", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "Data", "kitsune-8clients-anchor"))
     client = int(_arg("--client", "5"))
     data_seed = int(_arg("--data-seed", "4"))
     epochs = int(_arg("--epochs", "5"))
@@ -68,6 +73,9 @@ def main():
     cfg = ExperimentConfig(network_size=1, num_participants=1.0,
                            epochs=epochs, num_rounds=1, data_seed=data_seed)
     n_avail = len(__import__("glob").glob(shards + "/Client-*"))
+    if n_avail == 0:
+        sys.exit(f"no Client-* shards under {shards!r} — regenerate with "
+                 f"PARITY_DATA.json regen_commands, or pass --shards")
     ds = DatasetConfig.for_client_dirs(shards, n_avail)
     ds = type(ds)(data_path=ds.data_path,
                   devices_list=[ds.devices_list[client]])
@@ -169,6 +177,7 @@ def main():
         "verdict": ("equivalent" if same_stop and max_dl < 1e-3 and
                     abs(ours["auc"] - th["auc"]) < 5e-3 else "DIVERGED"),
     }
+    out.update(capture_provenance())
     outp = _arg("--out", None)
     if outp:
         json.dump(out, open(outp, "w"), indent=1)

@@ -157,7 +157,8 @@ def _trace_busy_seconds(engine, n_rounds: int, trace_dir: str):
 
 def main():
     _ensure_live_backend()
-    from fedmse_tpu.utils.platform import enable_compilation_cache
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
     enable_compilation_cache()
     import jax
 
@@ -230,6 +231,7 @@ def main():
     reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
     if reason and reason != "1":
         out["tpu_fallback_reason"] = reason
+    out.update(capture_provenance())
     with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"wrote": out_path,

@@ -67,7 +67,8 @@ def race(impls: dict, *args) -> dict:
 
 def main() -> None:
     probe()
-    from fedmse_tpu.utils.platform import enable_compilation_cache
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
     enable_compilation_cache()
     import jax
     import jax.numpy as jnp
@@ -181,6 +182,7 @@ def main() -> None:
         dev["unfused_flax"] / dev["pallas"], 3)
     out["chain"] = CHAIN
 
+    out.update(capture_provenance())
     with open(os.path.join(REPO_ROOT, "TPU_CHECK.json"), "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
