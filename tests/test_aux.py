@@ -450,3 +450,34 @@ def test_bench_timed_pass_uses_driver_chunk_split():
     assert calls == ["reset", (0, 2), (2, 2), (4, 1)]
     assert results == ["r0", "r1", "r2", "r3", "r4"]
     assert sec >= 0
+
+
+def test_capture_provenance_identifies_engine(tmp_path):
+    """Benchmark artifacts must self-identify the engine that produced them
+    (VERDICT r3: TPU numbers whose commit was unrecorded turned out to
+    predate the shipped code). The helper reports the short HEAD commit, a
+    CODE-dirty flag immune to the artifact JSONs the tools themselves
+    write, and never raises outside a checkout."""
+    from fedmse_tpu.utils.platform import capture_provenance
+
+    out = capture_provenance()
+    assert set(out) == {"git_commit", "git_dirty", "captured_utc"}
+    # this test runs inside the repo checkout: a real short sha comes back
+    assert out["git_commit"] and all(
+        c in "0123456789abcdef" for c in out["git_commit"])
+    assert isinstance(out["git_dirty"], bool)
+    # ISO-8601 UTC timestamp, e.g. 2026-07-31T11:49:19Z
+    assert len(out["captured_utc"]) == 20 and out["captured_utc"][-1] == "Z"
+
+    # artifact writes must NOT flip the dirty bit: touch an untracked JSON
+    # at the repo root (the category bench_suite/tpu_check produce)
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    probe = os.path.join(repo, "BENCH_PROVENANCE_TEST_SCRATCH.json")
+    before = out["git_dirty"]
+    try:
+        with open(probe, "w") as f:
+            f.write("{}")
+        assert capture_provenance()["git_dirty"] == before
+    finally:
+        os.remove(probe)
