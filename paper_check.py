@@ -20,11 +20,13 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 from bench import _ensure_live_backend, build_data  # noqa: E402
+from fedmse_tpu.utils.platform import capture_provenance  # noqa: E402
 
 
 def measure(shard_dir: str, runs: int = 3, quick: bool = False) -> dict:
     import glob
 
+    import jax
     import numpy as np
 
     from fedmse_tpu.config import (DatasetConfig, ExperimentConfig,
@@ -71,6 +73,9 @@ def measure(shard_dir: str, runs: int = 3, quick: bool = False) -> dict:
                         if quick else
                         "100 epochs, 20 rounds, lr 1e-5, lambda 10")
                      + ", no global early stop"),
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+        **capture_provenance(),
     }
 
 
