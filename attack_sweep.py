@@ -98,7 +98,8 @@ def run_cell(cfg, data, n_real, kind, strength):
 
 def main():
     _ensure_live_backend()
-    from fedmse_tpu.utils.platform import enable_compilation_cache
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
     enable_compilation_cache()
     import jax
 
@@ -127,6 +128,7 @@ def main():
         "device": str(device), "platform": device.platform,
         "baseline": cells[0],
         "cells": cells[1:],
+        **capture_provenance(),
     }
     with open(out_path, "w") as f:
         json.dump(out, f, indent=1)

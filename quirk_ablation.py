@@ -70,7 +70,8 @@ def run_variant(name, cfg, data, n_real, use_early_stop=True):
 
 
 def main():
-    from fedmse_tpu.utils.platform import enable_compilation_cache
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
     enable_compilation_cache()
     from fedmse_tpu.config import ExperimentConfig
 
@@ -112,7 +113,8 @@ def main():
 
     out = {"protocol": protocol,
            "metric": "final-round mean client AUC",
-           "variants": rows}
+           "variants": rows,
+           **capture_provenance()}
     out_path = out_default
     if "--out" in sys.argv:
         idx = sys.argv.index("--out") + 1

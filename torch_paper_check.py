@@ -26,6 +26,19 @@ import sys
 
 from refharness import cleanup, run_reference
 
+
+def capture_provenance() -> dict:
+    """Load fedmse_tpu/utils/platform.py directly (importlib, not the
+    package) so this torch-side harness never imports jax."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fedmse_tpu", "utils", "platform.py")
+    spec = importlib.util.spec_from_file_location("_fedmse_platform", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.capture_provenance()
+
 _COMMON = [
     (r'^model_types = .*$', 'model_types = ["hybrid"]'),
     (r'^update_types = .*$', 'update_types = ["mse_avg"]'),
@@ -92,6 +105,9 @@ def measure(shard_dir: str, runs: int = 1, quick: bool = False,
                             f"100 epochs, {rounds or 20} rounds, lr 1e-5, "
                             f"lambda 10")
                          + ", no global early stop"),
+            # harness provenance: which commit of OUR repo drove the
+            # reference (the torch numbers themselves are engine-free)
+            **capture_provenance(),
         }
     finally:
         cleanup(run_dir)
