@@ -111,8 +111,12 @@ def main():
     for row in rows[1:]:
         row["delta_vs_baseline"] = round(row["final_auc_mean"] - base, 5)
 
+    import jax
+
     out = {"protocol": protocol,
            "metric": "final-round mean client AUC",
+           "device": str(jax.devices()[0]),
+           "platform": jax.devices()[0].platform,
            "variants": rows,
            **capture_provenance()}
     out_path = out_default
