@@ -230,12 +230,14 @@ class ExperimentConfig:
     # fused_schedule_chunk-1 rounds of progress; set fused_schedule_chunk=1
     # (or fused_schedule=False) for per-round checkpoint granularity.
     # Default 32: the schedule is dispatch-bound on the v5e tunnel —
-    # ~59 ms host overhead per dispatch vs ~11 ms marginal compute per
-    # round, so chunk 8 pays 23.2 ms/round where 32 pays 12.1 and 128 only
-    # 11.5 (measured, PROFILE_r04.json chunk_sweep). 32 takes nearly all of
-    # the win while keeping the mid-chunk-stop replay and crash-loss bounds
-    # small; short runs are unaffected (the driver clamps the chunk to the
-    # rounds remaining).
+    # marginal compute is stable at ~11 ms/round while the per-dispatch
+    # host overhead swings with pool congestion (59 ms quiet window,
+    # 291 ms congested — PROFILE_r04.json fit, both windows in DESIGN §2),
+    # so amortizing dispatches wins in every window: the quiet-window
+    # chunk sweep gives 23.2 ms/round at chunk 8, 12.1 at 32, 11.5 at
+    # 128. 32 takes nearly all of the win while keeping the
+    # mid-chunk-stop replay and crash-loss bounds small; short runs are
+    # unaffected (the driver clamps the chunk to the rounds remaining).
     fused_schedule: bool = True
     fused_schedule_chunk: int = 32
 
