@@ -88,6 +88,13 @@ PAPER_BASELINE_SEC_PER_ROUND_FULL_EPOCHS = 66.0
 # -> 0.98962 +/- 0.01289. The round-1 figure of 0.9990 was a single run.
 BASELINE_AUC = 0.98962
 BASELINE_AUC_STD = 0.01289
+# Per-scale torch s/round, measured with torch_baseline.py on this CPU on
+# the SAME regenerated IID shards and quick protocol as --clients N
+# (BENCH_SCALING_r04_cpu.json; 20/30/40 measured there too; 25 is the
+# 20/30 interpolation used in PARITY §4; 200/500 from the
+# BENCH_C{200,500}_r04_cpu captures).
+SCALING_BASELINE_SEC = {20: 2.67, 25: 4.2, 30: 5.81, 40: 7.55, 50: 8.78,
+                        100: 4.51, 200: 5.31, 500: 10.93}
 
 NBAIOT_ROOT = "/root/reference/Data/N-BaIoT/IID-10-Client_Data"
 
@@ -315,9 +322,10 @@ def main():
     protocol = ("100 local epochs, 20 rounds, lr 1e-5, lambda 10"
                 if paper else "5 local epochs, batch 12")
     if n_clients != 10:
-        # the measured torch baselines are 10-client numbers; per-N
-        # baselines come from torch_baseline.py
-        baseline_sec = None
+        # per-N torch baselines measured via torch_baseline.py on this
+        # machine's CPU, same regenerated shards, quick protocol (PARITY
+        # §3 CPU-vs-CPU table; 200/500 rows in BENCH_C{200,500}_r04_cpu)
+        baseline_sec = None if paper else SCALING_BASELINE_SEC.get(n_clients)
     elif paper:
         baseline_sec = PAPER_BASELINE_SEC_PER_ROUND
     else:
