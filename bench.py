@@ -94,7 +94,7 @@ BASELINE_AUC_STD = 0.01289
 # 20/30 interpolation used in PARITY §4; 200/500 from the
 # BENCH_C{200,500}_r04_cpu captures).
 SCALING_BASELINE_SEC = {20: 2.67, 25: 4.2, 30: 5.81, 40: 7.55, 50: 8.78,
-                        100: 4.51, 200: 5.31, 500: 10.93}
+                        100: 4.512, 200: 5.312, 500: 10.925}
 
 NBAIOT_ROOT = "/root/reference/Data/N-BaIoT/IID-10-Client_Data"
 

@@ -481,3 +481,32 @@ def test_capture_provenance_identifies_engine(tmp_path):
         assert capture_provenance()["git_dirty"] == before
     finally:
         os.remove(probe)
+
+
+def test_scaling_baselines_match_committed_artifacts():
+    """bench.SCALING_BASELINE_SEC (the per-scale torch s/round used for
+    --clients N vs_baseline) must agree with the committed measurement
+    artifacts it cites — code constants and artifacts drifting apart would
+    make scaling captures mis-report their speedup."""
+    import json
+
+    import bench
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "BENCH_SCALING_r04_cpu.json")) as f:
+        rows = {r["clients"]: r["torch_cpu_sec_per_round"]
+                for r in json.load(f)["rows"]}
+    for n, sec in rows.items():
+        if n == 10:  # 10-client quick baseline is the dedicated constant
+            assert bench.BASELINE_SEC_PER_ROUND == 3.33
+            continue
+        assert bench.SCALING_BASELINE_SEC[n] == sec, (n, sec)
+    for n, artifact in ((200, "BENCH_C200_r04_cpu.json"),
+                        (500, "BENCH_C500_r04_cpu.json")):
+        with open(os.path.join(repo, artifact)) as f:
+            sec = json.load(f)["torch_cpu_sec_per_round"]
+        assert bench.SCALING_BASELINE_SEC[n] == sec, (n, sec)
+    # 25 is the documented 20/30 interpolation (PARITY §4), not a
+    # measurement — keep it between its neighbors
+    assert (bench.SCALING_BASELINE_SEC[20] < bench.SCALING_BASELINE_SEC[25]
+            < bench.SCALING_BASELINE_SEC[30])
