@@ -353,7 +353,9 @@ def main():
         "baseline_sec_per_round": baseline_sec,
         "baseline_sec_per_round_full_epochs": (
             PAPER_BASELINE_SEC_PER_ROUND_FULL_EPOCHS if paper else None),
-        "baseline_source": ("reference torch run on this machine's CPU"
+        "baseline_source": (("20/30-client interpolation of "
+                             if n_clients == 25 else "")
+                            + "reference torch run on this machine's CPU"
                             + (", committed behavior (local early stop "
                                "active); baseline_sec_per_round_full_"
                                "epochs is the forced-100-epoch variant"

@@ -73,11 +73,13 @@ def capture_provenance() -> dict:
         # artifact JSONs (TPU_CHECK.json, PROFILE_*.json) and drop untracked
         # ones, so an unrestricted `git status` would report dirty forever
         # after the first capture. Restrict to the code that defines the
-        # engine's behavior (tracked files only).
+        # engine's behavior — INCLUDING untracked files matching the
+        # pathspec (a brand-new uncommitted module changes behavior too);
+        # untracked artifact JSONs at the repo root match no pathspec
+        # element and stay invisible.
         out["git_dirty"] = bool(subprocess.run(
-            ["git", "-C", repo, "status", "--porcelain",
-             "--untracked-files=no", "--", "fedmse_tpu", "native", "tests",
-             "configs", "*.py"],
+            ["git", "-C", repo, "status", "--porcelain", "--",
+             "fedmse_tpu", "native", "tests", "configs", "*.py"],
             capture_output=True, text=True, timeout=10,
             check=True).stdout.strip())
     except Exception:

@@ -497,8 +497,16 @@ def test_scaling_baselines_match_committed_artifacts():
         rows = {r["clients"]: r["torch_cpu_sec_per_round"]
                 for r in json.load(f)["rows"]}
     for n, sec in rows.items():
-        if n == 10:  # 10-client quick baseline is the dedicated constant
+        if n == 10:
+            # the headline 10-client baseline is a DIFFERENT measurement
+            # from the scaling table's 3.02 row: bench.py's 3.33 is the
+            # 2026-07-29 capture whose per-round walls [4.0, 3.0, 3.0] are
+            # recorded in its provenance comment, and every committed
+            # vs_baseline in BENCH_*_r0?.json artifacts is computed
+            # against it — so it is pinned to its own provenance, not to
+            # the (later, slightly faster) torch_baseline.py row.
             assert bench.BASELINE_SEC_PER_ROUND == 3.33
+            assert sec == 3.02  # the scaling row's separate measurement
             continue
         assert bench.SCALING_BASELINE_SEC[n] == sec, (n, sec)
     for n, artifact in ((200, "BENCH_C200_r04_cpu.json"),

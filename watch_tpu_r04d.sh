@@ -19,7 +19,7 @@ cd "$(dirname "$0")"
 OUT=${1:-/tmp/tpu_capture_r04d}
 LOG=${OUT}.watch.log
 DEADLINE=$(( $(date +%s) + ${2:-25200} ))  # default 7 h, then give up
-BATTERY_BUDGET=9000  # 6 steps x 1500 s max
+BATTERY_BUDGET=11000  # 7 steps x 1500 s max + slack
 mkdir -p "$OUT"
 echo "watcher-d start $(date +%F\ %T)" >> "$LOG"
 while true; do
