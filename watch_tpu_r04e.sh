@@ -1,0 +1,47 @@
+#!/bin/bash
+# Late-window single-shot watcher (round 4): runs after watch_tpu_r04d's
+# deadline passes with the tunnel still wedged. Captures ONLY the items no
+# committed artifact covers on-chip — the scenario suite and the first
+# 200/500-client points — so the battery fits a short end-of-round window
+# without risking the driver's own bench slot.
+# Usage: setsid nohup bash watch_tpu_r04e.sh [outdir] [deadline_s] &
+set -u
+cd "$(dirname "$0")"
+OUT=${1:-/tmp/tpu_capture_r04e}
+LOG=${OUT}.watch.log
+DEADLINE=$(( $(date +%s) + ${2:-10800} ))  # default 3 h
+BATTERY_BUDGET=5000  # 3 steps x 1500 s + slack
+mkdir -p "$OUT"
+echo "watcher-e start $(date +%F\ %T)" >> "$LOG"
+while true; do
+    if [ "$(( $(date +%s) + BATTERY_BUDGET ))" -ge "$DEADLINE" ]; then
+        echo "deadline headroom exhausted $(date +%F\ %T); giving up" >> "$LOG"
+        exit 0
+    fi
+    while [ -e /tmp/fedmse_cpu_busy ]; do
+        echo "cpu busy $(date +%F\ %T); waiting" >> "$LOG"
+        sleep 60
+    done
+    if timeout 120 python -c "import jax; d=jax.devices()[0]; \
+assert d.platform=='tpu', d.platform" >> "$LOG" 2>&1; then
+        echo "tunnel healthy $(date +%F\ %T); capturing" >> "$LOG"
+        for step in "bench_suite:python bench_suite.py --out $OUT/BENCH_SUITE_tpu.json" \
+                    "bench_c200:python bench.py --clients 200" \
+                    "bench_c500:python bench.py --clients 500"; do
+            name=${step%%:*}; cmd=${step#*:}
+            echo "=== $name ($(date +%H:%M:%S))" >> "$LOG"
+            timeout 1500 $cmd >"$OUT/$name.out" 2>"$OUT/$name.err" \
+                || echo "--- $name FAILED rc=$?" >> "$LOG"
+        done
+        break
+    fi
+    echo "probe failed $(date +%F\ %T); sleeping 240s" >> "$LOG"
+    sleep 240
+done
+for f in bench_suite bench_c200 bench_c500; do
+    src="$OUT/$f.out"
+    [ "$f" = bench_suite ] && src="$OUT/BENCH_SUITE_tpu.json"
+    [ -s "$src" ] && grep -q '"platform": "tpu"' "$src" \
+        && echo "landed-candidate $f" >> "$LOG"
+done
+echo "watcher-e done $(date +%F\ %T)" >> "$LOG"
