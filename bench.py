@@ -195,6 +195,7 @@ def main():
     from fedmse_tpu.utils.platform import (capture_provenance,
                                            enable_compilation_cache)
     enable_compilation_cache()  # persistent XLA cache across bench runs
+    capture_provenance()  # pin git state before any timed work
     import numpy as np
     import jax
 

@@ -124,6 +124,7 @@ def main():
     from fedmse_tpu.utils.platform import (capture_provenance,
                                            enable_compilation_cache)
     enable_compilation_cache()  # persistent XLA cache across suite runs
+    capture_provenance()  # pin git state before any timed work
     import jax
     from fedmse_tpu.config import DatasetConfig, ExperimentConfig
 

@@ -47,6 +47,9 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
         pass  # pre-import call: the env vars above are picked up at import
 
 
+_GIT_SNAPSHOT: Optional[dict] = None
+
+
 def capture_provenance() -> dict:
     """Engine identity for benchmark artifacts: the git commit the numbers
     were captured at, whether the tree was dirty, and the capture time.
@@ -55,10 +58,21 @@ def capture_provenance() -> dict:
     merges this into its JSON so a reader can tell exactly which engine a
     number describes — the round-3 verdict's core complaint was TPU numbers
     whose engine commit was unrecorded and turned out to predate the
-    shipped code. Never raises: outside a git checkout the fields are null.
+    shipped code. The git fields are snapshotted on the FIRST call in the
+    process and reused by later calls, so entry points invoke this once
+    before their timed work begins: a commit or edit made while a long
+    battery runs cannot retroactively stamp the artifact (round-4 advisor
+    finding). `captured_utc` stays fresh per call — it records write time.
+    Never raises: outside a git checkout the fields are null.
     """
     import subprocess
     import time
+
+    global _GIT_SNAPSHOT
+    if _GIT_SNAPSHOT is not None:
+        return {**_GIT_SNAPSHOT,
+                "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime())}
 
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -84,6 +98,11 @@ def capture_provenance() -> dict:
             check=True).stdout.strip())
     except Exception:
         pass
+    if out["git_commit"] is not None:
+        # only pin a SUCCESSFUL query: a transient git failure (subprocess
+        # timeout on a loaded box) must not stamp null provenance onto
+        # every artifact an 11 h battery writes
+        _GIT_SNAPSHOT = {k: out[k] for k in ("git_commit", "git_dirty")}
     return out
 
 

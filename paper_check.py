@@ -83,6 +83,7 @@ if __name__ == "__main__":
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import enable_compilation_cache
     enable_compilation_cache()
+    capture_provenance()  # pin git state before any timed work
     args = [a for a in sys.argv[1:] if a != "--quick"]
     runs = int(args[1]) if len(args) > 1 else 3
     print(json.dumps(measure(args[0], runs, quick="--quick" in sys.argv)),

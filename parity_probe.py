@@ -59,6 +59,8 @@ def main():
     from fedmse_tpu.utils.seeding import ExperimentRngs
 
     enable_compilation_cache()
+
+    capture_provenance()  # pin git state before any timed work
     # default: the persistent 8-complete-client Kitsune anchor tree
     # (regen: PARITY_DATA.json regen_commands.kitsune_anchor), resolved
     # against the repo root so the probe works from any cwd

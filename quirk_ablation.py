@@ -73,6 +73,7 @@ def main():
     from fedmse_tpu.utils.platform import (capture_provenance,
                                            enable_compilation_cache)
     enable_compilation_cache()
+    capture_provenance()  # pin git state before any timed work
     from fedmse_tpu.config import ExperimentConfig
 
     cfg = ExperimentConfig()  # committed quick-run defaults, all quirks ON

@@ -470,17 +470,64 @@ def test_capture_provenance_identifies_engine(tmp_path):
     assert len(out["captured_utc"]) == 20 and out["captured_utc"][-1] == "Z"
 
     # artifact writes must NOT flip the dirty bit: touch an untracked JSON
-    # at the repo root (the category bench_suite/tpu_check produce)
+    # at the repo root (the category bench_suite/tpu_check produce).
+    # Reset the start-of-process snapshot so this exercises a real git
+    # query, not the memoized copy.
     import os
+
+    from fedmse_tpu.utils import platform as plat
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     probe = os.path.join(repo, "BENCH_PROVENANCE_TEST_SCRATCH.json")
     before = out["git_dirty"]
+    saved = plat._GIT_SNAPSHOT
     try:
         with open(probe, "w") as f:
             f.write("{}")
+        plat._GIT_SNAPSHOT = None
         assert capture_provenance()["git_dirty"] == before
     finally:
+        plat._GIT_SNAPSHOT = saved
         os.remove(probe)
+
+
+def test_capture_provenance_pins_git_state_at_first_call():
+    """The git fields are snapshotted at the FIRST call in the process and
+    reused afterwards (round-4 advisor: a commit made while a long battery
+    runs must not retroactively stamp the artifact with an engine state
+    that did not produce the numbers)."""
+    from fedmse_tpu.utils import platform as plat
+    from fedmse_tpu.utils.platform import capture_provenance
+
+    capture_provenance()  # ensure a snapshot exists
+    saved = plat._GIT_SNAPSHOT
+    try:
+        # simulate "the tree changed mid-battery" with a sentinel the repo
+        # can never produce: if memoization works, the sentinel comes back
+        # verbatim; if capture re-queried git, a real sha would
+        plat._GIT_SNAPSHOT = {"git_commit": "deadbeef-sentinel",
+                              "git_dirty": "sentinel"}
+        again = capture_provenance()
+        assert again["git_commit"] == "deadbeef-sentinel"
+        assert again["git_dirty"] == "sentinel"
+        # captured_utc stays per-call (records artifact WRITE time)
+        assert len(again["captured_utc"]) == 20
+    finally:
+        plat._GIT_SNAPSHOT = saved
+
+    # a FAILED first query must not be pinned: transient git trouble at
+    # process start must not null-stamp every artifact of a long battery
+    from unittest import mock
+    plat._GIT_SNAPSHOT = None
+    try:
+        with mock.patch("subprocess.run", side_effect=OSError("git gone")):
+            nulled = capture_provenance()
+        assert nulled["git_commit"] is None
+        assert plat._GIT_SNAPSHOT is None  # not memoized
+        recovered = capture_provenance()   # git back: real sha, now pinned
+        assert recovered["git_commit"]
+        assert plat._GIT_SNAPSHOT is not None
+    finally:
+        plat._GIT_SNAPSHOT = saved
 
 
 def test_scaling_baselines_match_committed_artifacts():

@@ -27,17 +27,27 @@ import sys
 from refharness import cleanup, run_reference
 
 
+_PLATFORM_MOD = None
+
+
 def capture_provenance() -> dict:
     """Load fedmse_tpu/utils/platform.py directly (importlib, not the
-    package) so this torch-side harness never imports jax."""
-    import importlib.util
+    package) so this torch-side harness never imports jax. The loaded
+    module is cached: platform.py pins the git state at the FIRST call in
+    the process, and a fresh exec_module per call would silently discard
+    that pin (round-5 review finding)."""
+    global _PLATFORM_MOD
+    if _PLATFORM_MOD is None:
+        import importlib.util
 
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "fedmse_tpu", "utils", "platform.py")
-    spec = importlib.util.spec_from_file_location("_fedmse_platform", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod.capture_provenance()
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "fedmse_tpu", "utils", "platform.py")
+        spec = importlib.util.spec_from_file_location(
+            "_fedmse_platform", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _PLATFORM_MOD = mod
+    return _PLATFORM_MOD.capture_provenance()
 
 _COMMON = [
     (r'^model_types = .*$', 'model_types = ["hybrid"]'),
@@ -114,6 +124,7 @@ def measure(shard_dir: str, runs: int = 1, quick: bool = False,
 
 
 if __name__ == "__main__":
+    capture_provenance()  # pin git state before any timed work
     rounds = 0
     if "--rounds" in sys.argv:
         i = sys.argv.index("--rounds")

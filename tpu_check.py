@@ -70,6 +70,7 @@ def main() -> None:
     from fedmse_tpu.utils.platform import (capture_provenance,
                                            enable_compilation_cache)
     enable_compilation_cache()
+    capture_provenance()  # pin git state before any timed work
     import jax
     import jax.numpy as jnp
     import numpy as np
