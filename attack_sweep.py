@@ -18,6 +18,14 @@ history), rounds 1-7 attacked every round by a malicious elected aggregator
 (federation/attack.py tampers between aggregation and broadcast). One
 federation per cell, plus a no-attack baseline.
 
+The sweep runs twice: once with the reference-faithful accept rule
+(mode "reference" — measuring WHERE the reference's operating point sits,
+holes included) and once with `hardened_verification=True` (mode
+"hardened" — the fixed accept rule; the zero row must flip to
+rejected+flagged while the clean baseline's accept rate is unchanged).
+Each mode also gets a paper-scale (20 rounds / 100 epochs) baseline+zero
+pair, where quotas and history have time to matter.
+
 Writes ATTACK.json (override with --out) and prints one line per cell.
 Run on CPU: `env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 python attack_sweep.py`.
@@ -45,7 +53,7 @@ GRID = {
 }
 
 
-def run_cell(cfg, data, n_real, kind, strength):
+def run_cell(cfg, data, n_real, kind, strength, rounds=ROUNDS, start=START):
     import numpy as np
 
     from fedmse_tpu.federation import RoundEngine
@@ -55,19 +63,19 @@ def run_cell(cfg, data, n_real, kind, strength):
 
     poison = (None if kind is None else make_poison_fn(
         AttackSpec(kind=kind, strength=strength, every_k=1,
-                   start_round=START)))
+                   start_round=start)))
     model = make_model("hybrid", cfg.dim_features,
                        shrink_lambda=cfg.shrink_lambda)
     engine = RoundEngine(model, cfg, data, n_real=n_real,
                          rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
                          model_type="hybrid", update_type="mse_avg",
                          fused=True, poison_fn=poison)
-    results = engine.run_rounds(0, ROUNDS)
+    results = engine.run_rounds(0, rounds)
 
     accept_events = reject_events = 0
     max_rejected = 0
     mean_rejected_curve = []
-    for res in results[START:]:
+    for res in results[start:]:
         rows = res.verification_results
         if not rows:  # no aggregator elected: nothing broadcast this round
             mean_rejected_curve.append(None)
@@ -86,7 +94,7 @@ def run_cell(cfg, data, n_real, kind, strength):
                  for r in results]
     return {
         "kind": kind or "none", "strength": strength,
-        "attacked_rounds": ROUNDS - START if kind else 0,
+        "attacked_rounds": rounds - start if kind else 0,
         "accept_rate": round(accept_events / total, 4) if total else None,
         "mean_rejected_curve": mean_rejected_curve,
         "max_rejected_counter": max_rejected,
@@ -110,30 +118,54 @@ def main():
     if "--out" in sys.argv:
         out_path = sys.argv[sys.argv.index("--out") + 1]
 
-    cfg = ExperimentConfig()
-    data, n_real, _ = build_data(cfg, 10)
+    from fedmse_tpu.config import paper_scale
 
-    cells = [run_cell(cfg, data, n_real, None, 0.0)]  # no-attack baseline
-    print(json.dumps(cells[0]), flush=True)
-    for kind, strengths in GRID.items():
-        for s in strengths:
-            cells.append(run_cell(cfg, data, n_real, kind, s))
-            print(json.dumps(cells[-1]), flush=True)
+    base_cfg = ExperimentConfig()
+    data, n_real, _ = build_data(base_cfg, 10)
+
+    modes = {}
+    for mode, hardened in (("reference", False), ("hardened", True)):
+        cfg = base_cfg.replace(hardened_verification=hardened)
+        cells = [run_cell(cfg, data, n_real, None, 0.0)]  # no-attack baseline
+        print(json.dumps({"mode": mode, **cells[0]}), flush=True)
+        for kind, strengths in GRID.items():
+            for s in strengths:
+                cells.append(run_cell(cfg, data, n_real, kind, s))
+                print(json.dumps({"mode": mode, **cells[-1]}), flush=True)
+        # paper-scale zero row (VERDICT r4 weak #3): 20 rounds / 100 epochs
+        # give quotas and verification history time to matter — the regime
+        # where the history-poisoning dynamic compounds
+        pcfg = paper_scale(cfg)
+        paper_rows = [run_cell(pcfg, data, n_real, None, 0.0,
+                               rounds=pcfg.num_rounds),
+                      run_cell(pcfg, data, n_real, "zero", 1.0,
+                               rounds=pcfg.num_rounds)]
+        for row in paper_rows:
+            print(json.dumps({"mode": mode, "paper_scale": True, **row}),
+                  flush=True)
+        modes[mode] = {"baseline": cells[0], "cells": cells[1:],
+                       "paper_scale_baseline": paper_rows[0],
+                       "paper_scale_zero": paper_rows[1]}
 
     device = jax.devices()[0]
     out = {
         "protocol": f"quick-run 10-client N-BaIoT IID, hybrid+mse_avg, "
                     f"{ROUNDS} fused rounds, rounds {START}-{ROUNDS - 1} "
-                    f"attacked every round; thresholds: Frobenius-sum 3.0, "
-                    f"perf-drop 0.002 (reference model_verifier.py:72-75)",
+                    f"attacked every round; paper-scale rows: 20 rounds/"
+                    f"100 epochs, zero attack from round 1; thresholds: "
+                    f"Frobenius-sum 3.0, perf-drop 0.002 (reference "
+                    f"model_verifier.py:72-75). Modes: 'reference' = "
+                    f"reference-faithful accept rule (default), "
+                    f"'hardened' = --hardened-verification true "
+                    f"(federation/verification.py)",
         "device": str(device), "platform": device.platform,
-        "baseline": cells[0],
-        "cells": cells[1:],
+        **modes,
         **capture_provenance(),
     }
     with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
-    print(json.dumps({"wrote": out_path, "n_cells": len(cells) - 1}))
+    print(json.dumps({"wrote": out_path,
+                      "n_cells_per_mode": len(modes["reference"]["cells"])}))
 
 
 if __name__ == "__main__":

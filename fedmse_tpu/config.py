@@ -178,6 +178,16 @@ class ExperimentConfig:
     performance_threshold: float = 0.002
     max_aggregation_threshold: int = 3  # client_trainer.py:78
     max_rejected_updates: int = 3  # client_trainer.py:94
+    # Hardened accept rule (no reference equivalent; default off keeps the
+    # reference's verifier semantics, measured holes and all). The
+    # reference verifier's history-on-every-attempt + unconditional
+    # first-contact accept let a zeroed broadcast poison the baseline and
+    # pass forever (accept 0.857, AUC collapses to 0.5, never flagged —
+    # ATTACK_r04.json). True => deltas and the performance bar are
+    # measured against each client's OWN current model instead of stored
+    # history, and first contact gets no free pass
+    # (federation/verification.py make_verify_fn docstring).
+    hardened_verification: bool = False
 
     # Runs / seeds (src/main.py:43, 51, 73-78, 115-117)
     num_runs: int = 1

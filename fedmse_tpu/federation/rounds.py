@@ -85,6 +85,7 @@ def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
            cfg.fedprox_mu, cfg.compat.no_best_restore,
            cfg.compat.restandardize_vote_data, cfg.compat.vote_tie_break,
            cfg.verification_threshold, cfg.performance_threshold,
+           cfg.hardened_verification,
            model_type, cfg.metric, cfg.fused_eval)
     hit = _PROGRAM_CACHE.get(key)
     if hit is not None:
@@ -101,7 +102,8 @@ def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
             tie_break=cfg.compat.vote_tie_break),
         "aggregate": make_aggregate_fn(model, update_type),
         "verify": make_verify_fn(model, cfg.verification_threshold,
-                                 cfg.performance_threshold),
+                                 cfg.performance_threshold,
+                                 hardened=cfg.hardened_verification),
         "evaluate_all": make_evaluate_all(model, model_type, cfg.metric,
                                           fused=cfg.fused_eval),
     }

@@ -49,9 +49,42 @@ class VerifyOutcome(NamedTuple):
 
 
 def make_verify_fn(model, verification_threshold: float = 3.0,
-                   performance_threshold: float = 0.002) -> Callable:
+                   performance_threshold: float = 0.002,
+                   hardened: bool = False) -> Callable:
     """Build fn(states, agg_params, ver_x [N,V,D], ver_m [N,V],
-    agg_onehot [N], client_mask [N]) -> VerifyOutcome."""
+    agg_onehot [N], client_mask [N]) -> VerifyOutcome.
+
+    ``hardened=False`` (default) reproduces the reference's accept rule
+    exactly — including its measured failure mode: because history updates
+    on EVERY attempt (model_verifier.py:59-66) and the first contact is
+    accepted unconditionally (:41-47), a zeroed/poisoned broadcast that
+    gets in once pins the history to itself, making every subsequent
+    attack round Δ=0 / perf-change=0 and silently accepted (measured:
+    accept 0.857, AUC→0.5, never flagged — ATTACK_r04.json "zero" row).
+
+    ``hardened=True`` closes both holes while keeping the same thresholds
+    and counter semantics. Both baselines come from the client's OWN
+    current model (post-local-training), computed fresh each round —
+    nothing an attacker broadcasts can move them until it is accepted:
+      * performance gate (always on, including first contact): the
+        broadcast must score at least own_perf - performance_threshold on
+        the client's verification tensor. A zeroed/garbage model scores
+        far below any locally trained model, so there is no unconditional
+        first-contact accept to exploit;
+      * delta gate: Σ‖own - agg‖_F <= verification_threshold, WAIVED when
+        (a) this is the client's first contact — before the first sync,
+        honest clients sit at independently trained params whose mutual
+        distance exceeds any sane step-size cap (the cold-start problem
+        the reference solved with its unconditional accept), or (b) the
+        broadcast strictly improves on the own model by more than
+        performance_threshold — the recovery path: a client whose state
+        was trashed while it served as aggregator (the aggregator loads
+        unconditionally, client_trainer.py:333) can rejoin on the next
+        honest broadcast instead of being delta-capped into permanent
+        exclusion.
+    History/rejected bookkeeping is unchanged, so flag semantics
+    (rejected >= 3 => possible attack) carry over.
+    """
 
     def perf_of(params, ver_x, ver_m):
         """1/(1+MSE) on this client's verification tensor
@@ -75,15 +108,28 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
             lambda t: jnp.broadcast_to(t, (n,) + t.shape), agg_params)
 
         new_perf = jax.vmap(perf_of, in_axes=(None, 0, 0))(agg_params, ver_x, ver_m)
-        delta = jax.vmap(frob_delta)(states.hist_params, agg_stacked)
 
         is_agg = agg_onehot > 0
         attempted = (client_mask > 0) & ~is_agg  # broadcast receivers
-        first = ~states.hist_seen
-        perf_change = jnp.where(first, 0.0, new_perf - states.hist_perf)
-        checks = (delta <= verification_threshold) & \
-                 (perf_change >= -performance_threshold)
-        accepted = attempted & (first | checks)
+        if hardened:
+            # both baselines come from the client's OWN current model:
+            # nothing an attacker broadcasts can move them until accepted
+            delta = jax.vmap(frob_delta)(states.params, agg_stacked)
+            own_perf = jax.vmap(perf_of)(states.params, ver_x, ver_m)
+            perf_change = new_perf - own_perf
+            perf_ok = perf_change >= -performance_threshold
+            improves = perf_change >= performance_threshold
+            first = ~states.hist_seen
+            checks = perf_ok & (first | improves |
+                                (delta <= verification_threshold))
+            accepted = attempted & checks
+        else:
+            delta = jax.vmap(frob_delta)(states.hist_params, agg_stacked)
+            first = ~states.hist_seen
+            perf_change = jnp.where(first, 0.0, new_perf - states.hist_perf)
+            checks = (delta <= verification_threshold) & \
+                     (perf_change >= -performance_threshold)
+            accepted = attempted & (first | checks)
 
         load_mask = accepted | is_agg  # aggregator loads unconditionally
         params = tree_select_clients(load_mask, agg_stacked, states.params)

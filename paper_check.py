@@ -23,7 +23,12 @@ from bench import _ensure_live_backend, build_data  # noqa: E402
 from fedmse_tpu.utils.platform import capture_provenance  # noqa: E402
 
 
-def measure(shard_dir: str, runs: int = 3, quick: bool = False) -> dict:
+def measure(shard_dir: str, runs: int = 3, quick: bool = False,
+            data_seed: int = None) -> dict:
+    """data_seed overrides the partition draw (reference main.py:115-117
+    re-seeds np.random with data_seed before loading, pinning the
+    train/valid/dev/test split; we mirror) — the paired-draw axis of the
+    Kitsune adjudication (PARITY 1)."""
     import glob
 
     import jax
@@ -38,6 +43,8 @@ def measure(shard_dir: str, runs: int = 3, quick: bool = False) -> dict:
     n_clients = len(glob.glob(os.path.join(shard_dir, "Client-*")))
     assert n_clients, f"no Client-* dirs under {shard_dir}"
     cfg = ExperimentConfig(network_size=n_clients)
+    if data_seed is not None:
+        cfg = cfg.replace(data_seed=data_seed)
     if not quick:
         cfg = paper_scale(cfg)
     dataset = DatasetConfig.for_client_dirs(shard_dir, n_clients)
@@ -61,6 +68,7 @@ def measure(shard_dir: str, runs: int = 3, quick: bool = False) -> dict:
     return {
         "shard_dir": os.path.abspath(shard_dir),
         "n_clients": n_clients,
+        "data_seed": cfg.data_seed,
         "runs": per_run,
         "best_round_mean_avg": round(
             float(np.mean([r["best_round_mean"] for r in per_run])), 5),
@@ -84,7 +92,19 @@ if __name__ == "__main__":
     from fedmse_tpu.utils.platform import enable_compilation_cache
     enable_compilation_cache()
     capture_provenance()  # pin git state before any timed work
+    data_seed = None
+    if "--data-seed" in sys.argv:
+        i = sys.argv.index("--data-seed")
+        try:
+            data_seed = int(sys.argv[i + 1])
+        except (IndexError, ValueError):
+            sys.exit("--data-seed expects an integer value")
+        if data_seed < 0:
+            sys.exit(f"--data-seed expects a non-negative integer, "
+                     f"got {data_seed}")
+        del sys.argv[i:i + 2]
     args = [a for a in sys.argv[1:] if a != "--quick"]
     runs = int(args[1]) if len(args) > 1 else 3
-    print(json.dumps(measure(args[0], runs, quick="--quick" in sys.argv)),
+    print(json.dumps(measure(args[0], runs, quick="--quick" in sys.argv,
+                             data_seed=data_seed)),
           flush=True)

@@ -216,6 +216,140 @@ def test_verify_reject_on_perf_drop(model):
     assert np.all(np.asarray(out2.perf_change)[:3] < -0.002)
 
 
+def test_verify_default_mode_has_the_history_poisoning_hole(model):
+    """Reference-faithful mode accepts a zeroed broadcast forever once it
+    gets in: first contact is unconditional (model_verifier.py:41-47) and
+    history updates every attempt (:59-66), so round 2's zero model sees
+    delta=0 / perf_change=0 vs the poisoned history. Measured live in
+    ATTACK_r04.json (accept 0.857, AUC 0.5, never flagged). This test pins
+    the hole so the hardened mode's fix is provably a behavior CHANGE."""
+    states = _mk_states(model)
+    verify = make_verify_fn(model, verification_threshold=3.0,
+                            performance_threshold=0.002, hardened=False)
+    rng = np.random.default_rng(7)
+    ver_x = jnp.asarray(rng.normal(size=(4, 16, DIM)).astype(np.float32))
+    ver_m = jnp.ones((4, 16))
+    onehot = jnp.asarray([0.0, 0, 0, 1])
+    zero = jax.tree.map(lambda t: jnp.zeros_like(t[0]), states.params)
+    out1 = verify(states, zero, ver_x, ver_m, onehot, jnp.ones(4))
+    assert np.asarray(out1.accepted).tolist() == [True] * 4  # first contact
+    out2 = verify(out1.states, zero, ver_x, ver_m, onehot, jnp.ones(4))
+    assert np.asarray(out2.accepted).tolist() == [True] * 4  # the hole
+    assert np.asarray(out2.states.rejected).tolist() == [0, 0, 0, 0]
+
+
+def _trained_params(model, x, steps=300, lr=1e-2, seed=5):
+    """A genuinely trained single param set (reconstructs x well) — the
+    hardened verifier's own-model baselines only mean something when the
+    own model works, as trained client models do."""
+    params = model.init(jax.random.key(seed), x)["params"]
+    tx = optax.adam(lr)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o):
+        def loss_fn(q):
+            _, recon = model.apply({"params": q}, x)
+            return jnp.mean((recon - x) ** 2)
+        g = jax.grad(loss_fn)(p)
+        up, o2 = tx.update(g, o, p)
+        return optax.apply_updates(p, up), o2
+
+    for _ in range(steps):
+        params, opt = step(params, opt)
+    return params
+
+
+def test_verify_hardened_blocks_zero_attack_and_flags(model):
+    """Hardened mode measures both gates against the client's OWN current
+    model: the zeroed broadcast scores far below any trained model, is
+    rejected from FIRST contact (no unconditional accept to exploit),
+    keeps being rejected (no baseline to poison), and the rejected
+    counter reaches the possible-attack flag threshold (3)."""
+    rng = np.random.default_rng(7)
+    xv = jnp.asarray(rng.normal(size=(16, DIM)).astype(np.float32))
+    trained = _trained_params(model, xv)
+    states = _mk_states(model)
+    states = dataclasses.replace(
+        states, params=jax.tree.map(
+            lambda t: jnp.broadcast_to(t, (4,) + t.shape), trained))
+    verify = make_verify_fn(model, verification_threshold=3.0,
+                            performance_threshold=0.002, hardened=True)
+    ver_x = jnp.broadcast_to(xv, (4,) + xv.shape)
+    ver_m = jnp.ones((4, 16))
+    onehot = jnp.asarray([0.0, 0, 0, 1])
+    zero = jax.tree.map(lambda t: jnp.zeros_like(t[0]), states.params)
+    out = verify(states, zero, ver_x, ver_m, onehot, jnp.ones(4))
+    for _ in range(2):
+        assert np.asarray(out.accepted).tolist() == [False, False, False, True]
+        out = verify(out.states, zero, ver_x, ver_m, onehot, jnp.ones(4))
+    # live params never took the zero state (check every leaf: the first
+    # is a zero-init bias even in a healthy model)
+    assert max(float(np.abs(np.asarray(leaf[:3])).max())
+               for leaf in jax.tree.leaves(out.states.params)) > 0.0
+    # three consecutive rejections -> possible-attack threshold reached
+    assert np.asarray(out.states.rejected).tolist() == [3, 3, 3, 0]
+
+
+def test_verify_hardened_recovery_path(model):
+    """A client whose state was trashed while it served as aggregator
+    (the aggregator loads the broadcast unconditionally,
+    client_trainer.py:333) must be able to rejoin: an honest broadcast
+    that strictly improves on its ruined own model is accepted even
+    though the Frobenius delta from zero to a trained model far exceeds
+    the step-size cap — the IMPROVES waiver, not first contact."""
+    rng = np.random.default_rng(11)
+    xv = jnp.asarray(rng.normal(size=(16, DIM)).astype(np.float32))
+    trained = _trained_params(model, xv)
+    states = _mk_states(model)
+    params = jax.tree.map(
+        lambda t: jnp.broadcast_to(t, (4,) + t.shape), trained)
+    params = jax.tree.map(lambda t: t.at[0].set(0.0), params)
+    states = dataclasses.replace(
+        states, params=params,
+        hist_seen=jnp.asarray([True, True, True, True]))
+    verify = make_verify_fn(model, verification_threshold=3.0,
+                            performance_threshold=0.002, hardened=True)
+    ver_x = jnp.broadcast_to(xv, (4,) + xv.shape)
+    ver_m = jnp.ones((4, 16))
+    onehot = jnp.asarray([0.0, 0, 0, 1])
+    out = verify(states, trained, ver_x, ver_m, onehot, jnp.ones(4))
+    assert np.asarray(out.accepted).tolist() == [True, True, True, True]
+    # client 0's live params actually recovered to the broadcast
+    l0 = jax.tree.leaves(out.states.params)
+    lt = jax.tree.leaves(trained)
+    np.testing.assert_allclose(np.asarray(l0[-1][0]), np.asarray(lt[-1]),
+                               rtol=1e-6)
+
+
+def test_verify_hardened_accepts_honest_aggregate(model):
+    """The hardened rule must not burn honest federation. Post-broadcast,
+    honest clients share the global model plus small local-training
+    deltas, and the next honest aggregate is near them: small Frobenius
+    delta, comparable performance -> accepted, from first contact onward
+    (hardened mode has no first-contact exemption to lean on)."""
+    states = _mk_states(model)
+    common = jax.tree.map(lambda t: t[:1], states.params)  # one shared init
+    jitter = jax.tree.map(  # per-client local-training drift, tiny
+        lambda t: t * 0.01, states.params)
+    states = dataclasses.replace(
+        states, params=jax.tree.map(
+            lambda c, j: jnp.broadcast_to(c, j.shape) + j, common, jitter))
+    verify = make_verify_fn(model, verification_threshold=3.0,
+                            performance_threshold=0.002, hardened=True)
+    rng = np.random.default_rng(9)
+    ver_x = jnp.asarray(rng.normal(size=(4, 16, DIM)).astype(np.float32))
+    ver_m = jnp.ones((4, 16))
+    onehot = jnp.asarray([0.0, 0, 0, 1])
+    # honest aggregate: the mean of the clients' current models
+    agg = jax.tree.map(lambda t: t.mean(axis=0), states.params)
+    out1 = verify(states, agg, ver_x, ver_m, onehot, jnp.ones(4))
+    assert np.asarray(out1.accepted).tolist() == [True] * 4
+    out2 = verify(out1.states, agg, ver_x, ver_m, onehot, jnp.ones(4))
+    assert np.asarray(out2.accepted).tolist() == [True] * 4
+    assert np.asarray(out2.states.rejected).tolist() == [0, 0, 0, 0]
+
+
 # ------------------------- local training ---------------------------- #
 
 def test_local_training_decreases_loss(model):

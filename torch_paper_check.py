@@ -75,9 +75,13 @@ _QUICK = _COMMON  # committed globals ARE the quick-run protocol
 
 
 def measure(shard_dir: str, runs: int = 1, quick: bool = False,
-            rounds: int = 0) -> dict:
+            rounds: int = 0, data_seed: int = None) -> dict:
     """rounds > 0 overrides the protocol's round count — e.g. the 20-round
-    quick-run drift scenario of BENCH_SUITE (bench_suite.py scenario 2)."""
+    quick-run drift scenario of BENCH_SUITE (bench_suite.py scenario 2).
+    data_seed overrides the reference's partition draw (its module global
+    `data_seed = 1234`, re-seeded into np.random before every combination's
+    data load — src/main.py:115-117) — the paired-draw axis of the Kitsune
+    adjudication."""
     import numpy as np
 
     n_clients = len(glob.glob(os.path.join(shard_dir, "Client-*")))
@@ -86,6 +90,8 @@ def measure(shard_dir: str, runs: int = 1, quick: bool = False,
     if rounds:
         overrides = [o for o in overrides if "num_rounds" not in o[1]]
         overrides.append((r'^num_rounds = .*$', f'num_rounds = {rounds}'))
+    if data_seed is not None:
+        overrides.append((r'^data_seed = .*$', f'data_seed = {data_seed}'))
     run_dir, log = run_reference(shard_dir, overrides, n_clients,
                                  extra_fmt={"runs": runs})
     try:
@@ -104,6 +110,7 @@ def measure(shard_dir: str, runs: int = 1, quick: bool = False,
             "shard_dir": os.path.abspath(shard_dir),
             "n_clients": n_clients,
             "rounds_override": rounds or None,
+            "data_seed": data_seed if data_seed is not None else 1234,
             "runs": per_run,
             "best_round_mean_avg": round(
                 float(np.mean([r["best_round_mean"] for r in per_run])), 5),
@@ -130,7 +137,18 @@ if __name__ == "__main__":
         i = sys.argv.index("--rounds")
         rounds = int(sys.argv[i + 1])
         del sys.argv[i:i + 2]
+    data_seed = None
+    if "--data-seed" in sys.argv:
+        i = sys.argv.index("--data-seed")
+        try:
+            data_seed = int(sys.argv[i + 1])
+        except (IndexError, ValueError):
+            sys.exit("--data-seed expects an integer value")
+        if data_seed < 0:
+            sys.exit(f"--data-seed expects a non-negative integer, "
+                     f"got {data_seed}")
+        del sys.argv[i:i + 2]
     args = [a for a in sys.argv[1:] if a != "--quick"]
     runs = int(args[1]) if len(args) > 1 else 1
     print(json.dumps(measure(args[0], runs, quick="--quick" in sys.argv,
-                             rounds=rounds)), flush=True)
+                             rounds=rounds, data_seed=data_seed)), flush=True)
