@@ -139,13 +139,21 @@ def main():
         paper_rows = [run_cell(pcfg, data, n_real, None, 0.0,
                                rounds=pcfg.num_rounds),
                       run_cell(pcfg, data, n_real, "zero", 1.0,
-                               rounds=pcfg.num_rounds)]
+                               rounds=pcfg.num_rounds),
+                      # late start: rounds 0-9 clean converge the models,
+                      # THEN the zero attack — separates the hardened
+                      # gate's fundamental power (own-model yardstick)
+                      # from the cold-start window where barely-trained
+                      # models are indistinguishable from zero
+                      run_cell(pcfg, data, n_real, "zero", 1.0,
+                               rounds=pcfg.num_rounds, start=10)]
         for row in paper_rows:
             print(json.dumps({"mode": mode, "paper_scale": True, **row}),
                   flush=True)
         modes[mode] = {"baseline": cells[0], "cells": cells[1:],
                        "paper_scale_baseline": paper_rows[0],
-                       "paper_scale_zero": paper_rows[1]}
+                       "paper_scale_zero": paper_rows[1],
+                       "paper_scale_zero_late_start10": paper_rows[2]}
 
     device = jax.devices()[0]
     out = {

@@ -50,7 +50,8 @@ class VerifyOutcome(NamedTuple):
 
 def make_verify_fn(model, verification_threshold: float = 3.0,
                    performance_threshold: float = 0.002,
-                   hardened: bool = False) -> Callable:
+                   hardened: bool = False,
+                   recovery_threshold: float = 0.1) -> Callable:
     """Build fn(states, agg_params, ver_x [N,V,D], ver_m [N,V],
     agg_onehot [N], client_mask [N]) -> VerifyOutcome.
 
@@ -76,12 +77,20 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
         honest clients sit at independently trained params whose mutual
         distance exceeds any sane step-size cap (the cold-start problem
         the reference solved with its unconditional accept), or (b) the
-        broadcast strictly improves on the own model by more than
-        performance_threshold — the recovery path: a client whose state
-        was trashed while it served as aggregator (the aggregator loads
-        unconditionally, client_trainer.py:333) can rejoin on the next
-        honest broadcast instead of being delta-capped into permanent
-        exclusion.
+        broadcast improves on the own model by at least
+        ``recovery_threshold`` (default 0.1 on the 0..1 perf scale —
+        deliberately LARGE, not the 0.002 noise threshold) — the recovery
+        path: a client whose state was trashed while it served as
+        aggregator (the aggregator loads unconditionally,
+        client_trainer.py:333) can rejoin on the next honest broadcast
+        (zero-model perf ~0.5 -> trained ~0.9 clears the margin easily)
+        instead of being delta-capped into permanent exclusion. The large
+        margin keeps the cap meaningful against adversaries: a crafted
+        model that merely edges out the own model by the noise threshold
+        does NOT get an unbounded step; one that improves the client's
+        own verification score by 0.1 has, by the only oracle this
+        scheme has ever had (reference model_verifier.py:86-99), earned
+        the replacement it amounts to.
     History/rejected bookkeeping is unchanged, so flag semantics
     (rejected >= 3 => possible attack) carry over.
     """
@@ -118,9 +127,9 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
             own_perf = jax.vmap(perf_of)(states.params, ver_x, ver_m)
             perf_change = new_perf - own_perf
             perf_ok = perf_change >= -performance_threshold
-            improves = perf_change >= performance_threshold
+            recovers = perf_change >= recovery_threshold
             first = ~states.hist_seen
-            checks = perf_ok & (first | improves |
+            checks = perf_ok & (first | recovers |
                                 (delta <= verification_threshold))
             accepted = attempted & checks
         else:

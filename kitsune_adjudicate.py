@@ -44,7 +44,14 @@ DEFAULT_SEEDS = (1234, 7, 99, 2024, 11, 23, 42, 57, 101, 314)
 # two-sided 97.5% t quantiles for df = n-1 (no scipy dependency)
 T975 = {2: 12.706, 3: 4.303, 4: 3.182, 5: 2.776, 6: 2.571, 7: 2.447,
         8: 2.365, 9: 2.306, 10: 2.262, 11: 2.228, 12: 2.201, 13: 2.179,
-        14: 2.160, 15: 2.145}  # beyond 15 draws 1.96 is within 2%
+        14: 2.160, 15: 2.145}
+
+
+def t_crit_975(n):
+    """Two-sided 95% t critical value for n paired draws (df = n-1).
+    Beyond the table, 1.96 + 2.72/df tracks the true quantile within
+    ~0.5% for df >= 15 (t(15)=2.131 vs 2.141, t(30)=2.042 vs 2.051)."""
+    return T975.get(n) or 1.96 + 2.72 / (n - 1)
 
 
 def _arg(flag, default, cast=str):
@@ -163,7 +170,7 @@ def main():
     mean_d = sum(deltas) / n
     sd = math.sqrt(sum((x - mean_d) ** 2 for x in deltas) / (n - 1))
     se = sd / math.sqrt(n)
-    t = T975.get(n, 1.96)
+    t = t_crit_975(n)
     ci = (round(mean_d - t * se, 5), round(mean_d + t * se, 5))
     crosses_zero = ci[0] <= 0.0 <= ci[1]
 

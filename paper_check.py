@@ -20,6 +20,7 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 from bench import _ensure_live_backend, build_data  # noqa: E402
+from refharness import pop_int_flag  # noqa: E402
 from fedmse_tpu.utils.platform import capture_provenance  # noqa: E402
 
 
@@ -92,17 +93,7 @@ if __name__ == "__main__":
     from fedmse_tpu.utils.platform import enable_compilation_cache
     enable_compilation_cache()
     capture_provenance()  # pin git state before any timed work
-    data_seed = None
-    if "--data-seed" in sys.argv:
-        i = sys.argv.index("--data-seed")
-        try:
-            data_seed = int(sys.argv[i + 1])
-        except (IndexError, ValueError):
-            sys.exit("--data-seed expects an integer value")
-        if data_seed < 0:
-            sys.exit(f"--data-seed expects a non-negative integer, "
-                     f"got {data_seed}")
-        del sys.argv[i:i + 2]
+    data_seed = pop_int_flag(sys.argv, "--data-seed", minimum=0)
     args = [a for a in sys.argv[1:] if a != "--quick"]
     runs = int(args[1]) if len(args) > 1 else 3
     print(json.dumps(measure(args[0], runs, quick="--quick" in sys.argv,

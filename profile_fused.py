@@ -19,6 +19,10 @@ Answers, with measurements rather than wall-clock assertions:
      chunk, parsed with `jax.profiler.ProfileData` when this jax build
      exposes it (device-plane event union = busy seconds); the raw trace dir
      is kept for TensorBoard/XProf. Skipped gracefully when unavailable.
+  4. WHICH PHASE owns the marginal per-round time (VERDICT r4 #5)?  The
+     fused scan is rebuilt with each phase (train / vote scoring / verify /
+     eval) replaced by a shape-matched stub; the drop in the fitted
+     marginal b attributes that phase's compute. See _phase_ablation.
 
 Usage:
   python profile_fused.py [--out PROFILE.json] [--chunks 1,8,32,128]
@@ -155,6 +159,125 @@ def _trace_busy_seconds(engine, n_rounds: int, trace_dir: str):
             "trace_dir": trace_dir}, None
 
 
+def _phase_ablation(engine, chunks=(8, 32)):
+    """Attribute the MARGINAL device time per round to phases (VERDICT r4
+    #5): rebuild the fused scan with one phase at a time replaced by a
+    shape-matched stub, fit T(C) = a + b*C over `chunks`, and read each
+    phase's share as b_full - b_variant. Stubs preserve program structure
+    (the election while_loop still runs; the verify cond still branches)
+    so the delta isolates the phase's COMPUTE, not its control flow.
+
+    The variants swap the engine's phase callables and call _build_fused()
+    — the same injection seam the program cache keys on, so no product
+    code changes and the real programs stay cached for the caller (the
+    engine is restored afterwards)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedmse_tpu.federation.verification import VerifyOutcome
+
+    import optax
+
+    from fedmse_tpu.federation.local_training import make_local_train_all
+
+    n_pad = engine.data.num_clients_padded
+    epochs = engine.cfg.epochs
+    cfg = engine.cfg
+    saved = (engine.train_all, engine.scores_fn, engine.verify,
+             engine.evaluate_all, engine._fused_round, engine._fused_scan,
+             engine.tx)
+
+    # candidate optimization (measured, not shipped): optax.flatten folds
+    # the per-leaf Adam update (12 small elementwise ops over the param
+    # tree) into ONE fused vector op. The training loop runs
+    # epochs x n_batches SERIAL steps inside the fused program, so on
+    # latency-dominated backends (tiny kernels on TPU) per-step op count
+    # is the marginal cost driver; identical math either way.
+    flat_tx = optax.flatten(optax.adam(cfg.lr_rate))
+    train_flat = make_local_train_all(
+        model=engine.model, tx=flat_tx, epochs=cfg.epochs,
+        patience=cfg.patience, fedprox=False, mu=cfg.fedprox_mu,
+        restore_best=not cfg.compat.no_best_restore)
+
+    def stub_train(params, opt_state, prev_global, sel_mask, txb, tmb,
+                   vxb, vmb, sel_idx=None):
+        zeros_n = jnp.zeros(n_pad, jnp.float32)
+        tracking = jnp.zeros((n_pad, epochs, 3), jnp.float32)
+        return params, opt_state, params, zeros_n, tracking
+
+    def stub_scores(params, x, m, key):
+        return jnp.zeros(n_pad, jnp.float32)
+
+    def stub_verify(states, agg_params, ver_x, ver_m, agg_onehot,
+                    client_mask):
+        # accept-all load, no perf/frob computation
+        agg_stacked = jax.tree.map(
+            lambda t: jnp.broadcast_to(t, (n_pad,) + t.shape), agg_params)
+        out = dataclasses.replace(states, params=agg_stacked)
+        ones = jnp.ones(n_pad, jnp.float32) > 0
+        zeros = jnp.zeros(n_pad, jnp.float32)
+        return VerifyOutcome(states=out, accepted=ones, perf_change=zeros,
+                             param_delta=zeros)
+
+    def stub_eval(params, test_x, test_m, test_y, train_xb, train_mb):
+        return jnp.zeros(n_pad, jnp.float32)
+
+    variants = {
+        "full": {},
+        "no_train": {"train_all": stub_train},
+        "no_vote_scoring": {"scores_fn": stub_scores},
+        "no_verify": {"verify": stub_verify},
+        "no_eval": {"evaluate_all": stub_eval},
+        "skeleton": {"train_all": stub_train, "scores_fn": stub_scores,
+                     "verify": stub_verify, "evaluate_all": stub_eval},
+        "flat_adam": {"train_all": train_flat, "tx": flat_tx},
+    }
+    result = {}
+    try:
+        for name, subs in variants.items():
+            (engine.train_all, engine.scores_fn, engine.verify,
+             engine.evaluate_all) = (
+                subs.get("train_all", saved[0]),
+                subs.get("scores_fn", saved[1]),
+                subs.get("verify", saved[2]),
+                subs.get("evaluate_all", saved[3]))
+            # a variant with its own optimizer transform must also own
+            # state init (reset_federation builds opt_state from engine.tx)
+            engine.tx = subs.get("tx", saved[6])
+            engine._build_fused()
+            pts = []
+            for c in chunks:
+                _time_chunk(engine, c)  # compile + warm
+                pts.append(min(_time_chunk(engine, c) for _ in range(REPS)))
+            b = (pts[-1] - pts[0]) / (chunks[-1] - chunks[0])
+            result[name] = {"sec_per_dispatch": [round(p, 5) for p in pts],
+                            "marginal_sec_per_round": round(b, 6)}
+            print(json.dumps({"ablation": name, **result[name]}), flush=True)
+    finally:
+        (engine.train_all, engine.scores_fn, engine.verify,
+         engine.evaluate_all, engine._fused_round, engine._fused_scan,
+         engine.tx) = saved
+        engine.reset_federation()  # states must match the restored tx
+    full_b = result["full"]["marginal_sec_per_round"]
+    shares = {}
+    for name in ("no_train", "no_vote_scoring", "no_verify", "no_eval"):
+        if name in result:
+            shares[name.replace("no_", "")] = round(
+                full_b - result[name]["marginal_sec_per_round"], 6)
+    shares["residual_skeleton"] = result["skeleton"]["marginal_sec_per_round"]
+    out = {"variants": result, "marginal_attribution_sec": shares,
+           "chunks": list(chunks),
+           "method": "b(full) - b(variant) per phase; b fit over two "
+                     "chunk sizes, min of REPS warm dispatches each"}
+    if "flat_adam" in result and result["flat_adam"][
+            "marginal_sec_per_round"] > 0:
+        out["flat_adam_speedup_marginal"] = round(
+            full_b / result["flat_adam"]["marginal_sec_per_round"], 3)
+    return out
+
+
 def main():
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -211,6 +334,12 @@ def main():
     except Exception as e:
         trace_info, trace_err = None, repr(e)
 
+    # ---- 4. per-phase attribution of the marginal round time ----
+    try:
+        ablation = _phase_ablation(engine)
+    except Exception as e:
+        ablation = {"error": repr(e)}
+
     device = jax.devices()[0]
     out = {
         "workload": "quick-run fused-scan chunk (10-client N-BaIoT, hybrid "
@@ -228,6 +357,7 @@ def main():
         "peak_flops_bf16_v5e": peak,
         "mfu": (achieved / peak) if achieved else None,
         "trace": trace_info if trace_info else {"unavailable": trace_err},
+        "phase_ablation": ablation,
     }
     reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
     if reason and reason != "1":

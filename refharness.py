@@ -75,3 +75,18 @@ def run_reference(shard_dir: str, overrides, n_clients: int,
 
 def cleanup(run_dir: str) -> None:
     shutil.rmtree(os.path.dirname(run_dir), ignore_errors=True)
+
+def pop_int_flag(argv, flag, default=None, minimum=None):
+    """Parse and REMOVE `<flag> <int>` from argv (shared by the paper-check
+    driver family so seed/round flags validate identically everywhere)."""
+    if flag not in argv:
+        return default
+    i = argv.index(flag)
+    try:
+        val = int(argv[i + 1])
+    except (IndexError, ValueError):
+        sys.exit(f"{flag} expects an integer value")
+    if minimum is not None and val < minimum:
+        sys.exit(f"{flag} expects an integer >= {minimum}, got {val}")
+    del argv[i:i + 2]
+    return val

@@ -322,6 +322,39 @@ def test_verify_hardened_recovery_path(model):
                                rtol=1e-6)
 
 
+def test_verify_hardened_marginal_improvement_does_not_waive_cap(model):
+    """The recovery waiver requires a LARGE improvement (recovery_threshold,
+    default 0.1), not the 0.002 noise threshold: a far-away model that
+    merely edges out the client's own model must still fail the Frobenius
+    step-size cap (round-5 review: otherwise any perf-improving broadcast
+    gets an unbounded step and the cap is decorative)."""
+    rng = np.random.default_rng(13)
+    xv = jnp.asarray(rng.normal(size=(16, DIM)).astype(np.float32))
+    own = _trained_params(model, xv, steps=300, seed=5)
+    # independently initialized, trained longer: slightly better perf
+    # (well under +0.1), but Frobenius-far from `own`
+    other = _trained_params(model, xv, steps=600, seed=6)
+    states = _mk_states(model)
+    states = dataclasses.replace(
+        states,
+        params=jax.tree.map(
+            lambda t: jnp.broadcast_to(t, (4,) + t.shape), own),
+        hist_seen=jnp.asarray([True, True, True, True]))
+    verify = make_verify_fn(model, verification_threshold=3.0,
+                            performance_threshold=0.002, hardened=True)
+    ver_x = jnp.broadcast_to(xv, (4,) + xv.shape)
+    ver_m = jnp.ones((4, 16))
+    onehot = jnp.asarray([0.0, 0, 0, 1])
+    out = verify(states, other, ver_x, ver_m, onehot, jnp.ones(4))
+    delta = np.asarray(out.param_delta)
+    change = np.asarray(out.perf_change)
+    # preconditions that make this test meaningful
+    assert np.all(delta[:3] > 3.0), delta
+    assert np.all(change[:3] < 0.1), change
+    # marginal improvement + far params -> rejected (aggregator exempt)
+    assert np.asarray(out.accepted).tolist() == [False, False, False, True]
+
+
 def test_verify_hardened_accepts_honest_aggregate(model):
     """The hardened rule must not burn honest federation. Post-broadcast,
     honest clients share the global model plus small local-training

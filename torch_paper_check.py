@@ -24,7 +24,7 @@ import json
 import os
 import sys
 
-from refharness import cleanup, run_reference
+from refharness import cleanup, pop_int_flag, run_reference
 
 
 _PLATFORM_MOD = None
@@ -132,22 +132,8 @@ def measure(shard_dir: str, runs: int = 1, quick: bool = False,
 
 if __name__ == "__main__":
     capture_provenance()  # pin git state before any timed work
-    rounds = 0
-    if "--rounds" in sys.argv:
-        i = sys.argv.index("--rounds")
-        rounds = int(sys.argv[i + 1])
-        del sys.argv[i:i + 2]
-    data_seed = None
-    if "--data-seed" in sys.argv:
-        i = sys.argv.index("--data-seed")
-        try:
-            data_seed = int(sys.argv[i + 1])
-        except (IndexError, ValueError):
-            sys.exit("--data-seed expects an integer value")
-        if data_seed < 0:
-            sys.exit(f"--data-seed expects a non-negative integer, "
-                     f"got {data_seed}")
-        del sys.argv[i:i + 2]
+    rounds = pop_int_flag(sys.argv, "--rounds", default=0, minimum=1) or 0
+    data_seed = pop_int_flag(sys.argv, "--data-seed", minimum=0)
     args = [a for a in sys.argv[1:] if a != "--quick"]
     runs = int(args[1]) if len(args) > 1 else 1
     print(json.dumps(measure(args[0], runs, quick="--quick" in sys.argv,
