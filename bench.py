@@ -93,8 +93,24 @@ BASELINE_AUC_STD = 0.01289
 # (BENCH_SCALING_r04_cpu.json; 20/30/40 measured there too; 25 is the
 # 20/30 interpolation used in PARITY §4; 200/500 from the
 # BENCH_C{200,500}_r04_cpu captures).
+# Two caveats a reader of vs_baseline needs (VERDICT r4 weak #6):
+#   * rows were captured in separate sessions on this 1-core box, so they
+#     embed different background-load regimes (the 20-client row's 2.67
+#     vs the 10-client protocol's 3.33 is load noise, not torch getting
+#     faster with more clients);
+#   * the table is legitimately non-monotonic in N anyway: the fixed
+#     N-BaIoT pool is SPLIT N ways, so per-client shards thin out
+#     (~26 train rows/client at 500) and sequential-torch round time
+#     tracks (selected clients) x (rows/client + per-client overhead),
+#     not N alone.
 SCALING_BASELINE_SEC = {20: 2.67, 25: 4.2, 30: 5.81, 40: 7.55, 50: 8.78,
                         100: 4.512, 200: 5.312, 500: 10.925}
+SCALING_BASELINE_NOTE = (
+    "per-scale torch baselines captured in separate sessions on a 1-core "
+    "box (different load regimes; the 20-client row predates the others) "
+    "and non-monotonic in N by construction (fixed pool split N ways - "
+    "rows/client shrink as N grows); within-row speedups are valid, "
+    "cross-N torch comparisons are not")
 
 NBAIOT_ROOT = "/root/reference/Data/N-BaIoT/IID-10-Client_Data"
 
@@ -369,6 +385,9 @@ def main():
         "baseline_platform": "cpu",
         "baseline_note": "no GPU in this environment; vs_baseline is "
                          "TPU/torch-CPU on identical workload",
+        "scaling_baseline_note": (SCALING_BASELINE_NOTE
+                                  if n_clients != 10 and not paper
+                                  else None),
         "device": str(device),
         "platform": device.platform,
         "mode": "fused-scan" if fused else "per-phase",

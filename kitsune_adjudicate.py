@@ -16,11 +16,14 @@ implementation drift is real — isolate with parity_probe.py on the worst
 draw.
 
 Checkpoints after every seed (--checkpoint, default
-/tmp/kitsune_adj_r05.ckpt.json) so an interrupted sweep resumes without
-redoing finished draws. Coordinates with the TPU watcher: waits while
-/tmp/fedmse_tpu_capturing exists and holds /tmp/fedmse_cpu_busy during
-each measured slice (1-core box — concurrent CPU load corrupts the
-battery's wall-clock numbers, and vice versa).
+KITSUNE_ADJ_CHECKPOINT.json at the repo root, git-committed after every
+completed draw — on this box a driver restart wipes even gitignored
+files, so the only durable checkpoint is a committed one) so an
+interrupted sweep resumes without redoing finished draws. Coordinates
+with the TPU watcher through the atomic box lock
+/tmp/fedmse_box_lock (mkdir-based; watch_tpu.sh takes it for
+probe+battery, this driver takes it per measured slice — 1-core box:
+concurrent load corrupts both sides' wall-clock numbers).
 
 Usage: python kitsune_adjudicate.py [--seeds 1234,7,...] [--runs 2]
            [--shards Data/kitsune-8clients-anchor] [--out KITSUNE_PAPER_r05.json]
@@ -34,9 +37,11 @@ import sys
 import time
 
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO_ROOT)
 
-CAPTURING_FLAG = "/tmp/fedmse_tpu_capturing"
-CPU_BUSY_FLAG = "/tmp/fedmse_cpu_busy"
+from refharness import pop_int_flag  # noqa: E402
+
+BOX_LOCK = "/tmp/fedmse_box_lock"
 
 # 10 draws: the four round-4 seeds (re-measured at this engine) + six new
 DEFAULT_SEEDS = (1234, 7, 99, 2024, 11, 23, 42, 57, 101, 314)
@@ -60,15 +65,29 @@ def _arg(flag, default, cast=str):
     return default
 
 
-def wait_for_cpu(log=print):
-    """Block while the TPU battery runs; the battery owns the box."""
+def acquire_box_lock(log=print):
+    """Atomically take the box (mkdir): the watcher holds this through
+    probe+battery, we hold it per measured slice. No check-then-act
+    window (round-5 review: the old two-flag handshake could let the
+    battery and a torch slice share the core)."""
     waited = False
-    while os.path.exists(CAPTURING_FLAG):
-        if not waited:
-            log(json.dumps({"waiting": "tpu battery holds the box"}),
-                flush=True)
-            waited = True
-        time.sleep(60)
+    while True:
+        try:
+            os.mkdir(BOX_LOCK)
+            return
+        except FileExistsError:
+            if not waited:
+                log(json.dumps({"waiting": "box lock held "
+                                "(tpu battery or probe)"}), flush=True)
+                waited = True
+            time.sleep(60)
+
+
+def release_box_lock():
+    try:
+        os.rmdir(BOX_LOCK)
+    except OSError:
+        pass
 
 
 def run_side(cmd, log_path, env=None, timeout=14400):
@@ -90,11 +109,13 @@ def run_side(cmd, log_path, env=None, timeout=14400):
 def main():
     seeds = [int(s) for s in
              _arg("--seeds", ",".join(map(str, DEFAULT_SEEDS))).split(",")]
-    runs = _arg("--runs", 2, int)
+    runs = pop_int_flag(sys.argv, "--runs", default=2, minimum=1)
     shards = _arg("--shards", "Data/kitsune-8clients-anchor")
     out_path = _arg("--out", "KITSUNE_PAPER_r05.json")
-    ckpt_path = _arg("--checkpoint", "/tmp/kitsune_adj_r05.ckpt.json")
-    side_log = ckpt_path + ".sides.log"
+    ckpt_path = _arg("--checkpoint",
+                     os.path.join(REPO_ROOT, "KITSUNE_ADJ_CHECKPOINT.json"))
+    side_log = os.path.join("/tmp", os.path.basename(ckpt_path)
+                            + ".sides.log")
 
     meta = {"runs": runs, "shards": os.path.abspath(shards)}
     ckpt = {}
@@ -117,8 +138,7 @@ def main():
         done = ckpt.get(key, {})
         if "ours" in done and "torch" in done:
             continue
-        wait_for_cpu()
-        open(CPU_BUSY_FLAG, "w").close()
+        acquire_box_lock()
         try:
             t0 = time.time()
             if "ours" not in done:
@@ -139,8 +159,8 @@ def main():
                 "torch": done["torch"]["best_round_mean_avg"],
             }), flush=True)
         finally:
-            if os.path.exists(CPU_BUSY_FLAG):
-                os.remove(CPU_BUSY_FLAG)
+            release_box_lock()
+        _commit_checkpoint(ckpt_path, seed)
 
     # ---- paired statistics over the completed draws ----
     pairs = []
@@ -204,9 +224,24 @@ def main():
 
 
 def run_provenance():
-    sys.path.insert(0, REPO_ROOT)
     from fedmse_tpu.utils.platform import capture_provenance
     return capture_provenance()
+
+
+def _commit_checkpoint(ckpt_path, seed):
+    """Durable resume on a box whose restarts wipe even gitignored files:
+    commit the checkpoint after every completed draw. Pathspec-scoped so a
+    concurrent interactive session's staged work is never swept in."""
+    rel = os.path.relpath(ckpt_path, REPO_ROOT)
+    if rel.startswith(".."):
+        return  # operator pointed the checkpoint outside the repo
+    subprocess.run(["git", "-C", REPO_ROOT, "add", "--", rel],
+                   capture_output=True)
+    subprocess.run(
+        ["git", "-C", REPO_ROOT, "commit",
+         "-m", f"kitsune adjudication checkpoint through seed {seed}\n\n"
+               "No-Verification-Needed: measurement checkpoint, no code",
+         "--", rel], capture_output=True)
 
 
 def _write(path, obj):

@@ -18,12 +18,17 @@
 #        — capture-debt items first so a short window still pays them)
 #   -r  artifact round tag                   (default r05)
 #
-# Coordination files:
-#   /tmp/fedmse_cpu_busy       — created by CPU-heavy jobs; the watcher
-#                                waits while it exists (1-core box: CPU
-#                                load corrupts battery wall-clock timing)
-#   /tmp/fedmse_tpu_capturing  — created by THIS script while the battery
-#                                runs; CPU jobs should wait on it
+# Coordination:
+#   /tmp/fedmse_box_lock       — atomic mkdir lock shared with CPU-heavy
+#                                drivers (kitsune_adjudicate.py): held here
+#                                through probe+battery, held there per
+#                                measured slice. mkdir is the acquire, so
+#                                there is no check-then-act window (1-core
+#                                box: concurrent load corrupts both sides'
+#                                wall-clock numbers).
+#   /tmp/fedmse_cpu_busy       — legacy advisory flag, still honored: ad-hoc
+#                                CPU jobs may create it; the watcher defers
+#   /tmp/fedmse_tpu_capturing  — observability flag while the battery runs
 set -u
 cd "$(dirname "$0")"
 OUT=/tmp/tpu_capture_r05; DEADLINE_IN=39600; TAG=r05
@@ -135,18 +140,26 @@ while true; do
         echo "cpu busy $(date +%F\ %T); waiting" >> "$LOG"
         sleep 60
     done
+    # take the box lock BEFORE probing: a CPU driver that starts mid-probe
+    # would otherwise share the core with the battery (review finding)
+    if ! mkdir /tmp/fedmse_box_lock 2>/dev/null; then
+        echo "box lock held $(date +%F\ %T); waiting" >> "$LOG"
+        sleep 60
+        continue
+    fi
     if timeout 120 python -c "import jax; d=jax.devices()[0]; \
 assert d.platform=='tpu', d.platform" >> "$LOG" 2>&1; then
         echo "tunnel healthy $(date +%F\ %T); firing battery" >> "$LOG"
-        break
+        break  # lock stays held through the battery; EXIT trap releases
     fi
+    rmdir /tmp/fedmse_box_lock 2>/dev/null
     echo "probe failed $(date +%F\ %T); sleeping 240s" >> "$LOG"
     sleep 240
 done
 
 # ---- battery ----
 touch /tmp/fedmse_tpu_capturing
-trap 'rm -f /tmp/fedmse_tpu_capturing' EXIT
+trap 'rm -f /tmp/fedmse_tpu_capturing; rmdir /tmp/fedmse_box_lock 2>/dev/null' EXIT
 # clean any previous invocation's captures: the landing loop below must
 # only ever see THIS battery's outputs (a stale .out from an older engine
 # landing under a fresh tag is a provenance lie)
