@@ -161,6 +161,14 @@ def main():
         finally:
             release_box_lock()
         _commit_checkpoint(ckpt_path, seed)
+        # courtesy yield: without it this loop re-acquires the lock
+        # microseconds after releasing it and the watcher (60 s poll)
+        # never gets to probe during a multi-hour sweep — starving the
+        # TPU capture the round exists to land. 3 min covers the
+        # watcher's poll + its 120 s probe window.
+        if any("ours" not in ckpt.get(str(sd), {})
+               or "torch" not in ckpt.get(str(sd), {}) for sd in seeds):
+            time.sleep(180)
 
     # ---- paired statistics over the completed draws ----
     pairs = []
