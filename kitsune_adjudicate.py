@@ -106,6 +106,11 @@ def run_side(cmd, log_path, env=None, timeout=14400):
     return json.loads(lines[-1])
 
 
+def _complete(d):
+    """Both sides of a draw measured."""
+    return "ours" in d and "torch" in d
+
+
 def main():
     seeds = [int(s) for s in
              _arg("--seeds", ",".join(map(str, DEFAULT_SEEDS))).split(",")]
@@ -136,7 +141,7 @@ def main():
     for seed in seeds:
         key = str(seed)
         done = ckpt.get(key, {})
-        if "ours" in done and "torch" in done:
+        if _complete(done):
             continue
         acquire_box_lock()
         try:
@@ -166,15 +171,14 @@ def main():
         # never gets to probe during a multi-hour sweep — starving the
         # TPU capture the round exists to land. 3 min covers the
         # watcher's poll + its 120 s probe window.
-        if any("ours" not in ckpt.get(str(sd), {})
-               or "torch" not in ckpt.get(str(sd), {}) for sd in seeds):
+        if any(not _complete(ckpt.get(str(sd), {})) for sd in seeds):
             time.sleep(180)
 
     # ---- paired statistics over the completed draws ----
     pairs = []
     for seed in seeds:
         d = ckpt.get(str(seed), {})
-        if "ours" in d and "torch" in d:
+        if _complete(d):
             pairs.append({
                 "seed": seed,
                 "ours_best_round_mean": d["ours"]["best_round_mean_avg"],

@@ -565,3 +565,28 @@ def test_scaling_baselines_match_committed_artifacts():
     # measurement — keep it between its neighbors
     assert (bench.SCALING_BASELINE_SEC[20] < bench.SCALING_BASELINE_SEC[25]
             < bench.SCALING_BASELINE_SEC[30])
+
+
+def test_kitsune_adjudication_statistics():
+    """The paired-CI machinery the Kitsune verdict rests on: exact t
+    criticals from the table, the df-keyed fallback within 0.5% of true
+    quantiles, and pop_int_flag's validation (shared by the paper-check
+    driver family)."""
+    from kitsune_adjudicate import t_crit_975
+    from refharness import pop_int_flag
+
+    # table values are the exact two-sided 97.5% quantiles for df = n-1
+    assert t_crit_975(2) == 12.706 and t_crit_975(10) == 2.262
+    # fallback tracks the true quantile beyond the table
+    for n, true_t in ((16, 2.131), (31, 2.042), (61, 2.000)):
+        assert abs(t_crit_975(n) - true_t) / true_t < 0.006, n
+    argv = ["prog", "positional", "--data-seed", "7"]
+    assert pop_int_flag(argv, "--data-seed", minimum=0) == 7
+    assert argv == ["prog", "positional"]  # flag consumed
+    assert pop_int_flag(argv, "--absent", default=3) == 3
+    with pytest.raises(SystemExit):
+        pop_int_flag(["p", "--runs", "x"], "--runs")
+    with pytest.raises(SystemExit):
+        pop_int_flag(["p", "--runs", "0"], "--runs", minimum=1)
+    with pytest.raises(SystemExit):
+        pop_int_flag(["p", "--runs"], "--runs")  # value missing
