@@ -128,3 +128,21 @@ def test_fused_quota_exhaustion():
     assert res.aggregator is None
     assert res.mse_scores is None
     assert res.verification_results == []
+
+
+def test_hardened_clean_run_identical_to_reference_mode():
+    """--hardened-verification must be invisible on an honest federation:
+    same selections, same aggregators, same metrics, zero rejections in
+    BOTH modes over a multi-round schedule (the gates differ only in what
+    they reject — a clean run offers nothing to reject). The engine-level
+    twin of the unit-level honest-aggregate test."""
+    ref = build_engine(fused=True)
+    hard = build_engine(fused=True, hardened_verification=True)
+    res_ref = ref.run_rounds(0, 3)
+    res_hard = hard.run_rounds(0, 3)
+    for ra, rb in zip(res_ref, res_hard):
+        assert_results_match(ra, rb)
+    assert all(r["rejected_updates"] == 0
+               for res in res_hard for r in res.verification_results)
+    # and the two modes must NOT share a verify program (cache key)
+    assert ref.verify is not hard.verify
