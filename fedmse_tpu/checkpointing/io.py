@@ -181,11 +181,27 @@ class CheckpointManager:
             # be restored against a newer round_index
             os.remove(self._path(tag) + ".tracking.npz")
 
-    def restore(self, tag: str, states_like: ClientStates):
+    def restore(self, tag: str, states_like: ClientStates,
+                expected_extra: Optional[Dict] = None):
         """Returns (states, host, round_index, tracking). `states_like`
         provides the pytree structure/shapes (build it with
         init_client_states); `tracking` is the accumulated [n_real, E, 3]
-        loss curve up to the checkpointed round (None if not saved)."""
+        loss curve up to the checkpointed round (None if not saved).
+
+        `expected_extra` keys are validated against the checkpoint's
+        recorded `extra` BEFORE the Orbax restore: layout-changing config
+        (e.g. flatten_optimizer flips the opt_state pytree) would
+        otherwise surface as a cryptic tree-structure mismatch deep in
+        Orbax instead of naming the flag that changed."""
+        if expected_extra:
+            with open(self._path(tag) + ".host.json") as f:
+                saved = json.load(f).get("extra", {})
+            for key, want in expected_extra.items():
+                if key in saved and saved[key] != want:
+                    raise ValueError(
+                        f"checkpoint {tag!r} was written with {key}="
+                        f"{saved[key]!r} but this run uses {key}={want!r};"
+                        f" resume with the matching setting or start fresh")
         target = {
             "states": dataclasses.asdict(states_like),
             "round_index": np.asarray(0),

@@ -220,6 +220,15 @@ class ExperimentConfig:
     # fused single-kernel forward for evaluation: 'off' | 'auto' | 'pallas' |
     # 'xla' ('auto' = pallas on TPU, XLA-fused elsewhere; ops/pallas_ae.py)
     fused_eval: str = "off"
+    # optax.flatten around Adam: folds the per-leaf update (12 small
+    # elementwise ops per step across the param tree; the training loop
+    # runs ~275 serial steps per round inside the fused program) into ONE
+    # fused vector op. Identical math — Adam is elementwise — different
+    # opt_state layout. Wins in latency-dominated regimes (tiny kernels on
+    # TPU; 1.09x marginal even on compute-bound CPU —
+    # PROFILE phase_ablation "flat_adam"). Default off until the on-chip
+    # ablation justifies flipping it.
+    flatten_optimizer: bool = False
     # single-dispatch rounds (federation/fused.py): the whole round compiles
     # into one XLA program. Same math as the per-phase path (numerically
     # equivalent to rtol=1e-4 when compat.vote_tie_break is off — XLA fusion

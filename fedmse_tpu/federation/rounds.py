@@ -85,12 +85,16 @@ def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
            cfg.fedprox_mu, cfg.compat.no_best_restore,
            cfg.compat.restandardize_vote_data, cfg.compat.vote_tie_break,
            cfg.verification_threshold, cfg.performance_threshold,
-           cfg.hardened_verification,
+           cfg.hardened_verification, cfg.flatten_optimizer,
            model_type, cfg.metric, cfg.fused_eval)
     hit = _PROGRAM_CACHE.get(key)
     if hit is not None:
         return hit
     tx = optax.adam(cfg.lr_rate)
+    if cfg.flatten_optimizer:
+        # one fused vector update instead of 12 per-leaf ops per step;
+        # identical Adam math (elementwise), different opt_state layout
+        tx = optax.flatten(tx)
     programs = {
         "tx": tx,
         "train_all": make_local_train_all(

@@ -128,7 +128,8 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     start_round = 0
     if resume is not None and resume.exists(tag):
         engine.states, engine.host, start_round, prev_tracking = \
-            resume.restore(tag, engine.states)
+            resume.restore(tag, engine.states, expected_extra={
+                "flatten_optimizer": cfg.flatten_optimizer})
         if prev_tracking is not None:  # keep the pre-kill part of the curve
             all_tracking.append(prev_tracking)
         logger.info("resumed %s at round %d", tag, start_round)
@@ -195,6 +196,8 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
             if resume is not None:
                 resume.save(tag, engine.states, engine.host,
                             round_index + done,
+                            extra={"flatten_optimizer":
+                                   cfg.flatten_optimizer},
                             tracking=np.concatenate(all_tracking, axis=1)
                             if all_tracking else None)
             round_index += k
@@ -206,6 +209,8 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
             fired = bookkeep(result, sec)
             if resume is not None:
                 resume.save(tag, engine.states, engine.host, round_index + 1,
+                            extra={"flatten_optimizer":
+                                   cfg.flatten_optimizer},
                             tracking=np.concatenate(all_tracking, axis=1)
                             if all_tracking else None)
             if fired:

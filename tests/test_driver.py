@@ -235,3 +235,31 @@ def test_checkpoint_tracking_roundtrip(tmp_path):
     # tracking is optional: a save without it restores None
     mgr.save("u", states, host, 1)
     assert mgr.restore("u", states)[3] is None
+
+
+def test_restore_validates_layout_changing_config(tmp_path):
+    """A checkpoint written under one opt_state layout must refuse a
+    restore under another WITH A CLEAR MESSAGE naming the flag —
+    flatten_optimizer flips the Adam state pytree, and without the guard
+    the mismatch surfaces as a cryptic Orbax tree-structure error."""
+    import jax
+    import optax
+    import pytest
+
+    from fedmse_tpu.checkpointing import CheckpointManager
+    from fedmse_tpu.federation.state import HostState, init_client_states
+    from fedmse_tpu.models import make_model
+
+    model = make_model("hybrid", DIM)
+    states = init_client_states(model, optax.adam(1e-3), jax.random.key(0), 3)
+    host = HostState.create(3)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save("t", states, host, 1, extra={"flatten_optimizer": False})
+
+    with pytest.raises(ValueError, match="flatten_optimizer"):
+        mgr.restore("t", states, expected_extra={"flatten_optimizer": True})
+    # matching flag restores fine; keys absent from the checkpoint (older
+    # snapshots) are not validated
+    assert mgr.restore("t", states,
+                       expected_extra={"flatten_optimizer": False,
+                                       "not_recorded": 1})[2] == 1

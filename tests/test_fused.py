@@ -146,3 +146,19 @@ def test_hardened_clean_run_identical_to_reference_mode():
                for res in res_hard for r in res.verification_results)
     # and the two modes must NOT share a verify program (cache key)
     assert ref.verify is not hard.verify
+
+
+def test_flatten_optimizer_is_numerically_equivalent():
+    """cfg.flatten_optimizer wraps Adam in optax.flatten — one fused
+    vector update instead of 12 per-leaf ops per serial step. Adam is
+    elementwise, so results must match the default layout numerically
+    (same selections, aggregators, metrics) over a multi-round schedule;
+    only the opt_state layout differs."""
+    ref = build_engine(fused=True)
+    flat = build_engine(fused=True, flatten_optimizer=True)
+    res_ref = ref.run_rounds(0, 3)
+    res_flat = flat.run_rounds(0, 3)
+    for ra, rb in zip(res_ref, res_flat):
+        assert_results_match(ra, rb)
+    # different transforms must not share a program set
+    assert ref.train_all is not flat.train_all
