@@ -172,6 +172,81 @@ def _timed_pass(engine, fused: bool, timed_rounds: int):
     return (time.time() - t0) / timed_rounds, results
 
 
+def measure_sweep(cfg, data, n_real: int, runs: int, timed_rounds: int):
+    """sec/sweep for R runs of the quick-run schedule, batched vs
+    sequential (ISSUE 1 tentpole metric): the sequential side resets and
+    runs R fused-scan schedules one after another exactly like the sweep
+    driver (main.py:run_experiment); the batched side advances all R
+    federations in runs-axis-batched dispatches
+    (federation/batched.py). Both sides include the per-round host
+    bookkeeping the real driver pays (RoundResult absorption). Warm-up
+    passes compile both programs; the reported numbers are the min over
+    repeated warm sweeps (bench._min_over_reps bursty-tunnel rule)."""
+    import numpy as np
+    from fedmse_tpu.federation import BatchedRunEngine, RoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    model = make_model("hybrid", cfg.dim_features,
+                       shrink_lambda=cfg.shrink_lambda)
+    engine = RoundEngine(model, cfg, data, n_real=n_real,
+                         rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                         model_type="hybrid", update_type="mse_avg",
+                         fused=True)
+
+    def sequential_sweep():
+        t0 = time.time()
+        results = []
+        for run in range(runs):
+            engine.rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed)
+            engine.reset_federation()
+            start = 0
+            while start < timed_rounds:
+                k = min(cfg.fused_schedule_chunk, timed_rounds - start)
+                results.extend(engine.run_rounds(start, k))
+                start += k
+        return time.time() - t0, results
+
+    bengine = BatchedRunEngine(model, cfg, data, n_real=n_real, runs=runs,
+                               model_type="hybrid", update_type="mse_avg")
+
+    def batched_sweep():
+        # reset INSIDE the timer, matching sequential_sweep: both sides pay
+        # their state-init dispatches, as the real sweep driver does
+        active = np.ones(runs, bool)
+        t0 = time.time()
+        bengine.reset_federation()
+        results = []
+        start = 0
+        while start < timed_rounds:
+            k = min(cfg.fused_schedule_chunk, timed_rounds - start)
+            outs, schedule, _ = bengine.run_schedule_chunk(start, k, active)
+            for i in range(k):
+                for r in range(runs):
+                    results.append(bengine.process_round(
+                        r, start + i, schedule[i][r], outs, i))
+            start += k
+        return time.time() - t0, results
+
+    sequential_sweep()  # warm-up: every jit compile lands here
+    batched_sweep()
+    seq_sec, seq_results = _min_over_reps(sequential_sweep)
+    bat_sec, bat_results = _min_over_reps(batched_sweep)
+    final_auc = round(float(np.nanmean(
+        [r.client_metrics for r in bat_results[-runs:]])), 5)
+    return {
+        "runs": runs,
+        "rounds": timed_rounds,
+        "sequential_sec_per_sweep": round(seq_sec, 4),
+        "batched_sec_per_sweep": round(bat_sec, 4),
+        "speedup_batched_vs_sequential": round(seq_sec / bat_sec, 2)
+        if bat_sec else None,
+        "sequential_sec_per_run": round(seq_sec / runs, 4),
+        "batched_sec_per_run": round(bat_sec / runs, 4),
+        "final_round_mean_auc_batched": final_auc,
+    }
+
+
 def build_data(cfg, n_clients: int = 10, dataset=None):
     """Stacked federation tensors for a benchmark scenario.
 
@@ -249,6 +324,9 @@ def main():
 
     n_clients = _int_flag("--clients", 10)
     num_runs = _int_flag("--num-runs", None)
+    sweep_runs = _int_flag("--sweep-runs", None)
+    if sweep_runs is not None and sweep_runs < 1:
+        sys.exit(f"--sweep-runs expects a positive integer, got {sweep_runs}")
     chunk = _int_flag("--chunk", None)
     if chunk is not None and chunk < 1:
         sys.exit(f"--chunk expects a positive integer, got {chunk}")
@@ -275,6 +353,35 @@ def main():
         from fedmse_tpu.config import paper_scale
         cfg = paper_scale(cfg)
     data, n_real, rngs = build_data(cfg, n_clients)
+
+    if sweep_runs is not None:
+        # sec/sweep mode (ISSUE 1): R runs of the quick-run schedule,
+        # batched (runs-axis vmap) vs sequential, one JSON line out
+        timed_rounds = cfg.num_rounds if paper else 3
+        device = jax.devices()[0]
+        out = {
+            "metric": f"sec/sweep ({sweep_runs} runs x {timed_rounds} "
+                      f"rounds, N-BaIoT {n_clients}-client IID, hybrid "
+                      f"SAE-CEN + mse_avg, 50% participation)",
+            "value": None,  # filled from batched_sec_per_sweep below
+            "unit": "s",
+            "device": str(device),
+            "platform": device.platform,
+            "mode": "batched-runs vs sequential fused-scan",
+            "fused_schedule_chunk": cfg.fused_schedule_chunk,
+            "data_seed": cfg.data_seed,
+            "data_source": ("nbaiot" if os.path.isdir(NBAIOT_ROOT)
+                            or n_clients != 10 else "synthetic-fallback"),
+        }
+        out.update(measure_sweep(cfg, data, n_real, sweep_runs,
+                                 timed_rounds))
+        out["value"] = out["batched_sec_per_sweep"]
+        reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
+        if reason and reason != "1":
+            out["tpu_fallback_reason"] = reason
+        out.update(capture_provenance())
+        print(json.dumps(out))
+        return
 
     model = make_model("hybrid", cfg.dim_features,
                        shrink_lambda=cfg.shrink_lambda)

@@ -109,16 +109,30 @@ def scen_single_client():
             "sec_per_5_epochs": round(sec, 4), "auc": round(float(auc), 5)}
 
 
+def scen_batched_runs(cfg, dataset):
+    """Scenario 6: sec/sweep for R ∈ {1, 3, 10} quick-run federations,
+    runs-axis-batched vs sequential (ISSUE 1: R runs should cost ~1 run on
+    a dispatch-bound engine)."""
+    from bench import measure_sweep
+
+    data, n_real, _ = _federation(cfg, dataset)
+    sweeps = [measure_sweep(cfg, data, n_real, runs, timed_rounds=3)
+              for runs in (1, 3, 10)]
+    return {"scenario": "batched multi-run sweeps (R in {1,3,10}), "
+                        "10-client, 3 rounds, batched vs sequential",
+            "sweeps": sweeps}
+
+
 def main():
-    only = None  # debug: run a single scenario (1-5)
+    only = None  # debug: run a single scenario (1-6)
     if "--only" in sys.argv:  # validate before the (slow) TPU liveness probe
         idx = sys.argv.index("--only") + 1
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-5")
-        if not 1 <= only <= 5:
-            sys.exit(f"--only expects a scenario number 1-5, got {only}")
+            sys.exit("--only expects a scenario number 1-6")
+        if not 1 <= only <= 6:
+            sys.exit(f"--only expects a scenario number 1-6, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -182,6 +196,9 @@ def main():
         emit({"scenario": "50-client scaled N-BaIoT, 20% participation, "
                           "50 rounds", "sec_per_round": round(sec, 4),
               "final_auc": round(auc, 5)})
+
+    if only in (None, 6):
+        emit(scen_batched_runs(ExperimentConfig(), nbaiot10))
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

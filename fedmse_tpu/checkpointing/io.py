@@ -182,7 +182,8 @@ class CheckpointManager:
             os.remove(self._path(tag) + ".tracking.npz")
 
     def restore(self, tag: str, states_like: ClientStates,
-                expected_extra: Optional[Dict] = None):
+                expected_extra: Optional[Dict] = None,
+                extra_defaults: Optional[Dict] = None):
         """Returns (states, host, round_index, tracking). `states_like`
         provides the pytree structure/shapes (build it with
         init_client_states); `tracking` is the accumulated [n_real, E, 3]
@@ -192,15 +193,26 @@ class CheckpointManager:
         recorded `extra` BEFORE the Orbax restore: layout-changing config
         (e.g. flatten_optimizer flips the opt_state pytree) would
         otherwise surface as a cryptic tree-structure mismatch deep in
-        Orbax instead of naming the flag that changed."""
+        Orbax instead of naming the flag that changed. A key the checkpoint
+        never recorded (written before that flag existed) is compared
+        against its value in `extra_defaults` — a pre-flag snapshot was
+        necessarily written under the flag's default, so resuming it under
+        a non-default setting must fail with the clear message too, not
+        fall through to the Orbax tree error (ADVICE r5)."""
         if expected_extra:
             with open(self._path(tag) + ".host.json") as f:
                 saved = json.load(f).get("extra", {})
             for key, want in expected_extra.items():
-                if key in saved and saved[key] != want:
+                if key in saved:
+                    recorded = saved[key]
+                elif extra_defaults is not None and key in extra_defaults:
+                    recorded = extra_defaults[key]
+                else:
+                    continue  # no recorded value and no known default
+                if recorded != want:
                     raise ValueError(
                         f"checkpoint {tag!r} was written with {key}="
-                        f"{saved[key]!r} but this run uses {key}={want!r};"
+                        f"{recorded!r} but this run uses {key}={want!r};"
                         f" resume with the matching setting or start fresh")
         target = {
             "states": dataclasses.asdict(states_like),

@@ -37,9 +37,13 @@ def _flatten_batches(xb: jax.Array, mb: jax.Array) -> Tuple[jax.Array, jax.Array
 def make_evaluate_all(model, model_type: str, metric: str = "AUC",
                       fused: str = "off", latency_reps: int = 5) -> Callable:
     """Build fn(stacked_params, test_x, test_m, test_y, train_xb, train_mb)
-    -> metrics [N] (AUC or F1, reference returns f1 for 'classification';
-    metric='time' returns steady-state per-client inference latency in
-    seconds — the vectorized counterpart of reference evaluator.py:99-108).
+    -> metrics [N] for AUC, or [N, 3] (f1, precision, recall) for
+    'classification' — the reference's calculate_classification_metric
+    returns all three (evaluator.py:42-47), so the batch path does too;
+    the round engine keeps f1 (column 0) as the scalar metric stream
+    (rounds.split_metric_columns). metric='time' returns steady-state
+    per-client inference latency in seconds — the vectorized counterpart
+    of reference evaluator.py:99-108.
 
     fused: 'off' uses the flax apply; 'auto'/'pallas'/'xla' route the forward
     through the single-kernel fused path (ops/pallas_ae.py) — same math, one
@@ -69,8 +73,8 @@ def make_evaluate_all(model, model_type: str, metric: str = "AUC",
         scores = jnp.nan_to_num(scores)  # evaluator.py:24-25 nan_to_num guard
         if metric == "AUC":
             return roc_auc(test_y, scores, test_m)
-        f1, _, _ = classification_metrics(test_y, scores, test_m)
-        return f1
+        f1, precision, recall = classification_metrics(test_y, scores, test_m)
+        return jnp.stack([f1, precision, recall])
 
     if metric == "time":
         # Latency is a host-side measurement, so this path cannot live inside

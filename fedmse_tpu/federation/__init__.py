@@ -5,9 +5,11 @@ from fedmse_tpu.federation.attack import AttackSpec, make_poison_fn, poison_para
 from fedmse_tpu.federation.voting import elect_aggregator, make_mse_scores_fn
 from fedmse_tpu.federation.verification import make_verify_fn
 from fedmse_tpu.federation.rounds import RoundEngine, RoundResult
+from fedmse_tpu.federation.batched import BatchedRunEngine
 
 __all__ = [
     "AttackSpec",
+    "BatchedRunEngine",
     "ClientStates",
     "RoundEngine",
     "RoundResult",

@@ -1,0 +1,209 @@
+"""Batched multi-run execution: R independent federations as ONE program.
+
+The sweep driver runs the `num_runs` seeds of every (model_type, update_type)
+combination one after another (main.py:run_experiment), so at quick-run model
+size — ~6.8k params, wall-clock dominated by program launches, not FLOPs —
+R runs cost R times the dispatch overhead of one. `BatchedRunEngine` stacks
+the R federations on a leading `runs` axis (state.init_batched_client_states)
+and scans the fused round body vmapped over that axis
+(fused.make_batched_runs_scan): one XLA dispatch advances every run by a
+whole chunk of rounds, and the per-client matmuls batch [R·N·B, D] rows into
+single MXU calls. Same lever as client-parallel training (DESIGN.md §1),
+applied one level up the sweep.
+
+Per-run independence is preserved exactly:
+  * host streams — every run keeps its OWN `ExperimentRngs`: selection draws
+    come from run r's `select_rng` in round order, round keys from run r's
+    `fold_in` stream (seeding.batched_run_keys), both bit-identical to the
+    sequential driver's draws;
+  * device state — params, optimizer, verification history, rejected
+    counters and aggregation quota all carry the leading [R] axis; vmap
+    lanes cannot interact;
+  * elections — first-voter-wins with quota runs per run inside the vmap
+    (the `lax.while_loop` batches over lanes);
+  * global early stopping — evaluated per run BY THE DRIVER from the stacked
+    per-round outputs, carried into the program as a per-round [K, R] active
+    MASK: a stopped run's lane keeps executing (lanes are lockstep) but its
+    states and quota freeze, so its final state matches a sequential run
+    that broke out of the loop (see make_batched_runs_scan docstring for
+    the mid-chunk rewind protocol).
+
+Sequential mode stays the default and the correctness oracle: batched R runs
+must reproduce R sequential runs' per-run metric streams, election outcomes
+and early-stop rounds (tests/test_batched_runs.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedmse_tpu.config import ExperimentConfig
+from fedmse_tpu.data.stacking import FederatedData
+from fedmse_tpu.federation.rounds import (RoundResult, _PROGRAM_CACHE,
+                                          _cache_put, _client_axis_is_sharded,
+                                          _engine_programs, absorb_fused_out,
+                                          verification_tensors)
+from fedmse_tpu.federation.state import (HostState, init_batched_client_states)
+from fedmse_tpu.parallel.mesh import host_fetch
+from fedmse_tpu.utils.seeding import batched_run_keys, make_run_rngs
+
+
+class BatchedRunEngine:
+    """R seeds of one (model_type, update_type) federation, runs-axis batched.
+
+    The public surface mirrors RoundEngine's schedule path at the run level:
+    `run_schedule_chunk` advances every ACTIVE run by k rounds in one
+    dispatch and returns the raw per-(round, run) output bundles;
+    `process_round` turns one valid (round, run) slice into the same
+    RoundResult (and host-counter updates) the sequential engine produces.
+    The split matters for early stopping: the driver must decide each run's
+    stop round BEFORE host counters absorb post-stop rounds, so absorption
+    is driven from the host loop, not baked into the dispatch.
+    """
+
+    def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
+                 n_real: int, runs: int, model_type: str, update_type: str,
+                 poison_fn=None):
+        if cfg.metric == "time":
+            raise ValueError(
+                "metric='time' is host-side wall-clock and cannot be traced "
+                "into the batched-runs program; use sequential mode")
+        self.model = model
+        self.cfg = cfg
+        self.data = data
+        self.n_real = n_real
+        self.n_pad = data.num_clients_padded
+        self.runs = runs
+        self.model_type = model_type
+        self.update_type = update_type
+        self.poison_fn = poison_fn
+
+        programs = _engine_programs(model, cfg, model_type, update_type)
+        self.tx = programs["tx"]
+        self._builder_args = (programs["train_all"], programs["scores_fn"],
+                              programs["aggregate"], programs["verify"],
+                              programs["evaluate_all"],
+                              cfg.max_aggregation_threshold)
+        self.evaluate_all = programs["evaluate_all"]
+        self._ver_x, self._ver_m = verification_tensors(cfg, data, n_real,
+                                                        self.n_pad)
+        self._scan = None
+        self._scan_compact = None
+        self.reset_federation()
+
+    def reset_federation(self) -> None:
+        """Fresh RNG streams, client states and host counters for all runs;
+        compiled programs are reused (the runs-axis analog of
+        RoundEngine.reset_federation)."""
+        self.rngs = make_run_rngs(self.runs, data_seed=self.cfg.data_seed,
+                                  run_seed_stride=self.cfg.run_seed_stride)
+        init_keys = batched_run_keys(self.rngs, 1)[0]
+        self.states = init_batched_client_states(self.model, self.tx,
+                                                 init_keys, self.n_pad)
+        self.host = [HostState.create(self.n_real) for _ in range(self.runs)]
+
+    @property
+    def compact(self) -> bool:
+        """Same policy as RoundEngine.compact: compact-cohort gathers stay on
+        unless the client axis is sharded (batched mode is single-mesh only,
+        so in practice this is just the config switch)."""
+        if not self.cfg.compact_cohort:
+            return False
+        return not _client_axis_is_sharded(self.data.train_xb)
+
+    def _build(self) -> None:
+        from fedmse_tpu.federation.fused import make_batched_runs_scan
+        self._scan_compact = self.compact
+        args = self._builder_args + (self._scan_compact, self.poison_fn)
+        key = ("batched_runs",) + args[:-1]
+        if self.poison_fn is None and key in _PROGRAM_CACHE:
+            self._scan = _PROGRAM_CACHE[key]
+            return
+        self._scan = make_batched_runs_scan(*args)
+        if self.poison_fn is None:
+            _cache_put(key, self._scan)
+
+    def select_clients(self, run: int) -> List[int]:
+        """⌈ratio·N⌉ clients from run r's own host stream — draw order per
+        stream matches the sequential driver's exactly."""
+        n_sel = max(1, int(self.cfg.num_participants * self.n_real))
+        return self.rngs[run].select_rng.sample(range(self.n_real), n_sel)
+
+    def _agg_count(self) -> jnp.ndarray:
+        stacked = np.stack([np.pad(h.aggregation_count,
+                                   (0, self.n_pad - self.n_real))
+                            for h in self.host]).astype(np.int32)
+        return jnp.asarray(stacked)
+
+    def run_schedule_chunk(self, start_round: int, k: int,
+                           active: np.ndarray,
+                           schedule: Optional[list] = None,
+                           keys: Optional[jax.Array] = None,
+                           active_rounds: Optional[np.ndarray] = None,
+                           agg_count: Optional[jnp.ndarray] = None):
+        """k rounds × R runs in ONE dispatch.
+
+        `active` [R] bool marks runs whose early stop has not fired; their
+        lanes advance, the rest stay frozen. Returns (outs, schedule, keys):
+        the host-fetched FusedRoundOut stacked on leading [k, R] axes plus
+        the selections/keys that produced it, so the driver can REPLAY the
+        chunk after a mid-chunk stop — same `schedule`/`keys`, a tighter
+        `active_rounds` [k, R], and the chunk-ENTRY `agg_count` (the host
+        counters have absorbed the chunk's valid rounds by replay time, and
+        feeding post-chunk quota into the replay would change elections).
+        Selections and keys are drawn from each run's own streams in round
+        order — stream-identical to k successive sequential-driver rounds
+        per run; on a replay nothing new is drawn.
+        """
+        if self._scan is None or self._scan_compact != self.compact:
+            self._build()
+        if schedule is None:
+            schedule = [[self.select_clients(r) for r in range(self.runs)]
+                        for _ in range(k)]
+            keys = batched_run_keys(self.rngs, k)
+        if active_rounds is None:
+            active_rounds = np.broadcast_to(np.asarray(active, bool),
+                                            (k, self.runs))
+        if agg_count is None:
+            agg_count = self._agg_count()
+        sel_idx = np.asarray(schedule, dtype=np.int32)       # [k, R, S]
+        masks = np.zeros((k, self.runs, self.n_pad), np.float32)
+        for i in range(k):
+            for r in range(self.runs):
+                masks[i, r, schedule[i][r]] = 1.0
+        self.states, _, outs = self._scan(
+            self.states, self.data, self._ver_x, self._ver_m,
+            jnp.asarray(sel_idx), jnp.asarray(masks), agg_count,
+            keys, jnp.arange(start_round, start_round + k, dtype=jnp.int32),
+            jnp.asarray(np.ascontiguousarray(active_rounds)))
+        return host_fetch(outs), schedule, keys
+
+    def process_round(self, run: int, round_index: int, selected: List[int],
+                      outs, chunk_pos: int) -> RoundResult:
+        """One valid (round, run) entry of a chunk's stacked outputs →
+        RoundResult + run r's host-counter updates. The driver calls this
+        only for rounds at or before run r's stop round, so post-stop lanes
+        never pollute the host counters."""
+        out_slice = jax.tree.map(lambda t: t[chunk_pos, run], outs)
+        return absorb_fused_out(out_slice, round_index, selected, self.n_real,
+                                self.host[run],
+                                self.cfg.max_rejected_updates)
+
+    def evaluate_final(self) -> np.ndarray:
+        """[R, n_real] (or [R, n_real, 3] for classification) final metrics —
+        all runs evaluated in one dispatch on their (frozen) final states."""
+        d = self.data
+        fn = jax.vmap(self.evaluate_all,
+                      in_axes=(0, None, None, None, None, None))
+        metrics = np.asarray(host_fetch(fn(
+            self.states.params, d.test_x, d.test_m, d.test_y,
+            d.train_xb, d.train_mb)))
+        return metrics[:, : self.n_real]
+
+    def run_params(self, run: int):
+        """Run r's stacked [N, ...] params (host copy) for artifact saving."""
+        return host_fetch(jax.tree.map(lambda t: t[run], self.states.params))

@@ -41,14 +41,15 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from fedmse_tpu.federation.state import ClientStates
+from fedmse_tpu.federation.state import ClientStates, tree_select_clients
 
 
 class FusedRoundOut(NamedTuple):
     """Per-round result bundle (everything the host logs, nothing more)."""
 
     aggregator: jax.Array    # i32 scalar, -1 = no aggregator found
-    metrics: jax.Array       # [N] per-client eval metric
+    metrics: jax.Array       # [N] per-client eval metric ([N, 3] f1/prec/rec
+                             # when metric='classification')
     scores: jax.Array        # [N] winning voter's MSE scores (0 if no winner)
     weights: jax.Array       # [N] aggregation weights (0 if no aggregation)
     rejected: jax.Array      # [N] i32 consecutive rejected updates
@@ -217,6 +218,66 @@ def make_fused_rounds_scan(*args) -> Callable:
         (states, agg_count), outs = jax.lax.scan(
             step, (states, agg_count),
             (sel_schedule, sel_masks, keys, round_indices))
+        return states, agg_count, outs
+
+    return run_all
+
+
+def make_batched_runs_scan(*args) -> Callable:
+    """Build the batched-runs whole-schedule runner: the round body vmapped
+    over a leading `runs` axis, scanned over a per-run selection schedule.
+
+    fn(states [R, N, ...], data, ver_x, ver_m, sel_schedule [K, R, S],
+       sel_masks [K, R, N], agg_count [R, N], keys [K, R],
+       round_indices [K], active [K, R])
+      -> (states, agg_count, FusedRoundOut stacked on leading [K, R] axes)
+
+    R independent federations — each with its own PRNG stream, client
+    states, selection masks, elections and quota counters — execute as ONE
+    XLA program: under the run vmap the per-client matmuls batch
+    [R·N·B, D] rows into single MXU calls, so R seeds of a combination
+    cost roughly one seed's dispatches (the engine is dispatch-bound at
+    this model size — DESIGN.md §7).
+
+    `active` is per-run global early stopping carried as a MASK instead of
+    host control flow: a run whose stop fired keeps executing (vmap lanes
+    are lockstep; XLA cannot skip a lane) but its states and quota counters
+    pass through unchanged, so its federation is FROZEN at the stop round
+    and the final states match a sequential run that broke out of the loop.
+    The driver evaluates the stop criterion per run from the stacked
+    outputs between chunks; a stop at a non-final round of a chunk rewinds
+    to the chunk-entry snapshot and replays with the per-round `active`
+    matrix rebuilt from the now-known stop rounds
+    (main.py:run_batched_combination). Frozen lanes cannot influence live
+    lanes (vmap lanes are independent), so replayed live-lane outputs are
+    identical to the first pass and the host keeps its first-pass
+    bookkeeping.
+    """
+    round_body = make_round_body(*args)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run_all(states: ClientStates, data, ver_x, ver_m, sel_schedule,
+                sel_masks, agg_count, keys, round_indices, active):
+        def one_run(run_states, sel_indices, sel_mask, count, key,
+                    round_index):
+            return round_body(run_states, data, ver_x, ver_m, sel_indices,
+                              sel_mask, count, key, round_index)
+
+        def step(carry, xs):
+            states, agg_count = carry
+            sel_indices, sel_mask, key, round_index, act = xs
+            new_states, new_count, out = jax.vmap(
+                one_run, in_axes=(0, 0, 0, 0, 0, None))(
+                    states, sel_indices, sel_mask, agg_count, key,
+                    round_index)
+            # early stop as a mask: stopped runs' federations are frozen
+            states = tree_select_clients(act, new_states, states)
+            agg_count = jnp.where(act[:, None], new_count, agg_count)
+            return (states, agg_count), out
+
+        (states, agg_count), outs = jax.lax.scan(
+            step, (states, agg_count),
+            (sel_schedule, sel_masks, keys, round_indices, active))
         return states, agg_count, outs
 
     return run_all

@@ -52,12 +52,25 @@ class RoundResult:
     round_index: int
     selected: List[int]
     aggregator: Optional[int]
-    client_metrics: np.ndarray          # [n_real]
+    client_metrics: np.ndarray          # [n_real] (f1 when metric='classification')
     verification_results: List[Dict]    # reference verification_results.json rows
     mse_scores: Optional[np.ndarray]    # winning voter's scores (or None)
     agg_weights: Optional[np.ndarray]   # aggregation weights [N_padded]
     tracking: np.ndarray                # [n_real, E, 3] train/valid loss curves
     min_valid: np.ndarray               # [n_real] best local valid loss
+    metrics_full: Optional[np.ndarray] = None  # [n_real, 3] f1/precision/recall
+                                               # (metric='classification' only)
+
+
+def split_metric_columns(metrics: np.ndarray):
+    """(client_metrics [n], metrics_full) from an evaluator output that is
+    either [n] (AUC; classification pre-triple) or [n, 3] f1/precision/recall
+    (evaluation/evaluator.py make_evaluate_all, metric='classification').
+    The scalar stream stays f1 — what the reference logs, early-stops on and
+    writes to the round artifacts — while the full triple rides alongside."""
+    if metrics.ndim == 2:
+        return metrics[:, 0], metrics
+    return metrics, None
 
 
 # Program cache: building an engine's jitted callables (train/scores/
@@ -113,6 +126,71 @@ def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
     }
     _cache_put(key, programs)
     return programs
+
+
+def verification_tensors(cfg: ExperimentConfig, data: FederatedData,
+                         n_real: int, n_pad: int):
+    """Per-client verification data [N, V, D] / [N, V] (see verification.py
+    module docstring for the quirk-6 semantics). Shared by RoundEngine and
+    BatchedRunEngine — verification data is data-derived and run-independent,
+    so batched runs pass ONE copy with the runs axis unmapped."""
+    if cfg.verification_method == "dev":
+        ver_x = jnp.broadcast_to(data.dev_x, (n_pad,) + data.dev_x.shape)
+        ver_m = jnp.ones((n_pad, data.dev_x.shape[0]), jnp.float32)
+    elif cfg.compat.shared_last_client_val:
+        # quirk 6: every client verifies on the LAST real client's valid
+        # split (src/main.py:264)
+        last = n_real - 1
+        ver_x = jnp.broadcast_to(data.valid_x[last],
+                                 (n_pad,) + data.valid_x[last].shape)
+        ver_m = jnp.broadcast_to(data.valid_m[last],
+                                 (n_pad,) + data.valid_m[last].shape)
+    else:
+        ver_x, ver_m = data.valid_x, data.valid_m
+    return ver_x, ver_m
+
+
+def absorb_fused_out(out, round_index: int, selected: List[int], n_real: int,
+                     host: HostState, max_rejected_updates: int) -> RoundResult:
+    """Host bookkeeping + RoundResult from ONE host-fetched FusedRoundOut
+    bundle: quota/vote counters, reference verification rows, attack
+    flagging. Shared by the per-run fused path (RoundEngine._fused_result)
+    and the batched-runs path (each run's slice of the stacked outputs —
+    federation/batched.py)."""
+    aggregator = int(out.aggregator)
+    rejected = np.asarray(out.rejected)
+    verification_rows: List[Dict] = []
+    if aggregator >= 0:
+        host.aggregation_count[aggregator] += 1
+        host.votes_received[aggregator] += 1
+        host.rounds_aggregated.append((round_index, aggregator))
+        for i in range(n_real):
+            if i != aggregator:
+                verification_rows.append({
+                    "client_id": i,
+                    "rejected_updates": int(rejected[i]),
+                    "is_verified": bool(rejected[i] == 0),
+                })
+                if rejected[i] >= max_rejected_updates:
+                    logger.error("[Client %d] Too many rejected updates. "
+                                 "Possible attack detected.", i)
+    else:
+        logger.warning("No aggregator selected for round %d", round_index)
+    metrics, metrics_full = split_metric_columns(
+        np.asarray(out.metrics)[:n_real])
+    return RoundResult(
+        round_index=round_index,
+        selected=list(selected),
+        aggregator=None if aggregator < 0 else aggregator,
+        client_metrics=metrics,
+        verification_results=verification_rows,
+        mse_scores=(None if aggregator < 0
+                    else np.asarray(out.scores)[:n_real]),
+        agg_weights=(None if aggregator < 0 else np.asarray(out.weights)),
+        tracking=np.asarray(out.tracking)[:n_real],
+        min_valid=np.asarray(out.min_valid)[:n_real],
+        metrics_full=metrics_full,
+    )
 
 
 def _client_axis_is_sharded(arr) -> bool:
@@ -225,21 +303,8 @@ class RoundEngine:
     # ------------------------------------------------------------------ #
 
     def _verification_tensors(self):
-        """Per-client verification data [N, V, D] / [N, V] (see
-        verification.py module docstring for the quirk-6 semantics)."""
-        d = self.data
-        if self.cfg.verification_method == "dev":
-            ver_x = jnp.broadcast_to(d.dev_x, (self.n_pad,) + d.dev_x.shape)
-            ver_m = jnp.ones((self.n_pad, d.dev_x.shape[0]), jnp.float32)
-        elif self.cfg.compat.shared_last_client_val:
-            # quirk 6: every client verifies on the LAST real client's valid
-            # split (src/main.py:264)
-            last = self.n_real - 1
-            ver_x = jnp.broadcast_to(d.valid_x[last], (self.n_pad,) + d.valid_x[last].shape)
-            ver_m = jnp.broadcast_to(d.valid_m[last], (self.n_pad,) + d.valid_m[last].shape)
-        else:
-            ver_x, ver_m = d.valid_x, d.valid_m
-        return ver_x, ver_m
+        return verification_tensors(self.cfg, self.data, self.n_real,
+                                    self.n_pad)
 
     def select_clients(self) -> List[int]:
         """⌈ratio·N⌉ clients via host RNG (src/main.py:270-273)."""
@@ -254,37 +319,8 @@ class RoundEngine:
                       out) -> RoundResult:
         """Host bookkeeping + RoundResult from a FusedRoundOut bundle."""
         out = host_fetch(out)  # multi-process-safe (parallel/mesh.py)
-        aggregator = int(out.aggregator)
-        rejected = np.asarray(out.rejected)
-        verification_rows: List[Dict] = []
-        if aggregator >= 0:
-            self.host.aggregation_count[aggregator] += 1
-            self.host.votes_received[aggregator] += 1
-            self.host.rounds_aggregated.append((round_index, aggregator))
-            for i in range(self.n_real):
-                if i != aggregator:
-                    verification_rows.append({
-                        "client_id": i,
-                        "rejected_updates": int(rejected[i]),
-                        "is_verified": bool(rejected[i] == 0),
-                    })
-                    if rejected[i] >= self.cfg.max_rejected_updates:
-                        logger.error("[Client %d] Too many rejected updates. "
-                                     "Possible attack detected.", i)
-        else:
-            logger.warning("No aggregator selected for round %d", round_index)
-        return RoundResult(
-            round_index=round_index,
-            selected=list(selected),
-            aggregator=None if aggregator < 0 else aggregator,
-            client_metrics=np.asarray(out.metrics)[: self.n_real],
-            verification_results=verification_rows,
-            mse_scores=(None if aggregator < 0
-                        else np.asarray(out.scores)[: self.n_real]),
-            agg_weights=(None if aggregator < 0 else np.asarray(out.weights)),
-            tracking=np.asarray(out.tracking)[: self.n_real],
-            min_valid=np.asarray(out.min_valid)[: self.n_real],
-        )
+        return absorb_fused_out(out, round_index, selected, self.n_real,
+                                self.host, self.cfg.max_rejected_updates)
 
     def _selection_arrays(self, selected: List[int]):
         sel_mask = np.zeros(self.n_pad, dtype=np.float32)
@@ -441,15 +477,17 @@ class RoundEngine:
 
         # ---- evaluation of every client (src/main.py:333-339) ----
         with self.timer.phase("evaluate"):
-            metrics = np.asarray(host_fetch(self.evaluate_all(
-                self.states.params, data.test_x, data.test_m, data.test_y,
-                data.train_xb, data.train_mb)))[: self.n_real]
+            metrics, metrics_full = split_metric_columns(
+                np.asarray(host_fetch(self.evaluate_all(
+                    self.states.params, data.test_x, data.test_m, data.test_y,
+                    data.train_xb, data.train_mb)))[: self.n_real])
 
         return RoundResult(
             round_index=round_index,
             selected=list(selected),
             aggregator=aggregator,
             client_metrics=metrics,
+            metrics_full=metrics_full,
             verification_results=verification_rows,
             mse_scores=None if scores is None else np.asarray(scores)[: self.n_real],
             agg_weights=agg_weights,

@@ -95,6 +95,33 @@ def init_client_states(model, tx: optax.GradientTransformation,
     )
 
 
+def init_batched_client_states(model, tx: optax.GradientTransformation,
+                               run_keys: jax.Array,
+                               n_clients: int) -> ClientStates:
+    """R independent federations stacked on a leading `runs` axis: every leaf
+    is [R, N, ...], and slice r is bitwise what `init_client_states` builds
+    from `run_keys[r]` (the vmap below performs the identical key splits and
+    init draws per run). This is the state layer of batched multi-run
+    execution (federation/batched.py): all R seeds of a (model_type,
+    update_type) combination move through the fused schedule as ONE pytree."""
+    from fedmse_tpu.models.autoencoder import init_stacked_params
+
+    params = jax.vmap(lambda k: init_stacked_params(model, k, n_clients))(
+        run_keys)
+    opt_state = jax.vmap(jax.vmap(tx.init))(params)
+    runs = len(run_keys)
+    zeros_like_params = jax.tree.map(jnp.zeros_like, params)
+    return ClientStates(
+        params=params,
+        opt_state=opt_state,
+        prev_global=jax.tree.map(lambda t: t.copy(), params),
+        hist_params=zeros_like_params,
+        hist_perf=jnp.zeros((runs, n_clients), dtype=jnp.float32),
+        hist_seen=jnp.zeros((runs, n_clients), dtype=bool),
+        rejected=jnp.zeros((runs, n_clients), dtype=jnp.int32),
+    )
+
+
 def tree_select(cond: jax.Array, a, b):
     """Elementwise pytree select on a scalar (or broadcastable) condition."""
     return jax.tree.map(lambda x, y: jnp.where(cond, x, y), a, b)

@@ -91,6 +91,22 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
         own verification score by 0.1 has, by the only oracle this
         scheme has ever had (reference model_verifier.py:86-99), earned
         the replacement it amounts to.
+
+    CAVEAT — recovery waiver × compat.shared_last_client_val (ADVICE r5):
+    the recovery waiver's oracle is only as private as the verification
+    tensor it scores on. Under the default quirk-6 compat every client
+    verifies on the LAST client's valid split — a tensor a malicious
+    aggregator also holds — so the attacker can CRAFT a broadcast that
+    genuinely scores +`recovery_threshold` on that shared tensor (easiest
+    early in training, while own models are weakly trained) and collect
+    an unbounded parameter step from every client at once. With
+    per-client verification data (shared_last_client_val=False, or
+    verification_method='val' fixed mode) the attacker must clear the
+    margin on N unseen tensors simultaneously, which restores the
+    waiver's intent. Deploy hardened=True together with per-client
+    verification data; if the shared-tensor quirk must stay on, consider
+    a delta ceiling even on the recovery path.
+
     History/rejected bookkeeping is unchanged, so flag semantics
     (rejected >= 3 => possible attack) carry over.
     """

@@ -40,6 +40,7 @@ from fedmse_tpu.checkpointing import (CheckpointManager, ResultsWriter,
                                       save_training_tracking)
 from fedmse_tpu.data import build_dev_dataset, prepare_clients, stack_clients
 from fedmse_tpu.federation import RoundEngine
+from fedmse_tpu.federation.rounds import split_metric_columns
 from fedmse_tpu.models import make_model
 from fedmse_tpu.parallel import (client_mesh, host_fetch, pad_to_multiple,
                                  shard_federation, uniform_decision)
@@ -89,6 +90,25 @@ def prepare_federation(cfg: ExperimentConfig, dataset: DatasetConfig,
     return clients, data, n_real
 
 
+def _save_hybrid_latents(cfg: ExperimentConfig, model, stacked_params, data,
+                         n_real: int, run: int, update_type: str) -> None:
+    """LatentData pickles for the latent t-SNE notebook parity (the
+    reference reads these but never writes them — SURVEY §2 #10)."""
+    from fedmse_tpu.visualization import save_latent_data
+    latents = host_fetch(jax.jit(jax.vmap(
+        lambda p, x: model.apply({"params": p}, x)[0]))(
+            stacked_params, data.test_x))
+    mask = np.asarray(host_fetch(data.test_m)) > 0
+    labels = np.asarray(host_fetch(data.test_y))
+    lat = np.concatenate([latents[i][mask[i]] for i in range(n_real)])
+    lab = np.concatenate([labels[i][mask[i]] for i in range(n_real)])
+    save_latent_data(
+        os.path.join(cfg.checkpoint_dir, "LatentData",
+                     str(cfg.network_size), cfg.experiment_name,
+                     f"Run_{run}"),
+        update_type, lat, lab)
+
+
 def run_combination(cfg: ExperimentConfig, data, n_real: int,
                     model_type: str, update_type: str, run: int,
                     writer: Optional[ResultsWriter] = None,
@@ -129,7 +149,8 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     if resume is not None and resume.exists(tag):
         engine.states, engine.host, start_round, prev_tracking = \
             resume.restore(tag, engine.states, expected_extra={
-                "flatten_optimizer": cfg.flatten_optimizer})
+                "flatten_optimizer": cfg.flatten_optimizer},
+                extra_defaults={"flatten_optimizer": False})
         if prev_tracking is not None:  # keep the pre-kill part of the curve
             all_tracking.append(prev_tracking)
         logger.info("resumed %s at round %d", tag, start_round)
@@ -216,11 +237,14 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
             if fired:
                 break
 
-    # final evaluation over every client (src/main.py:368-374)
-    final_metrics = np.asarray(host_fetch(engine.evaluate_all(
-        engine.states.params, engine.data.test_x, engine.data.test_m,
-        engine.data.test_y, engine.data.train_xb,
-        engine.data.train_mb)))[:n_real]
+    # final evaluation over every client (src/main.py:368-374); for
+    # metric='classification' the scalar stream is f1 and the full
+    # f1/precision/recall triple rides in final_metrics_full
+    final_metrics, final_metrics_full = split_metric_columns(
+        np.asarray(host_fetch(engine.evaluate_all(
+            engine.states.params, engine.data.test_x, engine.data.test_m,
+            engine.data.test_y, engine.data.train_xb,
+            engine.data.train_mb)))[:n_real])
 
     if writer is not None and save_checkpoints and device_names:
         save_client_models(writer, run, model_type, update_type, device_names,
@@ -232,23 +256,10 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
                                    device_names,
                                    np.concatenate(all_tracking, axis=1))
         if model_type == "hybrid":
-            # LatentData pickles for the latent t-SNE notebook parity
-            # (the reference reads these but never writes them — SURVEY §2 #10)
-            from fedmse_tpu.visualization import save_latent_data
-            latents = host_fetch(jax.jit(jax.vmap(
-                lambda p, x: model.apply({"params": p}, x)[0]))(
-                    engine.states.params, engine.data.test_x))
-            mask = np.asarray(host_fetch(engine.data.test_m)) > 0
-            labels = np.asarray(host_fetch(engine.data.test_y))
-            lat = np.concatenate([latents[i][mask[i]] for i in range(n_real)])
-            lab = np.concatenate([labels[i][mask[i]] for i in range(n_real)])
-            save_latent_data(
-                os.path.join(cfg.checkpoint_dir, "LatentData",
-                             str(cfg.network_size), cfg.experiment_name,
-                             f"Run_{run}"),
-                update_type, lat, lab)
+            _save_hybrid_latents(cfg, model, engine.states.params,
+                                 engine.data, n_real, run, update_type)
 
-    return {
+    out = {
         "final_metrics": final_metrics,
         "best_final": float(np.nanmax(final_metrics)),
         "round_times": round_times,
@@ -256,13 +267,146 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
         "aggregation_count": engine.host.aggregation_count.tolist(),
         "votes_received": engine.host.votes_received.tolist(),
     }
+    if final_metrics_full is not None:
+        out["final_metrics_full"] = final_metrics_full
+    return out
+
+
+def run_batched_combination(cfg: ExperimentConfig, data, n_real: int,
+                            model_type: str, update_type: str,
+                            writer: Optional[ResultsWriter] = None,
+                            device_names: Optional[List[str]] = None,
+                            save_checkpoints: bool = False,
+                            attack=None) -> List[Dict]:
+    """All `cfg.num_runs` seeds of one (model_type, update_type) as ONE
+    runs-axis-batched program (federation/batched.py): R federations advance
+    chunk-by-chunk in single XLA dispatches, and the per-run results are
+    UNBATCHED into the exact artifacts the sequential driver writes — round
+    JSON-lines, verification rows, per-client models, training_tracking.pkl
+    — so the checkpoint/JSON layout is unchanged.
+
+    Global early stopping runs per run on the host, exactly as the
+    sequential loop evaluates it per round, but carried into the device
+    program as a freeze mask: a run whose stop fires at a non-final round
+    of a chunk triggers ONE rewind-and-replay dispatch with the per-round
+    active matrix rebuilt from the known stop rounds (states restored to
+    the chunk-entry snapshot; chunk-entry quota fed back in), which leaves
+    every run's final state identical to a sequential run that broke out
+    of its loop. Early-stop STATE is per run: the reference's
+    cross-combination shared-state quirk (compat.global_early_stop_state_
+    shared) cannot couple runs that execute simultaneously — the caller
+    warns and sequential mode remains the oracle for that quirk.
+
+    Returns one result dict per run, shaped like run_combination's."""
+    from fedmse_tpu.federation.batched import BatchedRunEngine
+
+    runs = cfg.num_runs
+    model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
+                       cfg.latent_dim, cfg.shrink_lambda)
+    poison_fn = None
+    if attack is not None:
+        from fedmse_tpu.federation.attack import make_poison_fn
+        poison_fn = make_poison_fn(attack)
+    engine = BatchedRunEngine(model, cfg, data, n_real=n_real, runs=runs,
+                              model_type=model_type, update_type=update_type,
+                              poison_fn=poison_fn)
+    early = [GlobalEarlyStop(inverted=cfg.compat.inverted_global_early_stop,
+                             patience=cfg.global_patience)
+             for _ in range(runs)]
+    round_times: List[List[float]] = [[] for _ in range(runs)]
+    all_tracking: List[List[np.ndarray]] = [[] for _ in range(runs)]
+    stopped = [False] * runs
+
+    round_index = 0
+    while round_index < cfg.num_rounds and not all(stopped):
+        k = min(cfg.fused_schedule_chunk, cfg.num_rounds - round_index)
+        active = np.asarray([not s for s in stopped])
+        # scan donates states; snapshot (on-device copy) + chunk-entry quota
+        # so a mid-chunk stop can rewind and replay with freeze masks
+        snap_states = jax.tree.map(jnp.copy, engine.states)
+        entry_agg = engine._agg_count()
+        t0 = time.time()
+        outs, schedule, keys = engine.run_schedule_chunk(round_index, k,
+                                                         active)
+        sec = (time.time() - t0) / k
+        stop_pos: List[Optional[int]] = [None] * runs
+        for i in range(k):
+            for r in range(runs):
+                if stopped[r] or stop_pos[r] is not None:
+                    continue  # post-stop lanes never reach the host books
+                result = engine.process_round(r, round_index + i,
+                                              schedule[i][r], outs, i)
+                round_times[r].append(sec)
+                all_tracking[r].append(result.tracking)
+                logger.info(
+                    "[%s/%s run %d] round %d: agg=%s mean %s=%.4f (%.2fs)",
+                    model_type, update_type, r, result.round_index + 1,
+                    result.aggregator, cfg.metric,
+                    float(np.nanmean(result.client_metrics)), sec)
+                if writer is not None:
+                    writer.append_round_metrics(r, result.round_index,
+                                                result.client_metrics,
+                                                model_type, update_type)
+                    writer.append_verification(r, result.round_index,
+                                               result.verification_results)
+                if uniform_decision(
+                        early[r].should_stop(result.client_metrics)):
+                    logger.info("Early stopping in global round!")
+                    stop_pos[r] = i
+        if any(p is not None and p < k - 1 for p in stop_pos):
+            # mid-chunk stop: rewind device states and replay the chunk with
+            # the per-round freeze matrix so stopped runs end at their stop
+            # round; live lanes recompute identical results (discarded)
+            engine.states = snap_states
+            act2 = np.zeros((k, runs), dtype=bool)
+            for i in range(k):
+                for r in range(runs):
+                    act2[i, r] = active[r] and (stop_pos[r] is None
+                                                or i <= stop_pos[r])
+            engine.run_schedule_chunk(round_index, k, active,
+                                      schedule=schedule, keys=keys,
+                                      active_rounds=act2,
+                                      agg_count=entry_agg)
+        for r in range(runs):
+            if stop_pos[r] is not None:
+                stopped[r] = True
+        round_index += k
+
+    # final evaluation: all runs in one dispatch on their frozen states
+    finals = engine.evaluate_final()
+    results: List[Dict] = []
+    for r in range(runs):
+        final_metrics, final_metrics_full = split_metric_columns(finals[r])
+        if writer is not None and save_checkpoints and device_names:
+            params_r = engine.run_params(r)
+            save_client_models(writer, r, model_type, update_type,
+                               device_names, params_r)
+            if all_tracking[r]:
+                save_training_tracking(
+                    writer, r, model_type, update_type, device_names,
+                    np.concatenate(all_tracking[r], axis=1))
+            if model_type == "hybrid":
+                _save_hybrid_latents(cfg, model, params_r, data, n_real, r,
+                                     update_type)
+        out = {
+            "final_metrics": final_metrics,
+            "best_final": float(np.nanmax(final_metrics)),
+            "round_times": round_times[r],
+            "rounds_run": len(round_times[r]),
+            "aggregation_count": engine.host[r].aggregation_count.tolist(),
+            "votes_received": engine.host[r].votes_received.tolist(),
+        }
+        if final_metrics_full is not None:
+            out["final_metrics_full"] = final_metrics_full
+        results.append(out)
+    return results
 
 
 def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                    use_mesh: bool = False,
                    save_checkpoints: bool = True,
                    resume_dir: Optional[str] = None,
-                   attack=None) -> Dict:
+                   attack=None, batch_runs: bool = False) -> Dict:
     """The full sweep (src/main.py:108-399) -> training summary dict."""
     mesh = None
     pad_multiple = None
@@ -282,11 +426,49 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
         inverted=cfg.compat.inverted_global_early_stop,
         patience=cfg.global_patience)
 
+    if batch_runs:
+        # batched runs require the single-mesh fused-schedule path; anything
+        # that breaks a precondition falls back to the sequential oracle
+        reasons = []
+        if mesh is not None:
+            reasons.append("--use-mesh (client axis is device-sharded)")
+        if resume is not None:
+            reasons.append("--resume-dir (per-chunk resume is per-run)")
+        if cfg.metric == "time":
+            reasons.append("metric='time' (host-side wall clock)")
+        if not (cfg.fused_rounds and cfg.fused_schedule):
+            reasons.append("fused_rounds/fused_schedule disabled")
+        if reasons:
+            logger.warning("--batch-runs disabled (%s); running runs "
+                           "sequentially", "; ".join(reasons))
+            batch_runs = False
+        elif cfg.compat.global_early_stop_state_shared:
+            logger.warning(
+                "--batch-runs: global early-stop state is per run — the "
+                "reference's shared-state quirk "
+                "(compat.global_early_stop_state_shared) cannot couple runs "
+                "that execute simultaneously; sequential mode remains the "
+                "oracle for that quirk")
+
     best_metrics = {mt: {ut: float("-inf") for ut in cfg.update_types}
                     for mt in cfg.model_types}
     all_results = {}
     for model_type in cfg.model_types:
         for update_type in cfg.update_types:
+            if batch_runs:
+                run_outs = run_batched_combination(
+                    cfg, data, n_real, model_type, update_type,
+                    writer=writer, device_names=device_names,
+                    save_checkpoints=save_checkpoints, attack=attack)
+                for run, out in enumerate(run_outs):
+                    best_metrics[model_type][update_type] = max(
+                        best_metrics[model_type][update_type],
+                        out["best_final"])
+                    all_results[f"{model_type}/{update_type}/run{run}"] = {
+                        "final_metrics": out["final_metrics"].tolist(),
+                        "round_times": out["round_times"],
+                    }
+                continue
             for run in range(cfg.num_runs):
                 if not cfg.compat.global_early_stop_state_shared:
                     early_stop.reset()  # fixed mode: per-combination state
@@ -319,6 +501,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="root replacing the JSON's relative data_path")
     p.add_argument("--use-mesh", action="store_true",
                    help="shard the client axis over all local devices")
+    p.add_argument("--batch-runs", action="store_true",
+                   help="execute all num_runs seeds of each combination as "
+                        "ONE runs-axis-batched program (federation/"
+                        "batched.py); per-run artifacts are unchanged")
     p.add_argument("--resume-dir", default=None,
                    help="directory for full-state checkpoints (enables resume)")
     p.add_argument("--no-save", action="store_true",
@@ -368,7 +554,8 @@ def main(argv: Optional[List[str]] = None) -> Dict:
             f"-{attack.strength:g}-k{attack.every_k}s{attack.start_round}"))
     return run_experiment(cfg, dataset, use_mesh=args.use_mesh,
                           save_checkpoints=not args.no_save,
-                          resume_dir=args.resume_dir, attack=attack)
+                          resume_dir=args.resume_dir, attack=attack,
+                          batch_runs=args.batch_runs)
 
 
 def cli() -> int:

@@ -66,3 +66,33 @@ class ExperimentRngs:
         counts = jnp.arange(self._fold + 1, self._fold + n + 1)
         self._fold += n
         return jax.vmap(lambda c: jax.random.fold_in(self.jax_root, c))(counts)
+
+
+def make_run_rngs(runs: int, data_seed: int = 1234,
+                  run_seed_stride: int = 10000) -> list:
+    """One ExperimentRngs per run, exactly as the sequential sweep constructs
+    them (main.py:run_combination) — the host streams of a batched-runs
+    federation (federation/batched.py)."""
+    return [ExperimentRngs(run=r, data_seed=data_seed,
+                           run_seed_stride=run_seed_stride)
+            for r in range(runs)]
+
+
+def batched_run_keys(rngs: list, n: int) -> jax.Array:
+    """A [n, R] key array whose column r is stream-identical to n successive
+    `rngs[r].next_jax()` draws, produced in ONE device dispatch.
+
+    This is the runs-axis analog of `next_jax_batch`: every run keeps its OWN
+    `fold_in(root_r, count_r)` stream (independent roots, independent fold
+    counters), so batched execution consumes bit-identical keys to R
+    sequential federations — the property the batched-vs-sequential
+    equivalence test pins (tests/test_batched_runs.py)."""
+    roots = jnp.stack([r.jax_root for r in rngs])
+    counts = jnp.asarray(np.stack(
+        [np.arange(r._fold + 1, r._fold + n + 1) for r in rngs], axis=1))
+    for r in rngs:
+        r._fold += n
+    # inner vmap pairs (root_r, count_r) across runs; outer vmap spans the
+    # n draws with the roots held fixed
+    return jax.vmap(jax.vmap(jax.random.fold_in), in_axes=(None, 0))(
+        roots, counts)

@@ -65,25 +65,126 @@ def _arg(flag, default, cast=str):
     return default
 
 
+LOCK_MAX_AGE_S = 6 * 3600  # staleness fallback when no holder PID was stamped
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    return True
+
+
+def _lock_is_stale() -> bool:
+    """True when the holder is provably gone: the PID stamped into the lock
+    dir no longer runs, or (no PID stamped — pre-staleness holder) the dir
+    outlived LOCK_MAX_AGE_S. The slowest legitimate hold is a full TPU
+    battery or refharness slice (<= 4 h subprocess timeouts), so 6 h of
+    silence means a SIGKILLed holder, not a slow one."""
+    try:
+        pid = int(open(os.path.join(BOX_LOCK, "pid")).read().strip())
+    except (OSError, ValueError):
+        try:
+            age = time.time() - os.stat(BOX_LOCK).st_mtime
+        except OSError:
+            return False  # lock vanished between checks; just re-acquire
+        return age > LOCK_MAX_AGE_S
+    return not _pid_alive(pid)
+
+
+def _try_reclaim(log) -> None:
+    """Reclaim a lock whose holder looks dead — race-safely. Deleting the
+    dir in place would let TWO waiters that both observed the dead PID
+    reclaim: the second's delete would destroy a lock the first had
+    already re-acquired (review catch). Instead STEAL the dir by rename —
+    only one contender's rename can succeed — with two guards against
+    stealing a LIVE lock: (a) re-read the pid immediately before the
+    rename and abort if a live holder replaced it since the staleness
+    check; (b) after the steal, confirm the stolen pid is the dead one we
+    just read, and hand the dir back (with retries) if not. The residual
+    window — another waiter's full reclaim+acquire landing between (a)
+    and the rename AND a third acquire landing before the hand-back — is
+    microseconds wide on top of an already-dead-holder precondition; a
+    failed hand-back is logged loudly rather than swallowed, because it
+    means two processes may believe they hold the box."""
+    try:
+        observed = int(open(os.path.join(BOX_LOCK, "pid")).read().strip())
+        if _pid_alive(observed):
+            return  # a live holder re-acquired since the staleness check
+    except (OSError, ValueError):
+        observed = None  # pid-less dir: the max-age heuristic sent us here
+    trash = f"{BOX_LOCK}.reclaim.{os.getpid()}"
+    try:
+        os.rename(BOX_LOCK, trash)
+    except OSError:
+        return  # lost the steal race (or the holder released); re-acquire
+    try:
+        stolen = int(open(os.path.join(trash, "pid")).read().strip())
+        alive = _pid_alive(stolen)
+    except (OSError, ValueError):
+        stolen, alive = None, False
+    if alive or stolen != observed:  # not the dir we checked: hand it back
+        restored = False
+        for _ in range(50):
+            try:
+                os.rename(trash, BOX_LOCK)
+                restored = True
+                break
+            except OSError:
+                time.sleep(0.1)  # freshly acquired dir in the way
+        if not restored:
+            log(json.dumps({"error": "box lock hand-back failed: a live "
+                            "holder's lock was stolen and could not be "
+                            "restored — two holders may coexist; inspect "
+                            f"{trash}"}), flush=True)
+        return
+    log(json.dumps({"reclaiming": "stale box lock (holder gone)"}),
+        flush=True)
+    try:
+        os.remove(os.path.join(trash, "pid"))
+    except OSError:
+        pass
+    try:
+        os.rmdir(trash)
+    except OSError:
+        pass
+
+
 def acquire_box_lock(log=print):
     """Atomically take the box (mkdir): the watcher holds this through
     probe+battery, we hold it per measured slice. No check-then-act
     window (round-5 review: the old two-flag handshake could let the
-    battery and a torch slice share the core)."""
+    battery and a torch slice share the core). The holder stamps its PID
+    into the lock dir; a lock whose holder died without cleanup (SIGKILL,
+    box restart) is reclaimed via an atomic rename-steal (_try_reclaim)
+    instead of starving every waiter forever (ADVICE r5)."""
     waited = False
     while True:
         try:
             os.mkdir(BOX_LOCK)
-            return
         except FileExistsError:
+            if _lock_is_stale():
+                _try_reclaim(log)
+                continue
             if not waited:
                 log(json.dumps({"waiting": "box lock held "
                                 "(tpu battery or probe)"}), flush=True)
                 waited = True
             time.sleep(60)
+            continue
+        with open(os.path.join(BOX_LOCK, "pid"), "w") as f:
+            f.write(str(os.getpid()))
+        return
 
 
 def release_box_lock():
+    try:
+        os.remove(os.path.join(BOX_LOCK, "pid"))
+    except OSError:
+        pass
     try:
         os.rmdir(BOX_LOCK)
     except OSError:

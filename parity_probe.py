@@ -57,6 +57,22 @@ def _arg(name, default):
     return default
 
 
+def welch_t(a, b):
+    """Welch's t statistic for two independent samples, or None where the
+    statistic is undefined — a side with fewer than 2 samples (ddof=1
+    variance is NaN) or zero within-side variance with unequal means (the
+    samples diverge with no spread to scale by). Neither NaN nor ±inf is
+    strict JSON, so the artifact records null for both (ADVICE r5).
+    Equal-mean zero-variance samples are a perfect match: 0.0."""
+    a, b = np.asarray(a, dtype=np.float64), np.asarray(b, dtype=np.float64)
+    if len(a) < 2 or len(b) < 2:
+        return None
+    va, vb = a.var(ddof=1) / len(a), b.var(ddof=1) / len(b)
+    if va + vb:
+        return float((a.mean() - b.mean()) / np.sqrt(va + vb))
+    return 0.0 if a.mean() == b.mean() else None
+
+
 def _load_client_partition(cfg, shards, client, data_seed):
     """One client's partition through OUR pipeline + the stacked tensors."""
     from fedmse_tpu.config import DatasetConfig
@@ -304,12 +320,8 @@ def solo_distribution(cfg, data, train, valid, test_x, test_y, n):
         torch_minv.append(round(min(th["valid_loss"]), 5))
 
     a, b = np.asarray(ours_auc), np.asarray(torch_auc)
-    va, vb = a.var(ddof=1) / n, b.var(ddof=1) / n
-    if va + vb:
-        t = float((a.mean() - b.mean()) / np.sqrt(va + vb))
-    else:  # zero within-side variance: equal means match, unequal diverge
-        t = 0.0 if a.mean() == b.mean() else float("inf") * np.sign(
-            a.mean() - b.mean())
+    # null = degenerate zero-variance divergence (strict-JSON-safe; welch_t)
+    t = welch_t(a, b)
     out = {
         "mode": "solo-distribution",
         "n_per_side": n, "epochs": cfg.epochs,
@@ -322,11 +334,12 @@ def solo_distribution(cfg, data, train, valid, test_x, test_y, n):
                           "diverged": torch_div, "aucs": torch_auc,
                           "stop_epochs": torch_stop,
                           "min_valid": torch_minv},
-        "welch_t": round(t, 3),
+        "welch_t": None if t is None else round(t, 3),
         "reading": ("|t| >= 2: the solo OUTCOME distributions differ — "
                     "single-client training owns any federation-level "
                     "gap; |t| < 2: solo sides match at this n — look in "
-                    "the federation layer"),
+                    "the federation layer; null: zero within-side "
+                    "variance with unequal means (degenerate divergence)"),
         **capture_provenance(),
     }
     _emit(out)

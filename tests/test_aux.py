@@ -587,3 +587,114 @@ def test_kitsune_adjudication_statistics():
         pop_int_flag(["p", "--runs", "0"], "--runs", minimum=1)
     with pytest.raises(SystemExit):
         pop_int_flag(["p", "--runs"], "--runs")  # value missing
+
+
+# ---------------- satellite fixes (ISSUE 1 / ADVICE r5) ---------------- #
+
+def test_welch_t_degenerate_zero_variance_is_null():
+    """parity_probe's solo-distribution artifact must be strict JSON: the
+    zero-within-side-variance divergent case is welch_t=null, never
+    Infinity (ADVICE r5)."""
+    import json
+    import parity_probe
+
+    assert parity_probe.welch_t([1.0, 1.0], [1.0, 1.0]) == 0.0
+    # unequal means with zero spread: degenerate divergence -> None -> null
+    assert parity_probe.welch_t([1.0, 1.0], [2.0, 2.0]) is None
+    # single-sample sides: ddof=1 variance is NaN (also not strict JSON)
+    assert parity_probe.welch_t([1.0], [2.0]) is None
+    assert "Infinity" not in json.dumps(
+        {"welch_t": parity_probe.welch_t([1.0, 1.0], [2.0, 2.0])})
+
+    # the regular case still matches scipy's Welch statistic
+    from scipy import stats
+    a, b = [1.0, 2.0, 3.0], [2.0, 3.5, 4.0]
+    want = stats.ttest_ind(a, b, equal_var=False).statistic
+    assert parity_probe.welch_t(a, b) == pytest.approx(float(want), abs=1e-9)
+
+
+def test_box_lock_reclaims_dead_holder(tmp_path, monkeypatch):
+    """A SIGKILLed lock holder must not starve waiters: the stamped PID is
+    gone, so acquire reclaims the lock instead of sleeping forever."""
+    import subprocess
+    import sys
+    import kitsune_adjudicate as ka
+
+    lock = str(tmp_path / "box_lock")
+    monkeypatch.setattr(ka, "BOX_LOCK", lock)
+    os.mkdir(lock)
+    proc = subprocess.run([sys.executable, "-c",
+                           "import os; print(os.getpid())"],
+                          capture_output=True, text=True)
+    dead_pid = int(proc.stdout)  # this process has already exited
+    with open(os.path.join(lock, "pid"), "w") as f:
+        f.write(str(dead_pid))
+    assert ka._lock_is_stale()
+    logs = []
+    ka.acquire_box_lock(log=lambda *a, **k: logs.append(a))
+    assert int(open(os.path.join(lock, "pid")).read()) == os.getpid()
+    assert any("reclaiming" in str(entry) for entry in logs)
+    ka.release_box_lock()
+    assert not os.path.exists(lock)
+
+
+def test_box_lock_live_and_fresh_holders_kept(tmp_path, monkeypatch):
+    import time
+    import kitsune_adjudicate as ka
+
+    lock = str(tmp_path / "box_lock")
+    monkeypatch.setattr(ka, "BOX_LOCK", lock)
+    os.mkdir(lock)
+    with open(os.path.join(lock, "pid"), "w") as f:
+        f.write(str(os.getpid()))  # live holder: never stale
+    assert not ka._lock_is_stale()
+    # pre-staleness holder (no PID stamped): fresh dir is given the benefit
+    os.remove(os.path.join(lock, "pid"))
+    assert not ka._lock_is_stale()
+    # ... but a dir older than the max-age heuristic is reclaimed
+    old = time.time() - ka.LOCK_MAX_AGE_S - 60
+    os.utime(lock, (old, old))
+    assert ka._lock_is_stale()
+
+
+def test_checkpoint_missing_extra_key_compared_against_default(tmp_path):
+    """A pre-round-5 checkpoint never recorded flatten_optimizer; resuming
+    it with the flag flipped must fail with the clear ValueError (the
+    recorded value IS the default), not the cryptic Orbax tree error
+    (ADVICE r5)."""
+    import json
+    from fedmse_tpu.checkpointing.io import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path))
+    with open(mgr._path("tag") + ".host.json", "w") as f:
+        json.dump({"aggregation_count": [0], "votes_received": [0],
+                   "rounds_aggregated": [], "round_index": 1, "extra": {}}, f)
+    with pytest.raises(ValueError, match="flatten_optimizer"):
+        mgr.restore("tag", None,
+                    expected_extra={"flatten_optimizer": True},
+                    extra_defaults={"flatten_optimizer": False})
+    # recorded keys still win over the default
+    with open(mgr._path("tag") + ".host.json", "w") as f:
+        json.dump({"aggregation_count": [0], "votes_received": [0],
+                   "rounds_aggregated": [], "round_index": 1,
+                   "extra": {"flatten_optimizer": True}}, f)
+    with pytest.raises(ValueError, match="flatten_optimizer"):
+        mgr.restore("tag", None,
+                    expected_extra={"flatten_optimizer": False},
+                    extra_defaults={"flatten_optimizer": False})
+
+
+def test_box_lock_steal_of_live_lock_is_restored(tmp_path, monkeypatch):
+    """_try_reclaim must hand back a lock whose holder turns out to be
+    alive at steal time (the waiter's staleness read raced a reclaim +
+    re-acquire by someone else)."""
+    import kitsune_adjudicate as ka
+
+    lock = str(tmp_path / "box_lock")
+    monkeypatch.setattr(ka, "BOX_LOCK", lock)
+    os.mkdir(lock)
+    with open(os.path.join(lock, "pid"), "w") as f:
+        f.write(str(os.getpid()))  # a live holder
+    ka._try_reclaim(log=lambda *a, **k: None)
+    assert os.path.isdir(lock)  # restored, not destroyed
+    assert int(open(os.path.join(lock, "pid")).read()) == os.getpid()

@@ -1,0 +1,187 @@
+"""Batched multi-run execution (federation/batched.py): R runs-axis-batched
+federations must reproduce R sequential runs exactly — per-run metric
+streams, election outcomes, early-stop rounds, and the ResultsWriter
+artifact layout. Sequential mode is the correctness oracle (ISSUE 1)."""
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from fedmse_tpu.checkpointing import ResultsWriter
+from fedmse_tpu.config import CompatConfig, ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+from fedmse_tpu.federation import BatchedRunEngine, RoundEngine
+from fedmse_tpu.main import (GlobalEarlyStop, run_batched_combination,
+                             run_combination)
+from fedmse_tpu.models import make_model
+from fedmse_tpu.utils.seeding import ExperimentRngs, batched_run_keys, make_run_rngs
+
+DIM = 12
+N = 4
+RUNS = 3
+
+
+def build_cfg(**kw):
+    kw.setdefault("num_rounds", 3)
+    return ExperimentConfig(
+        dim_features=DIM, network_size=N, epochs=2, batch_size=8,
+        num_runs=RUNS, compat=CompatConfig(vote_tie_break=False), **kw)
+
+
+def build_data(cfg):
+    clients = synthetic_clients(n_clients=N, dim=DIM, n_normal=120,
+                                n_abnormal=60)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(run=0).data_rng)
+    return stack_clients(clients, dev_x, cfg.batch_size)
+
+
+def test_batched_run_keys_match_sequential_streams():
+    """Column r of the batched key array must be bit-identical to run r's
+    own sequential next_jax() draws (the stream-preservation contract)."""
+    import jax
+    batched = make_run_rngs(RUNS)
+    keys = batched_run_keys(batched, 4)
+    for r in range(RUNS):
+        solo = ExperimentRngs(run=r)
+        for i in range(4):
+            np.testing.assert_array_equal(
+                jax.random.key_data(keys[i, r]),
+                jax.random.key_data(solo.next_jax()))
+
+
+def test_batched_chunk_matches_sequential_runs():
+    """One batched dispatch of K rounds x R runs == R sequential fused
+    schedules with the same seeds: selections, aggregators, metric streams,
+    min-valid curves (tolerance 1e-5; bitwise on CPU in practice)."""
+    cfg = build_cfg()
+    data = build_data(cfg)
+    model = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+
+    seq = {}
+    for r in range(RUNS):
+        eng = RoundEngine(model, cfg, data, n_real=N,
+                          rngs=ExperimentRngs(run=r), model_type="hybrid",
+                          update_type="mse_avg", fused=True)
+        seq[r] = eng.run_rounds(0, cfg.num_rounds)
+
+    bat = BatchedRunEngine(model, cfg, data, n_real=N, runs=RUNS,
+                           model_type="hybrid", update_type="mse_avg")
+    outs, schedule, _ = bat.run_schedule_chunk(0, cfg.num_rounds,
+                                               np.ones(RUNS, bool))
+    for i in range(cfg.num_rounds):
+        for r in range(RUNS):
+            res = bat.process_round(r, i, schedule[i][r], outs, i)
+            ref = seq[r][i]
+            assert res.selected == ref.selected
+            assert res.aggregator == ref.aggregator
+            np.testing.assert_allclose(res.client_metrics,
+                                       ref.client_metrics,
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(res.min_valid, ref.min_valid,
+                                       rtol=1e-5, atol=1e-6)
+    finals = bat.evaluate_final()
+    assert finals.shape == (RUNS, N)
+
+
+def _read_json_lines(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _walk_files(root):
+    out = {}
+    for d, _, files in os.walk(root):
+        for name in files:
+            p = os.path.join(d, name)
+            out[os.path.relpath(p, root)] = p
+    return out
+
+
+def test_batched_driver_reproduces_sequential_artifacts(tmp_path):
+    """run_batched_combination vs per-run run_combination with fresh
+    per-run early stopping: identical early-stop rounds, identical per-run
+    artifact trees (round JSON-lines byte-compatible, model.npz array-equal,
+    training_tracking.pkl byte-equal), matching final metrics.
+
+    num_rounds > chunk and inverted early stopping (AUC treated as a loss
+    improves only on the first round) force mid-chunk stops, so this also
+    pins the rewind-and-replay freeze semantics."""
+    cfg = build_cfg(num_rounds=6, fused_schedule_chunk=4, global_patience=1)
+    data = build_data(cfg)
+    device_names = [f"dev-{i}" for i in range(N)]
+
+    seq_root, bat_root = str(tmp_path / "seq"), str(tmp_path / "bat")
+    writers = {
+        root: ResultsWriter(root, cfg.network_size, cfg.experiment_name,
+                            cfg.scen_name, cfg.metric, cfg.num_participants)
+        for root in (seq_root, bat_root)
+    }
+
+    seq_outs = []
+    for r in range(RUNS):
+        early = GlobalEarlyStop(
+            inverted=cfg.compat.inverted_global_early_stop,
+            patience=cfg.global_patience)
+        seq_outs.append(run_combination(
+            cfg, data, N, "hybrid", "mse_avg", r, writer=writers[seq_root],
+            early_stop=early, device_names=device_names,
+            save_checkpoints=True))
+
+    bat_outs = run_batched_combination(
+        cfg, data, N, "hybrid", "mse_avg", writer=writers[bat_root],
+        device_names=device_names, save_checkpoints=True)
+
+    assert len(bat_outs) == RUNS
+    for r in range(RUNS):
+        # early-stop round parity: both modes ran the same number of rounds
+        assert bat_outs[r]["rounds_run"] == seq_outs[r]["rounds_run"]
+        assert bat_outs[r]["aggregation_count"] == \
+            seq_outs[r]["aggregation_count"]
+        np.testing.assert_allclose(bat_outs[r]["final_metrics"],
+                                   seq_outs[r]["final_metrics"],
+                                   rtol=1e-5, atol=1e-6)
+
+    seq_files, bat_files = _walk_files(seq_root), _walk_files(bat_root)
+    assert set(seq_files) == set(bat_files)  # identical artifact layout
+    for rel in seq_files:
+        if rel.endswith("_results.json") or rel.endswith(
+                "verification_results.json"):
+            with open(seq_files[rel], "rb") as a, open(bat_files[rel],
+                                                       "rb") as b:
+                assert a.read() == b.read(), f"{rel} not byte-compatible"
+        elif rel.endswith("model.npz"):
+            a, b = np.load(seq_files[rel]), np.load(bat_files[rel])
+            assert set(a.files) == set(b.files)
+            for k in a.files:
+                np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6)
+        elif rel.endswith("training_tracking.pkl"):
+            with open(seq_files[rel], "rb") as f:
+                rows_a = pickle.load(f)
+            with open(bat_files[rel], "rb") as f:
+                rows_b = pickle.load(f)
+            assert rows_a == rows_b
+
+
+def test_batched_single_run_works():
+    """R=1 is a valid batch (the bench sweeps R in {1, 3, 10})."""
+    cfg = build_cfg(num_rounds=2)
+    data = build_data(cfg)
+    model = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+    bat = BatchedRunEngine(model, cfg, data, n_real=N, runs=1,
+                           model_type="hybrid", update_type="mse_avg")
+    outs, schedule, _ = bat.run_schedule_chunk(0, 2, np.ones(1, bool))
+    res = bat.process_round(0, 1, schedule[1][0], outs, 1)
+    assert res.aggregator in res.selected
+    assert np.all(np.isfinite(res.client_metrics))
+
+
+def test_batched_time_metric_rejected():
+    cfg = build_cfg(metric="time")
+    data = build_data(cfg)
+    model = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+    with pytest.raises(ValueError, match="time"):
+        BatchedRunEngine(model, cfg, data, n_real=N, runs=2,
+                         model_type="hybrid", update_type="mse_avg")

@@ -56,6 +56,43 @@ def test_evaluate_all_matches_reference_math(model_type):
         assert got[i] == pytest.approx(want, abs=1e-5)
 
 
+@pytest.mark.parametrize("model_type", ["autoencoder", "hybrid"])
+def test_evaluate_all_classification_triple_matches_sklearn(model_type):
+    """metric='classification' returns [N, 3] f1/precision/recall — the
+    reference's calculate_classification_metric returns all three
+    (evaluator.py:42-47); the batch path returning f1 only was VERDICT
+    'missing' #4. Parity against sklearn at the reference's 0.5 score
+    threshold, per client, padded rows excluded."""
+    from sklearn.metrics import f1_score, precision_score, recall_score
+
+    model = make_model(model_type, DIM, shrink_lambda=1.0)
+    params = init_stacked_params(model, jax.random.key(0), 3)
+    test_x, test_m, test_y, train_xb, train_mb = _data()
+    got = np.asarray(make_evaluate_all(model, model_type,
+                                       metric="classification")(
+        params, test_x, test_m, test_y, train_xb, train_mb))
+    assert got.shape == (3, 3)
+
+    from fedmse_tpu.models.centroid import fit_centroid
+    for i in range(3):
+        p = jax.tree.map(lambda t: t[i], params)
+        mask = np.asarray(test_m[i]) > 0
+        tx = np.asarray(test_x[i])[mask]
+        ty = np.asarray(test_y[i])[mask]
+        latent, recon = model.apply({"params": p}, jnp.asarray(tx))
+        if model_type == "autoencoder":
+            scores = np.mean((tx - np.asarray(recon)) ** 2, axis=1)
+        else:
+            train_flat = np.asarray(train_xb[i]).reshape(-1, DIM)
+            tl, _ = model.apply({"params": p}, jnp.asarray(train_flat))
+            scores = np.asarray(fit_centroid(tl).get_density(latent))
+        pred = (np.nan_to_num(scores) > 0.5).astype(np.float32)
+        for col, fn in enumerate((f1_score, precision_score, recall_score)):
+            want = fn(ty, pred, zero_division=0)
+            assert got[i, col] == pytest.approx(want, abs=1e-5), \
+                (model_type, i, col)
+
+
 @pytest.mark.parametrize("fused", ["xla", "interpret"])
 @pytest.mark.parametrize("model_type", ["autoencoder", "hybrid"])
 def test_fused_eval_matches_plain(model_type, fused):

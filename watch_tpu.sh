@@ -143,15 +143,53 @@ while true; do
     # take the box lock BEFORE probing: a CPU driver that starts mid-probe
     # would otherwise share the core with the battery (review finding)
     if ! mkdir /tmp/fedmse_box_lock 2>/dev/null; then
+        # stale-holder reclaim (mirrors kitsune_adjudicate._try_reclaim):
+        # a SIGKILLed holder leaves the dir behind; its stamped PID tells
+        # us — and a holder killed between mkdir and the pid stamp leaves
+        # a PID-LESS dir, caught by the same 6 h max-age heuristic the
+        # Python side uses. STEAL by atomic mv (only one contender's mv
+        # succeeds — an in-place delete could destroy a lock another
+        # waiter had already reclaimed and re-acquired), then confirm the
+        # stolen lock still names a dead holder; if a live holder slipped
+        # in, hand it back (a failed hand-back is logged loudly: it means
+        # two holders may coexist).
+        holder=$(cat /tmp/fedmse_box_lock/pid 2>/dev/null)
+        stale=""
+        if [ -n "$holder" ]; then
+            kill -0 "$holder" 2>/dev/null || stale="holder $holder gone"
+        else
+            mtime=$(stat -c %Y /tmp/fedmse_box_lock 2>/dev/null || echo 0)
+            if [ "$mtime" -gt 0 ] && \
+                    [ $(( $(date +%s) - mtime )) -gt 21600 ]; then
+                stale="pid-less lock older than 6h"
+            fi
+        fi
+        if [ -n "$stale" ]; then
+            trash="/tmp/fedmse_box_lock.reclaim.$$"
+            if mv /tmp/fedmse_box_lock "$trash" 2>/dev/null; then
+                newpid=$(cat "$trash/pid" 2>/dev/null)
+                if [ -n "$newpid" ] && kill -0 "$newpid" 2>/dev/null; then
+                    mv "$trash" /tmp/fedmse_box_lock 2>/dev/null || \
+                        echo "box lock hand-back FAILED ($trash); two holders may coexist" >> "$LOG"
+                else
+                    echo "reclaiming stale box lock ($stale) $(date +%F\ %T)" >> "$LOG"
+                    rm -f "$trash/pid"
+                    rmdir "$trash" 2>/dev/null
+                fi
+            fi
+            continue
+        fi
         echo "box lock held $(date +%F\ %T); waiting" >> "$LOG"
         sleep 60
         continue
     fi
+    echo $$ > /tmp/fedmse_box_lock/pid
     if timeout 120 python -c "import jax; d=jax.devices()[0]; \
 assert d.platform=='tpu', d.platform" >> "$LOG" 2>&1; then
         echo "tunnel healthy $(date +%F\ %T); firing battery" >> "$LOG"
         break  # lock stays held through the battery; EXIT trap releases
     fi
+    rm -f /tmp/fedmse_box_lock/pid
     rmdir /tmp/fedmse_box_lock 2>/dev/null
     echo "probe failed $(date +%F\ %T); sleeping 240s" >> "$LOG"
     sleep 240
@@ -159,7 +197,7 @@ done
 
 # ---- battery ----
 touch /tmp/fedmse_tpu_capturing
-trap 'rm -f /tmp/fedmse_tpu_capturing; rmdir /tmp/fedmse_box_lock 2>/dev/null' EXIT
+trap 'rm -f /tmp/fedmse_tpu_capturing /tmp/fedmse_box_lock/pid; rmdir /tmp/fedmse_box_lock 2>/dev/null' EXIT
 # clean any previous invocation's captures: the landing loop below must
 # only ever see THIS battery's outputs (a stale .out from an older engine
 # landing under a fresh tag is a provenance lie)
