@@ -7,7 +7,8 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -Wall -Wextra
 LIB := fedmse_tpu/native/libfedmse_io.so
 
-.PHONY: native clean test bench bench-paper bench-scaling bench-suite tpu-check
+.PHONY: native clean test bench bench-paper bench-scaling bench-suite \
+        serve-bench tpu-check
 
 native: $(LIB)
 
@@ -30,6 +31,11 @@ bench-scaling:
 
 bench-suite:
 	python bench_suite.py
+
+# serving throughput/latency: bucketed micro-batched scorer vs per-request
+# dispatch (writes BENCH_SERVE_pr02_cpu.json; hermetic CPU like the tests)
+serve-bench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python bench_serve.py
 
 tpu-check:
 	python tpu_check.py

@@ -1,0 +1,160 @@
+"""Serving benchmark: rows/sec + latency percentiles of the bucketed
+micro-batched scorer (fedmse_tpu/serving/) vs an unbatched per-request
+baseline, at micro-batch sizes {1, 64, 1024}.
+
+The per-request baseline is the deployment the serving subsystem
+replaces: every arriving row becomes its own device dispatch (the
+bucket-1 program). At this model size the dispatch overhead dwarfs the
+~µs of compute per row (DESIGN.md §2), so batching the dispatch is the
+whole win — the acceptance bar is >=5x rows/sec at batch 1024 on CPU.
+
+Prints ONE JSON line and writes BENCH_SERVE_pr02_<platform>.json
+(override with --out). Run on CPU via `make serve-bench`.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+BATCHES = (1, 64, 1024)
+N_GATEWAYS = 10
+
+
+def _flag(name, default):
+    value = default
+    for i, a in enumerate(sys.argv):
+        if a == name and i + 1 < len(sys.argv):
+            value = sys.argv[i + 1]
+        elif a.startswith(name + "="):
+            value = a.split("=", 1)[1]
+    return value
+
+
+def bench_batched(engine, rows, gws, max_batch, calibration):
+    """Stream every row through the micro-batcher at one batch size."""
+    from fedmse_tpu.serving import MicroBatcher
+
+    batcher = MicroBatcher(engine, max_batch=max_batch, max_wait_ms=1e9,
+                           calibration=calibration)
+    t0 = time.perf_counter()
+    for i in range(len(rows)):
+        batcher.submit(rows[i], int(gws[i]))
+    batcher.drain()
+    wall = time.perf_counter() - t0
+    stats = batcher.stats()
+    return {
+        "batch": max_batch,
+        "rows": len(rows),
+        "rows_per_sec": round(len(rows) / wall, 1),
+        "rows_per_sec_service": round(stats["rows_per_sec_service"], 1),
+        "latency_p50_ms": round(stats["latency_p50_ms"], 4),
+        "latency_p95_ms": round(stats["latency_p95_ms"], 4),
+        "latency_p99_ms": round(stats["latency_p99_ms"], 4),
+        "dispatches": stats["dispatches"],
+    }
+
+
+def bench_unbatched(engine, rows, gws):
+    """Per-request baseline: one dispatch per row (bucket-1 program)."""
+    import numpy as np
+
+    lat = np.empty(len(rows))
+    t0 = time.perf_counter()
+    for i in range(len(rows)):
+        r0 = time.perf_counter()
+        engine.score(rows[i], int(gws[i]))
+        lat[i] = time.perf_counter() - r0
+    wall = time.perf_counter() - t0
+    return {
+        "rows": len(rows),
+        "rows_per_sec": round(len(rows) / wall, 1),
+        "latency_p50_ms": round(float(np.percentile(lat, 50) * 1000), 4),
+        "latency_p95_ms": round(float(np.percentile(lat, 95) * 1000), 4),
+        "latency_p99_ms": round(float(np.percentile(lat, 99) * 1000), 4),
+    }
+
+
+def main():
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
+    enable_compilation_cache()
+    capture_provenance()  # pin git state before any timed work
+    import numpy as np
+    import jax
+
+    from fedmse_tpu.models import make_model, init_stacked_params
+    from fedmse_tpu.serving import ServingEngine, fit_calibration
+
+    model_type = _flag("--model-type", "hybrid")
+    total_rows = int(_flag("--rows", 8192))
+    seed = 0
+
+    # Scoring throughput is independent of training quality, so the
+    # federation is synthetic: paper-dimension models (115 -> 27 -> 7),
+    # N_GATEWAYS independent inits, centroids fit on synthetic normals.
+    rng = np.random.default_rng(seed)
+    dim = 115
+    model = make_model(model_type, dim, shrink_lambda=10.0)
+    params = init_stacked_params(model, jax.random.key(seed), N_GATEWAYS)
+    train_x = rng.normal(size=(N_GATEWAYS, 512, dim)).astype(np.float32)
+    engine = ServingEngine.from_federation(
+        model, model_type, params,
+        train_x=train_x if model_type == "hybrid" else None,
+        max_bucket=max(BATCHES))
+    calibration = fit_calibration(
+        engine, rng.normal(size=(N_GATEWAYS, 256, dim)).astype(np.float32))
+    engine.warmup()  # every bucket compiles outside the timed sections
+
+    rows = rng.normal(size=(total_rows, dim)).astype(np.float32)
+    gws = rng.integers(0, N_GATEWAYS, size=total_rows).astype(np.int32)
+
+    # steady-state protocol: untimed warm pass per configuration, then the
+    # timed pass (the bursty-tunnel min-over-reps rule is bench.py's; this
+    # workload is host-loop-dominated and stable on CPU)
+    base_rows = min(total_rows, 1024)  # per-request dispatch is ~1000x
+    # slower; 1024 rows already give stable percentiles
+    bench_unbatched(engine, rows[:128], gws[:128])
+    baseline = bench_unbatched(engine, rows[:base_rows], gws[:base_rows])
+
+    results = []
+    for b in BATCHES:
+        n = total_rows if b > 1 else base_rows  # batch-1 IS the baseline
+        # shape; don't spend minutes re-measuring it at full volume
+        bench_batched(engine, rows[:min(n, 4 * b)], gws[:min(n, 4 * b)],
+                      b, calibration)
+        r = bench_batched(engine, rows[:n], gws[:n], b, calibration)
+        r["speedup_vs_unbatched"] = round(
+            r["rows_per_sec"] / baseline["rows_per_sec"], 2)
+        results.append(r)
+
+    device = jax.devices()[0]
+    out = {
+        "metric": f"serving rows/sec ({model_type}, {N_GATEWAYS} gateways "
+                  f"multi-tenant, dim {dim}, bucketed micro-batched engine "
+                  f"vs per-request dispatch)",
+        "value": results[-1]["rows_per_sec"],
+        "unit": "rows/s",
+        "model_type": model_type,
+        "gateways": N_GATEWAYS,
+        "unbatched_baseline": baseline,
+        "batched": results,
+        "speedup_batch1024_vs_unbatched": results[-1]["speedup_vs_unbatched"],
+        "buckets": engine.buckets,
+        "device": str(device),
+        "platform": device.platform,
+    }
+    out.update(capture_provenance())
+    line = json.dumps(out)
+    print(line)
+    dest = _flag("--out", f"BENCH_SERVE_pr02_{device.platform}.json")
+    with open(dest, "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

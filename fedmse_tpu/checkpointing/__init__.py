@@ -1,6 +1,7 @@
 from fedmse_tpu.checkpointing.io import (
     CheckpointManager,
     ResultsWriter,
+    load_client_models,
     save_client_models,
     save_training_tracking,
 )
@@ -8,6 +9,7 @@ from fedmse_tpu.checkpointing.io import (
 __all__ = [
     "CheckpointManager",
     "ResultsWriter",
+    "load_client_models",
     "save_client_models",
     "save_training_tracking",
 ]

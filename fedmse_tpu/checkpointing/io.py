@@ -109,6 +109,13 @@ class ResultsWriter:
                             str(run), "ClientModel", self.scen, model_type,
                             update_type, device_name)
 
+    def serving_dir(self, run: int) -> str:
+        """Serving-side artifacts (calibration thresholds, drift reports)
+        beside the run's ClientModel tree — the inference half
+        (fedmse_tpu/serving/) loads params + calibration from one root."""
+        return os.path.join(self.root, str(self.network_size), self.exp,
+                            str(run), "Serving", self.scen)
+
 
 def save_client_models(writer: ResultsWriter, run: int, model_type: str,
                        update_type: str, device_names: Sequence[str],
@@ -123,6 +130,35 @@ def save_client_models(writer: ResultsWriter, run: int, model_type: str,
         os.makedirs(d, exist_ok=True)
         np.savez(os.path.join(d, "model.npz"),
                  **{k: v[i] for k, v in arrays.items()})
+
+
+def load_client_models(writer: ResultsWriter, run: int, model_type: str,
+                       update_type: str, device_names: Sequence[str],
+                       params_like: Any) -> Any:
+    """Inverse of `save_client_models`: re-stack the per-client `model.npz`
+    files back into a `[N, ...]` stacked params pytree (the serving
+    subsystem's load path — fedmse_tpu/serving/engine.py).
+
+    `params_like` supplies the tree structure (one client's params, e.g.
+    `init_client_params(model, key)`); the npz array keys are the same
+    `jax.tree_util.keystr` paths `save_client_models` wrote."""
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(params_like)
+    keys = [jax.tree_util.keystr(path) for path, _ in leaves]
+    per_leaf: List[List[np.ndarray]] = [[] for _ in keys]
+    for name in device_names:
+        path = os.path.join(
+            writer.client_model_dir(run, model_type, update_type, name),
+            "model.npz")
+        with np.load(path) as z:
+            missing = [k for k in keys if k not in z.files]
+            if missing:
+                raise ValueError(
+                    f"{path} lacks params {missing[:3]}{'...' if len(missing) > 3 else ''}; "
+                    f"was it saved for a different model topology?")
+            for j, k in enumerate(keys):
+                per_leaf[j].append(z[k])
+    stacked = [np.stack(v, axis=0) for v in per_leaf]
+    return jax.tree_util.tree_unflatten(treedef, stacked)
 
 
 def save_training_tracking(writer: ResultsWriter, run: int, model_type: str,

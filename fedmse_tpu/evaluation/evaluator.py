@@ -23,6 +23,7 @@ from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fedmse_tpu.models.centroid import fit_centroid
 from fedmse_tpu.ops.losses import per_sample_mse
@@ -43,7 +44,9 @@ def make_evaluate_all(model, model_type: str, metric: str = "AUC",
     the round engine keeps f1 (column 0) as the scalar metric stream
     (rounds.split_metric_columns). metric='time' returns steady-state
     per-client inference latency in seconds — the vectorized counterpart
-    of reference evaluator.py:99-108.
+    of reference evaluator.py:99-108. metric='scores' returns the raw
+    nan_to_num'd per-row anomaly scores [N, T] — the serving subsystem's
+    parity oracle (fedmse_tpu/serving/engine.py).
 
     fused: 'off' uses the flax apply; 'auto'/'pallas'/'xla' route the forward
     through the single-kernel fused path (ops/pallas_ae.py) — same math, one
@@ -71,6 +74,11 @@ def make_evaluate_all(model, model_type: str, metric: str = "AUC",
     def eval_one(params, test_x, test_m, test_y, train_xf, train_mf):
         scores = anomaly_scores_one(params, test_x, train_xf, train_mf)
         scores = jnp.nan_to_num(scores)  # evaluator.py:24-25 nan_to_num guard
+        if metric == "scores":
+            # raw per-row anomaly scores [T] — the oracle the serving
+            # subsystem's parity tests compare against (serving/engine.py
+            # must reproduce this exact score path)
+            return scores
         if metric == "AUC":
             return roc_auc(test_y, scores, test_m)
         f1, precision, recall = classification_metrics(test_y, scores, test_m)
@@ -86,7 +94,6 @@ def make_evaluate_all(model, model_type: str, metric: str = "AUC",
 
         def latency_all(stacked_params, test_x, test_m, test_y,
                         train_xb, train_mb):
-            import numpy as np
             train_xf = train_xb.reshape(train_xb.shape[0], -1,
                                         train_xb.shape[-1])
             train_mf = train_mb.reshape(train_mb.shape[0], -1)

@@ -32,7 +32,7 @@ verification (its history is untouched) — expressed via `agg_onehot`.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -51,7 +51,8 @@ class VerifyOutcome(NamedTuple):
 def make_verify_fn(model, verification_threshold: float = 3.0,
                    performance_threshold: float = 0.002,
                    hardened: bool = False,
-                   recovery_threshold: float = 0.1) -> Callable:
+                   recovery_threshold: float = 0.1,
+                   recovery_delta_cap: Optional[float] = None) -> Callable:
     """Build fn(states, agg_params, ver_x [N,V,D], ver_m [N,V],
     agg_onehot [N], client_mask [N]) -> VerifyOutcome.
 
@@ -87,10 +88,17 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
         instead of being delta-capped into permanent exclusion. The large
         margin keeps the cap meaningful against adversaries: a crafted
         model that merely edges out the own model by the noise threshold
-        does NOT get an unbounded step; one that improves the client's
-        own verification score by 0.1 has, by the only oracle this
-        scheme has ever had (reference model_verifier.py:86-99), earned
-        the replacement it amounts to.
+        does NOT get a waived step; one that improves the client's own
+        verification score by 0.1 has, by the only oracle this scheme has
+        ever had (reference model_verifier.py:86-99), earned a LARGER
+        step — but not an unbounded one: the recovery waiver carries its
+        own hard Frobenius ceiling ``recovery_delta_cap`` (default
+        10 x ``verification_threshold``; ADVICE r5 #1 — a +0.1 perf gain
+        must widen the step cap, not lift it). The default clears the
+        measured cold-recovery distance (Σ‖trained − 0‖_F ≈ 13-19 on
+        both the test-size and paper-size models) with ~1.5x headroom
+        while still bounding what a broadcast that games the perf oracle
+        can move in one round.
 
     CAVEAT — recovery waiver × compat.shared_last_client_val (ADVICE r5):
     the recovery waiver's oracle is only as private as the verification
@@ -99,17 +107,19 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
     aggregator also holds — so the attacker can CRAFT a broadcast that
     genuinely scores +`recovery_threshold` on that shared tensor (easiest
     early in training, while own models are weakly trained) and collect
-    an unbounded parameter step from every client at once. With
-    per-client verification data (shared_last_client_val=False, or
-    verification_method='val' fixed mode) the attacker must clear the
-    margin on N unseen tensors simultaneously, which restores the
-    waiver's intent. Deploy hardened=True together with per-client
-    verification data; if the shared-tensor quirk must stay on, consider
-    a delta ceiling even on the recovery path.
+    a recovery-sized parameter step from every client at once — bounded
+    by ``recovery_delta_cap``, no longer unbounded, but still the largest
+    step the scheme ever grants. With per-client verification data
+    (shared_last_client_val=False, or verification_method='val' fixed
+    mode) the attacker must clear the margin on N unseen tensors
+    simultaneously, which restores the waiver's intent. Deploy
+    hardened=True together with per-client verification data.
 
     History/rejected bookkeeping is unchanged, so flag semantics
     (rejected >= 3 => possible attack) carry over.
     """
+    if recovery_delta_cap is None:
+        recovery_delta_cap = 10.0 * verification_threshold
 
     def perf_of(params, ver_x, ver_m):
         """1/(1+MSE) on this client's verification tensor
@@ -143,7 +153,10 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
             own_perf = jax.vmap(perf_of)(states.params, ver_x, ver_m)
             perf_change = new_perf - own_perf
             perf_ok = perf_change >= -performance_threshold
-            recovers = perf_change >= recovery_threshold
+            # the recovery waiver widens the step cap, it does not lift
+            # it: even a big genuine improvement stays Frobenius-bounded
+            recovers = ((perf_change >= recovery_threshold)
+                        & (delta <= recovery_delta_cap))
             first = ~states.hist_seen
             checks = perf_ok & (first | recovers |
                                 (delta <= verification_threshold))

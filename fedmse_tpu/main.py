@@ -406,8 +406,15 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                    use_mesh: bool = False,
                    save_checkpoints: bool = True,
                    resume_dir: Optional[str] = None,
-                   attack=None, batch_runs: bool = False) -> Dict:
-    """The full sweep (src/main.py:108-399) -> training summary dict."""
+                   attack=None, batch_runs: bool = False,
+                   serve: bool = False, serve_rows: int = 2048) -> Dict:
+    """The full sweep (src/main.py:108-399) -> training summary dict.
+
+    `serve=True` appends a serving smoke pass (fedmse_tpu/serving/): the
+    first combination's checkpointed ClientModel tree is loaded back from
+    disk, calibrated on validation normals, and test traffic is streamed
+    through the micro-batched bucketed scorer with drift monitoring; the
+    report lands under the returned dict's "serve_smoke" key."""
     mesh = None
     pad_multiple = None
     if use_mesh and len(jax.devices()) > 1:
@@ -490,6 +497,17 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
            "summary_path": summary_path}
     if attack is not None:  # record the adversary in the run's own summary
         out["attack"] = dataclasses.asdict(attack)
+    if serve:
+        if not save_checkpoints:
+            logger.warning("--serve needs the checkpointed ClientModel tree"
+                           " (run without --no-save); skipping smoke pass")
+        else:
+            from fedmse_tpu.serving import run_serve_smoke
+            out["serve_smoke"] = run_serve_smoke(
+                cfg, data, n_real, writer, device_names,
+                model_type=cfg.model_types[0],
+                update_type=cfg.update_types[0], run=0,
+                max_rows=serve_rows)
     return out
 
 
@@ -507,6 +525,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "batched.py); per-run artifacts are unchanged")
     p.add_argument("--resume-dir", default=None,
                    help="directory for full-state checkpoints (enables resume)")
+    p.add_argument("--serve", action="store_true",
+                   help="after the sweep, run a serving smoke pass on the "
+                        "first combination: load its checkpointed models, "
+                        "calibrate per-gateway thresholds on validation "
+                        "normals, stream test traffic through the bucketed "
+                        "micro-batched scorer, report latency + drift "
+                        "(fedmse_tpu/serving/)")
+    p.add_argument("--serve-rows", type=int, default=2048,
+                   help="max test rows streamed by the --serve smoke pass")
     p.add_argument("--no-save", action="store_true",
                    help="skip per-client model/tracking artifacts")
     p.add_argument("--paper-scale", action="store_true",
@@ -555,7 +582,8 @@ def main(argv: Optional[List[str]] = None) -> Dict:
     return run_experiment(cfg, dataset, use_mesh=args.use_mesh,
                           save_checkpoints=not args.no_save,
                           resume_dir=args.resume_dir, attack=attack,
-                          batch_runs=args.batch_runs)
+                          batch_runs=args.batch_runs, serve=args.serve,
+                          serve_rows=args.serve_rows)
 
 
 def cli() -> int:

@@ -1,0 +1,36 @@
+"""Online anomaly-scoring subsystem — the inference half of the stack.
+
+The training side ends with a converged federation: stacked `[N, ...]`
+params (plus, for the hybrid model, per-gateway centroid classifiers).
+This package turns that into a deployed detector:
+
+  engine.py       compiled scorer with static power-of-two row buckets;
+                  single-global and multi-tenant (per-row gateway routing
+                  by gather over the stacked pytree) paths
+  calibration.py  score -> verdict: per-gateway percentile thresholds fit
+                  on validation normals, persisted beside the checkpoint
+  batcher.py      host-side dynamic micro-batcher (max_batch / max_wait_ms)
+                  with p50/p95/p99 latency and rows/sec accounting
+  drift.py        streaming Welford mean/var over served scores per
+                  gateway vs the calibration distribution
+  smoke.py        end-to-end smoke pass (load checkpoint -> calibrate ->
+                  serve -> drift report) wired to `fedmse_tpu.main --serve`
+
+Design rationale lives in DESIGN.md §8.
+"""
+
+from fedmse_tpu.serving.batcher import MicroBatcher
+from fedmse_tpu.serving.calibration import ServingCalibration, fit_calibration
+from fedmse_tpu.serving.drift import DriftMonitor
+from fedmse_tpu.serving.engine import ServingEngine, fit_gateway_centroids
+from fedmse_tpu.serving.smoke import run_serve_smoke
+
+__all__ = [
+    "MicroBatcher",
+    "ServingCalibration",
+    "fit_calibration",
+    "DriftMonitor",
+    "ServingEngine",
+    "fit_gateway_centroids",
+    "run_serve_smoke",
+]

@@ -1,0 +1,115 @@
+"""Streaming drift detection over served scores, per gateway.
+
+The reference handles distribution change only at training time (a new
+device joins -> retrain the federation). A deployed detector needs the
+inverse signal: notice *while serving* that a gateway's live score
+distribution has departed the calibration distribution — traffic
+shifted, a device was replaced, or the model went stale — and flag it
+for recalibration/retraining.
+
+`DriftMonitor` keeps a Welford running mean/variance per gateway
+(numerically stable one-pass; batches merge via Chan's parallel update,
+so a 1024-row dispatch is one O(gateways) update, not 1024 scalar ones)
+and compares the live mean against the calibration mean in calibration-
+std units.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from fedmse_tpu.serving.calibration import ServingCalibration
+
+
+class DriftMonitor:
+    """Welford/Chan streaming moments per gateway vs the calibration.
+
+    A gateway drifts when it has seen at least `min_count` live rows and
+    |live_mean - calib_mean| > z_threshold * calib_std — a mean shift of
+    z_threshold calibration standard deviations. Gateways the calibration
+    never saw (count 0) are reported as uncalibrated, never drifted.
+    """
+
+    def __init__(self, calibration: ServingCalibration,
+                 z_threshold: float = 3.0, min_count: int = 30):
+        self.calibration = calibration
+        self.z_threshold = z_threshold
+        self.min_count = min_count
+        n = calibration.num_gateways
+        self.count = np.zeros(n, np.int64)
+        self.mean = np.zeros(n)
+        self._m2 = np.zeros(n)  # sum of squared deviations from the mean
+
+    def update(self, scores, gateway_ids=None) -> None:
+        """Absorb one served batch of scores (+ per-row gateway ids)."""
+        scores = np.asarray(scores, np.float64)
+        if gateway_ids is None:
+            gw = np.zeros(scores.shape[0], np.int32)
+        else:
+            gw = np.broadcast_to(np.asarray(gateway_ids, np.int32),
+                                 scores.shape)
+        for g in np.unique(gw):
+            xs = scores[gw == g]
+            nb = len(xs)
+            mb = float(np.mean(xs))
+            m2b = float(np.sum((xs - mb) ** 2))
+            na, ma = int(self.count[g]), float(self.mean[g])
+            delta = mb - ma
+            n = na + nb
+            # Chan et al. parallel combine of (count, mean, M2) pairs
+            self.mean[g] = ma + delta * nb / n
+            self._m2[g] += m2b + delta * delta * na * nb / n
+            self.count[g] = n
+
+    def live_std(self) -> np.ndarray:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return np.sqrt(np.where(self.count > 0,
+                                    self._m2 / np.maximum(self.count, 1),
+                                    0.0))
+
+    def shift(self) -> np.ndarray:
+        """Per-gateway mean shift in calibration-std units (0 where the
+        calibration std is 0 and the means agree; inf where it is 0 and
+        they do not)."""
+        cal = self.calibration
+        diff = np.abs(self.mean - cal.mean)
+        with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+            z = np.where(cal.std > 0, diff / np.maximum(cal.std, 1e-300),
+                         np.where(diff > 0, np.inf, 0.0))
+        return z
+
+    def drifted(self) -> np.ndarray:
+        """[N] bool: calibrated gateways whose live mean left the band."""
+        z = self.shift()
+        return ((self.count >= self.min_count)
+                & (self.calibration.count > 0)
+                & (z > self.z_threshold))
+
+    def report(self) -> Dict:
+        """JSON-safe summary (per-gateway rows + the flagged list)."""
+        z = self.shift()
+        drifted = self.drifted()
+        live_std = self.live_std()
+        cal = self.calibration
+        gateways: List[Dict] = []
+        for g in range(cal.num_gateways):
+            gateways.append({
+                "gateway": g,
+                "live_count": int(self.count[g]),
+                "live_mean": float(self.mean[g]),
+                "live_std": float(live_std[g]),
+                "calib_mean": float(cal.mean[g]),
+                "calib_std": float(cal.std[g]),
+                "shift_sigmas": (None if not np.isfinite(z[g])
+                                 else float(z[g])),
+                "calibrated": bool(cal.count[g] > 0),
+                "drifted": bool(drifted[g]),
+            })
+        return {
+            "z_threshold": self.z_threshold,
+            "min_count": self.min_count,
+            "drifted_gateways": [int(g) for g in np.nonzero(drifted)[0]],
+            "gateways": gateways,
+        }

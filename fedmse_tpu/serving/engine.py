@@ -1,0 +1,262 @@
+"""Compiled anomaly scorer with static shape buckets.
+
+The training engine's profile (DESIGN.md §2) shows this workload is
+dispatch-latency-bound, not FLOP-bound: marginal compute per round is
+~11 ms while a single dispatch costs 59-291 ms on the shared tunnel. The
+serving path lives in the same regime — a 7k-parameter model scores one
+115-feature row in microseconds, so per-request dispatch would be >99%
+overhead. The design therefore mirrors TPU-KNN's recipe (arxiv
+2206.14286): fixed-shape batched inference, one compiled program per
+shape, requests padded up to the nearest bucket.
+
+  * **Buckets**: power-of-two row counts 1..max_bucket. A request of B
+    rows is padded to the next bucket (one jitted program per bucket, so
+    every possible request shape hits a warm compile cache); requests
+    larger than max_bucket are chunked. Padding rows are sliced off after
+    the dispatch — rowwise score math means they cannot perturb real rows
+    (pinned by tests/test_serving.py).
+  * **Single-global vs multi-tenant**: a single model tree serves the
+    one-detector deployment; the multi-tenant path serves all N gateways'
+    models at once from the training side's stacked `[N, ...]` pytree,
+    routing each row to its gateway's params (and centroid) by gather —
+    the same stacked-pytree + vmap machinery the round engine trains with.
+  * **Score parity**: the score math is the evaluator's, not a re-
+    implementation — AE-MSE via `ops.losses.per_sample_mse`, hybrid
+    centroid density via `models.centroid.fit_centroid(...).get_density`,
+    with the evaluator's `nan_to_num` guard. `make_evaluate_all(...,
+    metric="scores")` is the oracle the parity tests compare against.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedmse_tpu.models.centroid import fit_centroid
+from fedmse_tpu.ops.losses import per_sample_mse
+
+
+def fit_gateway_centroids(model, stacked_params, train_x, train_m=None):
+    """Per-gateway CentroidClassifier pytree with leaves stacked [N, ...].
+
+    Exactly the evaluator's hybrid fit (evaluation/evaluator.py
+    anomaly_scores_one): encode each gateway's train rows with its own
+    params, fit the centroid on the (masked) latents. Accepts batch-major
+    [N, NB, B, D] (the FederatedData layout) or flat [N, S, D] train rows.
+    """
+    train_x = jnp.asarray(train_x)
+    if train_x.ndim == 4:
+        train_x = train_x.reshape(train_x.shape[0], -1, train_x.shape[-1])
+    if train_m is not None:
+        train_m = jnp.asarray(train_m).reshape(train_m.shape[0], -1)
+
+    @jax.jit
+    def fit_all(params, xf, mf):
+        def fit_one(p, x, m):
+            latent, _ = model.apply({"params": p}, x)
+            return fit_centroid(latent, m)
+        if mf is None:
+            return jax.vmap(lambda p, x: fit_one(p, x, None))(params, xf)
+        return jax.vmap(fit_one)(params, xf, mf)
+
+    return fit_all(stacked_params, train_x, train_m)
+
+
+class ServingEngine:
+    """Bucketed, compiled scorer over a trained federation.
+
+    Parameters
+    ----------
+    model : the flax module the params belong to (makes `input_dim`,
+        `apply` available — same object training used).
+    model_type : 'autoencoder' (score = per-row reconstruction MSE) or
+        'hybrid' (score = centroid density of the latent).
+    params : single param tree (multi_tenant=False) or stacked [N, ...]
+        pytree (multi_tenant=True).
+    centroids : CentroidClassifier pytree — required for 'hybrid'; single
+        (multi_tenant=False) or leaves stacked [N, ...] (multi_tenant=True).
+    max_bucket : largest compiled row bucket; larger requests are chunked.
+
+    Input buffers are fresh numpy arrays per dispatch, so nothing host-side
+    retains them past the call. (Buffer DONATION was evaluated and dropped:
+    the output [b] scores cannot alias either input — [b, D] rows / [b]
+    int32 ids — so donate_argnums would only emit unusable-donation
+    warnings, never reclaim memory.)
+    """
+
+    def __init__(self, model, model_type: str, params: Any,
+                 centroids: Any = None, *, multi_tenant: bool = True,
+                 max_bucket: int = 1024):
+        if model_type not in ("autoencoder", "hybrid"):
+            raise ValueError(f"unknown model_type {model_type!r}")
+        if model_type == "hybrid" and centroids is None:
+            raise ValueError("hybrid serving needs fitted centroids "
+                             "(fit_gateway_centroids)")
+        if max_bucket < 1:
+            raise ValueError(f"max_bucket must be >= 1, got {max_bucket}")
+        self.model = model
+        self.model_type = model_type
+        # device-resident once at load time (checkpoint loads arrive as
+        # numpy, which a traced gather could not index)
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.centroids = (None if centroids is None
+                          else jax.tree.map(jnp.asarray, centroids))
+        self.multi_tenant = multi_tenant
+        self.max_bucket = 1 << (max_bucket - 1).bit_length()  # round up pow2
+        self.num_gateways = (
+            jax.tree.leaves(params)[0].shape[0] if multi_tenant else 1)
+        self.dim = int(model.input_dim)
+        self._score_fn: Optional[Any] = None
+        self.dispatches: collections.Counter = collections.Counter()
+
+    # ------------------------- compiled programs ------------------------- #
+
+    @property
+    def buckets(self):
+        """Every static row bucket this engine compiles (powers of two)."""
+        out, b = [], 1
+        while b <= self.max_bucket:
+            out.append(b)
+            b <<= 1
+        return out
+
+    def bucket_for(self, n_rows: int) -> int:
+        """Smallest power-of-two bucket holding n_rows (<= max_bucket)."""
+        if n_rows > self.max_bucket:
+            raise ValueError(f"{n_rows} rows exceed max_bucket "
+                             f"{self.max_bucket}; chunk first")
+        return 1 << max(0, n_rows - 1).bit_length()
+
+    def _build_scorer(self):
+        model, model_type = self.model, self.model_type
+        params, centroids = self.params, self.centroids
+
+        if self.multi_tenant:
+            def score_rows(x, gw):
+                # per-row gateway routing: gather each row's model (and
+                # centroid) out of the stacked federation pytree
+                row_params = jax.tree.map(lambda t: t[gw], params)
+                if model_type == "autoencoder":
+                    def one(p, xi):
+                        _, recon = model.apply({"params": p}, xi)
+                        return per_sample_mse(xi, recon)
+                    scores = jax.vmap(one)(row_params, x)
+                else:
+                    row_cens = jax.tree.map(lambda t: t[gw], centroids)
+                    def one(p, c, xi):
+                        latent, _ = model.apply({"params": p}, xi)
+                        return c.get_density(latent)
+                    scores = jax.vmap(one)(row_params, row_cens, x)
+                # the evaluator's guard (evaluator.py eval_one) rides along
+                return jnp.nan_to_num(scores)
+        else:
+            def score_rows(x, gw):
+                del gw  # single-global: every row scores under one model
+                latent, recon = model.apply({"params": params}, x)
+                if model_type == "autoencoder":
+                    scores = per_sample_mse(x, recon)
+                else:
+                    scores = centroids.get_density(latent)
+                return jnp.nan_to_num(scores)
+
+        return jax.jit(score_rows)
+
+    def _scorer(self):
+        # ONE jitted function serves every bucket: jax.jit keys its compile
+        # cache on the input shape, so each power-of-two row count gets its
+        # own executable while the Python-side wrapper stays shared
+        if self._score_fn is None:
+            self._score_fn = self._build_scorer()
+        return self._score_fn
+
+    def warmup(self) -> None:
+        """Compile every bucket program ahead of traffic (the first real
+        request must not pay tens of seconds of XLA compile)."""
+        fn = self._scorer()
+        for b in self.buckets:
+            jax.block_until_ready(fn(jnp.zeros((b, self.dim), jnp.float32),
+                                     jnp.zeros((b,), jnp.int32)))
+
+    # ----------------------------- scoring ------------------------------ #
+
+    def score(self, x, gateway_ids=None) -> np.ndarray:
+        """Anomaly scores [B] for rows `x` [B, D] (a single row [D]
+        returns its scalar score).
+
+        `gateway_ids` ([B] int, or a scalar) routes each row to its
+        gateway's model — REQUIRED on the multi-tenant path (defaulting
+        would silently score every row under gateway 0's model); ignored
+        (and optional) on the single-global path. Requests pad up to the
+        next bucket; oversize requests are chunked at max_bucket.
+        """
+        x = np.asarray(x, dtype=np.float32)
+        squeeze = x.ndim == 1
+        if squeeze:
+            x = x[None, :]
+        n = x.shape[0]
+        if gateway_ids is None:
+            if self.multi_tenant:
+                raise ValueError(
+                    "multi-tenant engine: pass gateway_ids so each row is "
+                    "routed to its gateway's model")
+            gw = np.zeros(n, np.int32)
+        else:
+            gw = np.broadcast_to(
+                np.asarray(gateway_ids, np.int32), (n,)).copy()
+            if self.multi_tenant and n and (
+                    gw.min() < 0 or gw.max() >= self.num_gateways):
+                raise ValueError(
+                    f"gateway ids must be in [0, {self.num_gateways}); "
+                    f"got range [{gw.min()}, {gw.max()}]")
+        out = np.empty(n, np.float32)
+        start = 0
+        while start < n:
+            take = min(self.max_bucket, n - start)
+            b = self.bucket_for(take)
+            # fresh buffers per dispatch — nothing retains them host-side
+            xp = np.zeros((b, self.dim), np.float32)
+            xp[:take] = x[start:start + take]
+            gp = np.zeros(b, np.int32)
+            gp[:take] = gw[start:start + take]
+            s = np.asarray(self._scorer()(jnp.asarray(xp), jnp.asarray(gp)))
+            out[start:start + take] = s[:take]
+            self.dispatches[b] += 1
+            start += take
+        return out[0] if squeeze else out
+
+    # --------------------------- constructors ---------------------------- #
+
+    @classmethod
+    def from_federation(cls, model, model_type: str, stacked_params,
+                        train_x=None, train_m=None, **kw) -> "ServingEngine":
+        """Multi-tenant engine straight from an in-memory training result
+        (`engine.states.params`). Hybrid needs the training rows (the
+        FederatedData train_xb/train_mb slices) to fit the centroids."""
+        centroids = None
+        if model_type == "hybrid":
+            if train_x is None:
+                raise ValueError("hybrid serving needs train rows to fit "
+                                 "the per-gateway centroids")
+            centroids = fit_gateway_centroids(model, stacked_params,
+                                              train_x, train_m)
+        return cls(model, model_type, stacked_params, centroids,
+                   multi_tenant=True, **kw)
+
+    @classmethod
+    def from_checkpoint(cls, writer, model, model_type: str,
+                        update_type: str, device_names, run: int = 0,
+                        train_x=None, train_m=None, **kw) -> "ServingEngine":
+        """Multi-tenant engine from the reference-layout ClientModel tree
+        (`checkpointing.io.save_client_models`' model.npz per device)."""
+        from fedmse_tpu.checkpointing.io import load_client_models
+        from fedmse_tpu.models.autoencoder import init_client_params
+
+        template = init_client_params(model, jax.random.key(0))
+        params = load_client_models(writer, run, model_type, update_type,
+                                    device_names, template)
+        return cls.from_federation(model, model_type, params,
+                                   train_x=train_x, train_m=train_m, **kw)

@@ -156,6 +156,36 @@ def test_time_metric_excludes_compilation():
     assert t_steady * 5 < wall
 
 
+def test_evaluate_all_time_warmup_keeps_compile_out_of_latency():
+    """Regression pin for the latency_all warmup (evaluator.py: the jitted
+    scorer's first call pays tracing + XLA compilation; the warmup call
+    absorbs it so the measured per-client latencies are steady-state).
+    First-call cost vs steady-state must differ by far more than 10x, so
+    if the warmup ever regresses, the returned latencies jump by orders
+    of magnitude and the wall/steady ratio here collapses below the bar."""
+    import time as _time
+    model = make_model("hybrid", DIM, shrink_lambda=1.0)
+    params = init_stacked_params(model, jax.random.key(6), 2)
+    # distinctive shapes: this program must not be pre-compiled (in this
+    # process) by another test
+    data = _data(n_clients=2, t=407, s=61, seed=6)
+    latency_all = make_evaluate_all(model, "hybrid", metric="time")
+    t0 = _time.perf_counter()
+    lat = np.asarray(latency_all(params, *data))
+    wall = _time.perf_counter() - t0
+    assert lat.shape == (2,) and np.all(lat > 0)
+    # wall includes the first (warmup/compile) call plus 2 clients x
+    # latency_reps steady passes; steady state at this size is sub-ms
+    # while tracing+compile alone is tens of ms even on a warm disk
+    # cache — the >10x gap is what the warmup preserves
+    assert wall > 10 * lat.max(), (wall, lat)
+    # and the reported latencies are absolutely steady-state-sized: one
+    # pass at this size is sub-ms; tracing + compile alone is tens of ms,
+    # so a latency that accidentally included the first call would blow
+    # far past this bound
+    assert lat.max() < 0.05, lat
+
+
 def test_evaluate_all_time_metric_per_client():
     """The vectorized evaluator's 'time' mode returns one steady-state
     latency per client (reference evaluator.py:99-108 had no vectorized

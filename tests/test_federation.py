@@ -322,6 +322,45 @@ def test_verify_hardened_recovery_path(model):
                                rtol=1e-6)
 
 
+def test_verify_hardened_recovery_waiver_is_delta_capped(model):
+    """ADVICE r5 #1: the recovery waiver WIDENS the Frobenius step cap
+    (recovery_delta_cap, default 10x verification_threshold), it does not
+    lift it. The same trashed-aggregator scenario the recovery path
+    accepts under the default ceiling must be rejected when the ceiling
+    sits below the broadcast's delta — a big perf improvement alone no
+    longer buys an arbitrarily large parameter step."""
+    rng = np.random.default_rng(11)
+    xv = jnp.asarray(rng.normal(size=(16, DIM)).astype(np.float32))
+    trained = _trained_params(model, xv)
+    states = _mk_states(model)
+    params = jax.tree.map(
+        lambda t: jnp.broadcast_to(t, (4,) + t.shape), trained)
+    params = jax.tree.map(lambda t: t.at[0].set(0.0), params)
+    states = dataclasses.replace(
+        states, params=params,
+        hist_seen=jnp.asarray([True, True, True, True]))
+    ver_x = jnp.broadcast_to(xv, (4,) + xv.shape)
+    ver_m = jnp.ones((4, 16))
+    onehot = jnp.asarray([0.0, 0, 0, 1])
+
+    # ceiling below the zero->trained distance (~19): client 0's recovery
+    # is refused even though its perf improves far beyond the margin ...
+    tight = make_verify_fn(model, verification_threshold=3.0,
+                           performance_threshold=0.002, hardened=True,
+                           recovery_delta_cap=1.0)
+    out = tight(states, trained, ver_x, ver_m, onehot, jnp.ones(4))
+    assert np.asarray(out.perf_change)[0] > 0.1  # waiver precondition held
+    assert np.asarray(out.param_delta)[0] > 1.0
+    assert np.asarray(out.accepted).tolist() == [False, True, True, True]
+
+    # ... while the default ceiling (10x threshold = 30) clears it
+    default = make_verify_fn(model, verification_threshold=3.0,
+                             performance_threshold=0.002, hardened=True)
+    out2 = default(states, trained, ver_x, ver_m, onehot, jnp.ones(4))
+    assert np.asarray(out2.param_delta)[0] < 30.0
+    assert np.asarray(out2.accepted).tolist() == [True, True, True, True]
+
+
 def test_verify_hardened_marginal_improvement_does_not_waive_cap(model):
     """The recovery waiver requires a LARGE improvement (recovery_threshold,
     default 0.1), not the 0.002 noise threshold: a far-away model that

@@ -1,0 +1,327 @@
+"""Serving subsystem tests: engine/evaluator score parity across every
+bucket, checkpoint round-trip, calibration semantics, micro-batcher
+flush/accounting behavior, and drift detection (fedmse_tpu/serving/)."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.checkpointing import (ResultsWriter, load_client_models,
+                                      save_client_models)
+from fedmse_tpu.evaluation import make_evaluate_all
+from fedmse_tpu.models import (init_client_params, init_stacked_params,
+                               make_model)
+from fedmse_tpu.serving import (DriftMonitor, MicroBatcher, ServingCalibration,
+                                ServingEngine, fit_calibration,
+                                fit_gateway_centroids)
+
+pytestmark = pytest.mark.serve
+
+DIM = 12
+N = 3
+
+
+def _data(seed=0, t=90):
+    rng = np.random.default_rng(seed)
+    test_x = rng.normal(size=(N, t, DIM)).astype(np.float32)
+    test_m = (rng.random((N, t)) < 0.9).astype(np.float32)
+    test_y = (rng.random((N, t)) < 0.4).astype(np.float32)
+    train_xb = rng.normal(size=(N, 6, 10, DIM)).astype(np.float32)
+    train_mb = np.ones((N, 6, 10), np.float32)
+    return test_x, test_m, test_y, train_xb, train_mb
+
+
+def _engine(model_type, params=None, max_bucket=16, seed=0, **kw):
+    model = make_model(model_type, DIM, shrink_lambda=1.0)
+    if params is None:
+        params = init_stacked_params(model, jax.random.key(seed), N)
+    data = _data(seed)
+    eng = ServingEngine.from_federation(
+        model, model_type, params, train_x=data[3], train_m=data[4],
+        max_bucket=max_bucket, **kw)
+    return model, params, data, eng
+
+
+# ----------------------- evaluator score parity ----------------------- #
+
+@pytest.mark.parametrize("model_type", ["autoencoder", "hybrid"])
+def test_served_scores_match_evaluator_across_every_bucket(model_type, tmp_path):
+    """Acceptance pin: served scores for a CHECKPOINTED federation equal
+    make_evaluate_all's scores (metric='scores' oracle) to float32
+    tolerance, for every bucket size — i.e. at every padded-row count —
+    so bucket padding provably never perturbs real rows."""
+    model, params, data, _ = _engine(model_type)
+    test_x, test_m, test_y, train_xb, train_mb = data
+    oracle = np.asarray(make_evaluate_all(model, model_type,
+                                          metric="scores")(
+        params, test_x, test_m, test_y, train_xb, train_mb))
+
+    # round-trip through the reference ClientModel layout: the serving
+    # process loads params from disk, exactly like a deployment would
+    writer = ResultsWriter(str(tmp_path), N, "exp", "FL-IoT", "AUC", 0.5)
+    names = [f"Client-{k}" for k in range(1, N + 1)]
+    save_client_models(writer, 0, model_type, "mse_avg", names, params)
+    eng = ServingEngine.from_checkpoint(
+        writer, model, model_type, "mse_avg", names, run=0,
+        train_x=train_xb, train_m=train_mb, max_bucket=16)
+
+    for g in range(N):
+        # every bucket (1, 2, 4, 8, 16) and both off-by-one neighbors:
+        # each request pads up to the next power of two, so real rows sit
+        # next to zero padding in every dispatch
+        for n_rows in (1, 2, 3, 4, 5, 7, 8, 9, 15, 16):
+            got = eng.score(test_x[g, :n_rows], g)
+            np.testing.assert_allclose(got, oracle[g, :n_rows], atol=1e-5,
+                                       err_msg=f"{model_type} g={g} n={n_rows}")
+    # oversize requests chunk at max_bucket and still agree
+    got = eng.score(test_x[0, :37], 0)
+    np.testing.assert_allclose(got, oracle[0, :37], atol=1e-5)
+    assert sorted(eng.dispatches) == [1, 2, 4, 8, 16]
+
+
+def test_multi_tenant_routing_matches_per_gateway_single_global():
+    """Per-row gather routing == running each gateway's model alone: a
+    mixed-gateway batch must score every row under ITS OWN model."""
+    model, params, data, eng = _engine("hybrid")
+    test_x = data[0]
+    rng = np.random.default_rng(3)
+    gws = rng.integers(0, N, size=24).astype(np.int32)
+    rows = np.stack([test_x[g, i] for i, g in enumerate(gws)])
+    got = eng.score(rows, gws)
+
+    cens = fit_gateway_centroids(model, params, data[3], data[4])
+    for g in range(N):
+        single = ServingEngine(
+            model, "hybrid", jax.tree.map(lambda t: t[g], params),
+            jax.tree.map(lambda t: t[g], cens), multi_tenant=False,
+            max_bucket=16)
+        sel = gws == g
+        np.testing.assert_allclose(got[sel], single.score(rows[sel]),
+                                   atol=1e-5)
+
+
+def test_checkpoint_roundtrip_is_exact():
+    model = make_model("hybrid", DIM, shrink_lambda=1.0)
+    params = init_stacked_params(model, jax.random.key(3), N)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        writer = ResultsWriter(d, N, "exp", "FL-IoT", "AUC", 0.5)
+        names = [f"Client-{k}" for k in range(1, N + 1)]
+        save_client_models(writer, 0, "hybrid", "avg", names, params)
+        loaded = load_client_models(writer, 0, "hybrid", "avg", names,
+                                    init_client_params(model,
+                                                       jax.random.key(0)))
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_engine_rejects_bad_gateway_and_missing_centroids():
+    model, params, data, eng = _engine("autoencoder")
+    with pytest.raises(ValueError, match="gateway ids"):
+        eng.score(data[0][0, :4], N + 7)
+    with pytest.raises(ValueError, match="gateway_ids"):
+        eng.score(data[0][0, :4])  # multi-tenant: routing must be explicit
+    with pytest.raises(ValueError, match="centroids"):
+        ServingEngine(model, "hybrid", params, None)
+
+
+# ----------------------------- calibration ---------------------------- #
+
+def test_calibration_thresholds_and_verdict_rate(tmp_path):
+    model, params, data, eng = _engine("hybrid")
+    rng = np.random.default_rng(5)
+    valid_x = rng.normal(size=(N, 200, DIM)).astype(np.float32)
+    valid_m = np.ones((N, 200), np.float32)
+    valid_m[2, 150:] = 0.0  # ragged gateway
+    cal = fit_calibration(eng, valid_x, valid_m, percentile=90.0)
+    assert cal.count.tolist() == [200, 200, 150]
+    for g in range(N):
+        rows = valid_x[g][valid_m[g] > 0]
+        scores = eng.score(rows, g)
+        # threshold IS the requested percentile of the calibration scores
+        assert cal.thresholds[g] == pytest.approx(
+            np.percentile(scores, 90.0), rel=1e-6)
+        # detector semantics: ~10% of calibration normals exceed it
+        rate = float(np.mean(cal.verdicts(scores, g)))
+        assert rate == pytest.approx(0.10, abs=0.02)
+
+    # persistence round-trip next to the checkpoint tree
+    path = cal.save(os.path.join(str(tmp_path), "calibration.json"))
+    back = ServingCalibration.load(path)
+    np.testing.assert_allclose(back.thresholds, cal.thresholds)
+    np.testing.assert_allclose(back.mean, cal.mean)
+    np.testing.assert_allclose(back.std, cal.std)
+    assert back.count.tolist() == cal.count.tolist()
+    assert back.percentile == 90.0 and back.model_type == "hybrid"
+
+
+def test_calibration_empty_gateway_never_flags(tmp_path):
+    model, params, data, eng = _engine("autoencoder")
+    valid_x = np.random.default_rng(6).normal(
+        size=(N, 20, DIM)).astype(np.float32)
+    valid_m = np.ones((N, 20), np.float32)
+    valid_m[1] = 0.0  # gateway 1 has no validation rows
+    cal = fit_calibration(eng, valid_x, valid_m)
+    assert cal.count[1] == 0 and not np.isfinite(cal.thresholds[1])
+    scores = eng.score(valid_x[1], 1)
+    assert not cal.verdicts(scores, 1).any()  # +inf threshold: never flags
+    # inf round-trips JSON as null
+    path = cal.save(os.path.join(str(tmp_path), "c.json"))
+    assert json.load(open(path))["thresholds"][1] is None
+    assert not np.isfinite(ServingCalibration.load(path).thresholds[1])
+
+
+# ----------------------------- micro-batcher --------------------------- #
+
+def test_batcher_flushes_on_max_batch_and_preserves_order():
+    model, params, data, eng = _engine("autoencoder")
+    test_x = data[0]
+    b = MicroBatcher(eng, max_batch=8, max_wait_ms=1e9)
+    tickets = [b.submit(test_x[0, i], 0) for i in range(19)]
+    assert [t.done for t in tickets[:16]] == [True] * 16  # two full batches
+    assert not tickets[16].done  # tail pending
+    assert b.drain() == 3
+    want = eng.score(test_x[0, :19], 0)
+    got = np.asarray([t.score for t in tickets])
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    assert list(b.dispatch_batch_sizes) == [8, 8, 3]
+    stats = b.stats()
+    assert stats["rows_served"] == 19 and stats["dispatches"] == 3
+    assert stats["latency_p99_ms"] >= stats["latency_p50_ms"] > 0
+    assert stats["rows_per_sec_service"] > 0
+
+
+def test_batcher_flushes_on_max_wait_with_injected_clock():
+    model, params, data, eng = _engine("autoencoder")
+    now = [0.0]
+    b = MicroBatcher(eng, max_batch=16, max_wait_ms=5.0,
+                     clock=lambda: now[0])
+    t0 = b.submit(data[0][0, 0], 0)
+    now[0] = 0.004
+    b.submit(data[0][0, 1], 0)
+    assert not t0.done          # window not expired
+    assert not b.poll()
+    now[0] = 0.006              # oldest row is 6 ms old
+    assert b.poll()
+    assert t0.done and t0.latency_s == pytest.approx(0.006)
+    # a submit after expiry flushes the stale window BEFORE enqueueing
+    b.submit(data[0][0, 2], 0)
+    now[0] = 0.020
+    t3 = b.submit(data[0][0, 3], 0)
+    assert not t3.done and b.dispatch_batch_sizes[-1] == 1
+
+
+def test_batcher_verdicts_and_drift_wiring():
+    model, params, data, eng = _engine("hybrid")
+    valid_x = np.random.default_rng(8).normal(
+        size=(N, 100, DIM)).astype(np.float32)
+    cal = fit_calibration(eng, valid_x, percentile=95.0)
+    dm = DriftMonitor(cal, min_count=5)
+    b = MicroBatcher(eng, max_batch=16, max_wait_ms=1e9, calibration=cal,
+                     drift=dm)
+    tickets = [b.submit(valid_x[1, i], 1) for i in range(32)]
+    assert all(t.done and t.verdict is not None for t in tickets)
+    assert dm.count[1] == 32 and dm.count[0] == 0
+    assert b.stats()["mean_batch"] == 16.0
+
+
+def test_batcher_rejects_batch_beyond_engine_bucket():
+    model, params, data, eng = _engine("autoencoder", max_bucket=8)
+    with pytest.raises(ValueError, match="max_bucket"):
+        MicroBatcher(eng, max_batch=32)
+
+
+# -------------------------------- drift -------------------------------- #
+
+def test_drift_welford_matches_numpy_and_flags_shifted_gateway():
+    model, params, data, eng = _engine("hybrid")
+    rng = np.random.default_rng(9)
+    valid_x = rng.normal(size=(N, 300, DIM)).astype(np.float32)
+    cal = fit_calibration(eng, valid_x)
+    dm = DriftMonitor(cal, z_threshold=3.0, min_count=30)
+
+    # in-distribution traffic, streamed in uneven batches
+    live = rng.normal(size=(N, 120, DIM)).astype(np.float32)
+    all_scores = {g: [] for g in range(N)}
+    for start, stop in ((0, 7), (7, 40), (40, 120)):
+        for g in range(N):
+            s = eng.score(live[g, start:stop], g)
+            dm.update(s, np.full(stop - start, g))
+            all_scores[g].append(s)
+    for g in range(N):
+        ref = np.concatenate(all_scores[g]).astype(np.float64)
+        assert dm.count[g] == 120
+        assert dm.mean[g] == pytest.approx(float(np.mean(ref)), rel=1e-9)
+        assert dm.live_std()[g] == pytest.approx(float(np.std(ref)),
+                                                 rel=1e-9)
+    assert dm.drifted().tolist() == [False, False, False]
+
+    # gateway 0's traffic shifts far from the calibration distribution
+    shifted = live[0, :60] + 5.0
+    dm.update(eng.score(shifted, 0), np.zeros(60))
+    assert dm.drifted().tolist() == [True, False, False]
+    rep = dm.report()
+    assert rep["drifted_gateways"] == [0]
+    assert rep["gateways"][0]["shift_sigmas"] > 3.0
+    json.dumps(rep)  # report is JSON-safe
+
+
+def test_drift_respects_min_count_and_uncalibrated_gateways():
+    model, params, data, eng = _engine("autoencoder")
+    valid_x = np.random.default_rng(10).normal(
+        size=(N, 50, DIM)).astype(np.float32)
+    valid_m = np.ones((N, 50), np.float32)
+    valid_m[2] = 0.0  # gateway 2 uncalibrated
+    cal = fit_calibration(eng, valid_x, valid_m)
+    dm = DriftMonitor(cal, z_threshold=3.0, min_count=30)
+    far = valid_x[0, :10] + 50.0
+    dm.update(eng.score(far, 0), np.zeros(10))       # huge shift, 10 rows
+    dm.update(eng.score(far, 2) * 0 + 1e9, np.full(10, 2))
+    assert dm.drifted().tolist() == [False, False, False]  # under min_count
+    dm.update(eng.score(far, 0), np.zeros(10))
+    dm.update(eng.score(far, 0), np.zeros(10))
+    drifted = dm.drifted()
+    assert drifted[0] and not drifted[2]  # count met vs uncalibrated
+    assert not dm.report()["gateways"][2]["calibrated"]
+
+
+# ------------------------------ driver wiring --------------------------- #
+
+def test_cli_serve_smoke(tmp_path):
+    """--serve: train -> checkpoint -> calibrate -> serve -> drift report
+    through the real CLI driver (the acceptance pipeline, tiny scale)."""
+    from fedmse_tpu.config import DatasetConfig
+    from fedmse_tpu.main import main as cli_main
+    from tests.test_data import _write_client_csvs
+
+    root = str(tmp_path / "shards")
+    _write_client_csvs(root, 4, dim=6, n_normal=60, n_abnormal=24)
+    cfg_path = os.path.join(root, "config.json")
+    with open(cfg_path, "w") as f:
+        json.dump(DatasetConfig.for_client_dirs(root, 4).to_json(), f)
+    out = cli_main([
+        "--dataset-config", cfg_path,
+        "--model-types", "hybrid", "--update-types", "mse_avg",
+        "--network-size", "4", "--dim-features", "6",
+        "--epochs", "1", "--num-rounds", "1", "--batch-size", "8",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--experiment-name", "serve-t", "--serve", "--serve-rows", "256",
+    ])
+    smoke = out["serve_smoke"]
+    assert smoke["rows"] > 0
+    assert smoke["batcher"]["rows_served"] == smoke["rows"]
+    assert smoke["batcher"]["latency_p99_ms"] > 0
+    assert 0.0 <= smoke["verdict_anomaly_rate"] <= 1.0
+    assert os.path.exists(smoke["calibration_path"])
+    # calibration landed in the Serving tree beside ClientModel
+    assert glob.glob(os.path.join(
+        str(tmp_path / "ckpt"), "4", "serve-t", "0", "Serving", "*",
+        "*_calibration.json"))
+    assert isinstance(smoke["drift"]["drifted_gateways"], list)
+    json.dumps(smoke)  # the whole report is JSON-safe

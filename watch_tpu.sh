@@ -10,13 +10,19 @@
 # round-tagged name, and commits them.
 #
 # Usage: setsid nohup bash watch_tpu.sh [-o OUTDIR] [-d DEADLINE_S] \
-#            [-s STEP,STEP,...] [-r ROUNDTAG] &
+#            [-s STEP,STEP,...] [-r ROUNDTAG] [-m MAX_STEP_S] &
 #   -o  scratch dir for step stdout/stderr   (default /tmp/tpu_capture_r05)
 #   -d  give up this many seconds from now   (default 39600 = 11 h)
 #   -s  battery steps, comma-separated, run in the order given
 #       (default: check,quick,paper,suite,c200,c500,c25,c50,c100,profile,ab
 #        — capture-debt items first so a short window still pays them)
 #   -r  artifact round tag                   (default r05)
+#   -m  base per-step timeout in seconds     (default 1800). Scaled per
+#       step (step_scale below): the long captures — paper, suite,
+#       profile — get 3x, the big scaling points (c200/c500) 2x, so a
+#       congested-window capture is not killed at a flat 30 min and
+#       silently lost (ADVICE r5 #4). The -d deadline clamp ALWAYS wins:
+#       no step may hold the device past the window end.
 #
 # Coordination:
 #   /tmp/fedmse_box_lock       — atomic mkdir lock shared with CPU-heavy
@@ -31,14 +37,15 @@
 #   /tmp/fedmse_tpu_capturing  — observability flag while the battery runs
 set -u
 cd "$(dirname "$0")"
-OUT=/tmp/tpu_capture_r05; DEADLINE_IN=39600; TAG=r05
+OUT=/tmp/tpu_capture_r05; DEADLINE_IN=39600; TAG=r05; MAX_STEP_S=1800
 STEPS=check,quick,paper,suite,c200,c500,c25,c50,c100,profile,ab
-while getopts "o:d:s:r:" opt; do
+while getopts "o:d:s:r:m:" opt; do
     case $opt in
         o) OUT=$OPTARG ;;
         d) DEADLINE_IN=$OPTARG ;;
         s) STEPS=$OPTARG ;;
         r) TAG=$OPTARG ;;
+        m) MAX_STEP_S=$OPTARG ;;
         *) exit 2 ;;
     esac
 done
@@ -59,6 +66,14 @@ step_cmd() {  # step name -> capture command
         *)       echo "" ;;
     esac
 }
+step_scale() {  # step name -> per-step multiplier on the -m base timeout
+    case $1 in
+        paper|suite|profile) echo 3 ;;  # long captures: paper schedule,
+                                        # full suite, chunk-sweep profile
+        c200|c500)           echo 2 ;;  # big scaling points
+        *)                   echo 1 ;;
+    esac
+}
 step_dest() {  # step name -> landed artifact name ("" = tool writes in-repo)
     case $1 in
         check)   echo "" ;;  # tpu_check.py writes TPU_CHECK.json itself —
@@ -73,15 +88,18 @@ step_dest() {  # step name -> landed artifact name ("" = tool writes in-repo)
 }
 
 run() {  # run <name> <cmd...>: log, never abort the battery on one failure.
-    # Per-step timeout is clamped to the time left before DEADLINE so the
-    # watcher NEVER holds the device past -d (the driver's own end-of-round
-    # bench needs it — round 3 lost its capture to exactly that race).
+    # Per-step timeout = MAX_STEP_S x step_scale(step), then clamped to the
+    # time left before DEADLINE so the watcher NEVER holds the device past
+    # -d (the driver's own end-of-round bench needs it — round 3 lost its
+    # capture to exactly that race). The deadline clamp is the only
+    # non-negotiable bound; the per-step cap is operator policy (-m).
     local name=$1; shift
     local left=$(( DEADLINE - $(date +%s) ))
     if [ "$left" -le 60 ]; then
         echo "=== $name skipped: deadline" >> "$LOG"; return 1
     fi
-    [ "$left" -gt 1800 ] && left=1800
+    local cap=$(( MAX_STEP_S * $(step_scale "$name") ))
+    [ "$left" -gt "$cap" ] && left=$cap
     echo "=== $name: $* ($(date +%H:%M:%S), timeout ${left}s)" >> "$LOG"
     if timeout "$left" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"; then
         echo "--- $name ok" >> "$LOG"
