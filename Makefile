@@ -8,7 +8,7 @@ CXXFLAGS ?= -O3 -fPIC -Wall -Wextra
 LIB := fedmse_tpu/native/libfedmse_io.so
 
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
-        serve-bench tpu-check
+        serve-bench chaos-sweep tpu-check
 
 native: $(LIB)
 
@@ -36,6 +36,13 @@ bench-suite:
 # dispatch (writes BENCH_SERVE_pr02_cpu.json; hermetic CPU like the tests)
 serve-bench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python bench_serve.py
+
+# resilience operating-point sweep (fedmse_tpu/chaos/): dropout x
+# aggregator-crash grid + attack-composition and burst-recovery rows
+# (writes CHAOS_r06.json; hermetic CPU like the tests)
+chaos-sweep:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python chaos_sweep.py --out CHAOS_r06.json
 
 tpu-check:
 	python tpu_check.py

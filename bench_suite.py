@@ -1,11 +1,14 @@
-"""Benchmark the five BASELINE.json scenario configs on the live backend.
+"""Benchmark the BASELINE.json scenario configs on the live backend.
 
-BASELINE.json `configs` is the judge's scenario checklist:
+BASELINE.json `configs` is the judge's scenario checklist (6-7 are
+repo-grown axes):
   1. scen2-nba-iot-10clients, 1 client only, Shrink-AE local train (epoch=5)
   2. scen2-nba-iot-10clients full P2P FedMSE, 50% participation, 20 rounds
   3. FedAvg baseline aggregation (same 10-client N-BaIoT, MSE-weighting off)
   4. Kitsune-Network-Attack-Dataset non-IID clients (SAE hybrid)
   5. 50-client scaled N-BaIoT, num_participants=0.2, 50 rounds
+  6. batched multi-run sweeps, R in {1, 3, 10} (federation/batched.py)
+  7. chaos churn: 30% dropout + aggregator-crash p=0.1 (fedmse_tpu/chaos/)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -33,8 +36,13 @@ def _federation(cfg, dataset):
     return build_data(cfg, dataset=dataset)
 
 
-def _run_rounds(cfg, dataset, model_type, update_type, timed_rounds):
-    """Timed fused-scan rounds + final mean AUC (warmup run compiles)."""
+def _run_rounds(cfg, dataset, model_type, update_type, timed_rounds,
+                chaos=None):
+    """Timed fused-scan rounds + final mean AUC (warmup run compiles).
+    `chaos` (a ChaosSpec) compiles fault injection into the schedule —
+    scenario 7 measures the churn regime (fedmse_tpu/chaos/). The trailing
+    `results` return carries the raw RoundResults for scenario-specific
+    post-processing (scenario 7's resilience metrics)."""
     import numpy as np
     from fedmse_tpu.federation import RoundEngine
     from fedmse_tpu.models import make_model
@@ -44,7 +52,7 @@ def _run_rounds(cfg, dataset, model_type, update_type, timed_rounds):
                        shrink_lambda=cfg.shrink_lambda)
     engine = RoundEngine(model, cfg, data, n_real=n_real, rngs=rngs,
                          model_type=model_type, update_type=update_type,
-                         fused=True)
+                         fused=True, chaos=chaos)
     # compile + warm through the SAME chunked dispatch split the timed
     # passes use, so the chunk program and any remainder program are both
     # hot before timing (a whole-schedule warm-up here would leave the
@@ -55,7 +63,7 @@ def _run_rounds(cfg, dataset, model_type, update_type, timed_rounds):
     sec, results = _min_over_reps(
         lambda: _timed_pass(engine, True, timed_rounds))
     curve = [round(float(np.nanmean(r.client_metrics)), 5) for r in results]
-    return sec, curve[-1], n_real, curve
+    return sec, curve[-1], n_real, curve, results
 
 
 def scen_single_client():
@@ -109,6 +117,31 @@ def scen_single_client():
             "sec_per_5_epochs": round(sec, 4), "auc": round(float(auc), 5)}
 
 
+def scen_chaos_churn(cfg, dataset):
+    """Scenario 7: the churn regime (fedmse_tpu/chaos/) — 10-client
+    federation under 30% per-round client dropout + aggregator-crash
+    p=0.1, faults compiled into the fused schedule as mask tensors.
+    Reports round cost (the chaos plumbing must not de-fuse the dispatch)
+    plus the resilience bundle (chaos/metrics.py)."""
+    from fedmse_tpu.chaos import ChaosSpec, resilience_metrics
+
+    sec, _, _, _, results = _run_rounds(
+        cfg, dataset, "hybrid", "mse_avg", timed_rounds=20,
+        chaos=ChaosSpec(dropout_p=0.3, crash_p=0.1))
+    mets = resilience_metrics(results)
+    return {"scenario": "chaos churn: 10-client, 30% dropout, "
+                        "aggregator-crash p=0.1, 20 rounds",
+            "sec_per_round": round(sec, 4),
+            "final_auc": mets["final_auc"],
+            "effective_participation": mets["effective_participation"],
+            "re_elections": mets["re_elections"],
+            "crash_outages": mets["crash_outages"],
+            "no_aggregator_rounds": mets["no_aggregator_rounds"],
+            "quota_exhaustion_round": mets["quota_exhaustion_round"],
+            "final_divergence_mean": mets["final_divergence_mean"],
+            "auc_curve": mets["auc_curve"]}
+
+
 def scen_batched_runs(cfg, dataset):
     """Scenario 6: sec/sweep for R ∈ {1, 3, 10} quick-run federations,
     runs-axis-batched vs sequential (ISSUE 1: R runs should cost ~1 run on
@@ -124,15 +157,15 @@ def scen_batched_runs(cfg, dataset):
 
 
 def main():
-    only = None  # debug: run a single scenario (1-6)
+    only = None  # debug: run a single scenario (1-7)
     if "--only" in sys.argv:  # validate before the (slow) TPU liveness probe
         idx = sys.argv.index("--only") + 1
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-6")
-        if not 1 <= only <= 6:
-            sys.exit(f"--only expects a scenario number 1-6, got {only}")
+            sys.exit("--only expects a scenario number 1-7")
+        if not 1 <= only <= 7:
+            sys.exit(f"--only expects a scenario number 1-7, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -156,7 +189,7 @@ def main():
         emit(scen_single_client())
 
     if only in (None, 2):
-        sec, auc, _, curve = _run_rounds(ExperimentConfig(), nbaiot10,
+        sec, auc, _, curve, _ = _run_rounds(ExperimentConfig(), nbaiot10,
                                          "hybrid", "mse_avg",
                                          timed_rounds=20)
         emit({"scenario": "full P2P FedMSE, 10-client, 50% participation,"
@@ -171,7 +204,7 @@ def main():
                       "--quick --rounds 20)"})
 
     if only in (None, 3):
-        sec, auc, _, _ = _run_rounds(ExperimentConfig(), nbaiot10,
+        sec, auc, _, _, _ = _run_rounds(ExperimentConfig(), nbaiot10,
                                      "hybrid", "avg", timed_rounds=3)
         emit({"scenario": "FedAvg baseline (MSE-weighting off), "
                           "10-client, 3 rounds",
@@ -179,7 +212,7 @@ def main():
 
     if only in (None, 4):
         kitsune = DatasetConfig.from_json(KITSUNE_CFG)
-        sec, auc, n, _ = _run_rounds(ExperimentConfig(), kitsune,
+        sec, auc, n, _, _ = _run_rounds(ExperimentConfig(), kitsune,
                                      "hybrid", "mse_avg", timed_rounds=3)
         emit({"scenario": f"Kitsune non-IID ({n} trainable clients), "
                           "hybrid + mse_avg, 3 rounds",
@@ -191,7 +224,7 @@ def main():
             os.path.join(REPO_ROOT, "Data", "nbaiot-50clients-iid"), 50)
         cfg50 = ExperimentConfig(network_size=50, num_participants=0.2,
                                  num_rounds=50)
-        sec, auc, _, _ = _run_rounds(cfg50, nbaiot50, "hybrid", "mse_avg",
+        sec, auc, _, _, _ = _run_rounds(cfg50, nbaiot50, "hybrid", "mse_avg",
                                      timed_rounds=50)
         emit({"scenario": "50-client scaled N-BaIoT, 20% participation, "
                           "50 rounds", "sec_per_round": round(sec, 4),
@@ -199,6 +232,9 @@ def main():
 
     if only in (None, 6):
         emit(scen_batched_runs(ExperimentConfig(), nbaiot10))
+
+    if only in (None, 7):
+        emit(scen_chaos_churn(ExperimentConfig(), nbaiot10))
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

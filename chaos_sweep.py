@@ -1,0 +1,164 @@
+"""Resilience operating-point sweep (ISSUE 3): AUC + resilience metrics vs
+client churn x aggregator-crash rate — the mirror of attack_sweep.py for
+the failure axis (fedmse_tpu/chaos/).
+
+The paper's pitch is that a decentralized federation survives missing and
+misbehaving peers; attack_sweep.py measures the MISBEHAVING half (Byzantine
+broadcasts vs the verification defense). This sweep measures the MISSING
+half: for each (dropout_p, crash_p) cell one quick-run federation executes
+with faults compiled into the fused schedule, and chaos/metrics.py turns
+the round stream into effective participation, re-election / crash-outage
+counts, the quota-exhaustion horizon, per-client parameter-divergence
+spread, and final AUC.
+
+Protocol: committed quick-run config (10-client N-BaIoT IID, hybrid SAE-CEN
++ mse_avg), 8 fused rounds, chaos active from round 0. Grid:
+dropout ∈ {0, 0.1, 0.3, 0.5} x aggregator-crash ∈ {0, 0.1}; the (0, 0)
+cell is the clean baseline. Two extra row families close the threat model:
+
+  * composition rows (--attack, default scale-50): Byzantine peers PLUS
+    churn — the strongest cell of the dropout grid re-run under a
+    malicious aggregator, since an attacker who strikes while the cohort
+    is thin is the paper's actual adversary;
+  * burst-recovery rows: a transient zero attack (rounds 1-3, then stop —
+    AttackSpec.stop_round) and a transient full-churn window
+    (ChaosSpec start/stop), each reporting rounds_to_recover: how many
+    post-burst rounds until mean AUC regains its pre-burst best.
+
+Writes CHAOS.json (override with --out) and prints one line per cell.
+Run on CPU: `env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+python chaos_sweep.py` (or `make chaos-sweep`).
+"""
+
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from bench import _ensure_live_backend, build_data  # noqa: E402
+
+ROUNDS = 8
+DROPOUTS = (0.0, 0.1, 0.3, 0.5)
+CRASHES = (0.0, 0.1)
+BURST = (1, 4)  # transient-fault window [start, stop) for the recovery rows
+
+
+def run_cell(cfg, data, n_real, chaos_spec, attack_spec=None, rounds=ROUNDS,
+             burst=None, label=None):
+    from fedmse_tpu.chaos import resilience_metrics
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.federation.attack import make_poison_fn
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    poison = None if attack_spec is None else make_poison_fn(attack_spec)
+    model = make_model("hybrid", cfg.dim_features,
+                       shrink_lambda=cfg.shrink_lambda)
+    engine = RoundEngine(model, cfg, data, n_real=n_real,
+                         rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                         model_type="hybrid", update_type="mse_avg",
+                         fused=True, poison_fn=poison, chaos=chaos_spec)
+    results = engine.run_rounds(0, rounds)
+    burst_kw = ({} if burst is None
+                else {"burst_start": burst[0], "burst_stop": burst[1]})
+    row = {
+        "label": label or "grid",
+        "dropout_p": 0.0 if chaos_spec is None else chaos_spec.dropout_p,
+        "crash_p": 0.0 if chaos_spec is None else chaos_spec.crash_p,
+        "broadcast_loss_p": (0.0 if chaos_spec is None
+                             else chaos_spec.broadcast_loss_p),
+        "attack": (None if attack_spec is None else
+                   f"{attack_spec.kind}-{attack_spec.strength:g}"
+                   f"-s{attack_spec.start_round}"
+                   + ("" if attack_spec.stop_round is None
+                      else f"e{attack_spec.stop_round}")),
+        **resilience_metrics(results, **burst_kw),
+    }
+    return row
+
+
+def main():
+    _ensure_live_backend()
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
+    enable_compilation_cache()
+    capture_provenance()  # pin git state before any timed work
+    import jax
+
+    from fedmse_tpu.chaos import ChaosSpec
+    from fedmse_tpu.config import ExperimentConfig
+    from fedmse_tpu.federation.attack import AttackSpec
+
+    out_path = "CHAOS.json"
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    attack_kind = "scale"
+    if "--attack" in sys.argv:
+        attack_kind = sys.argv[sys.argv.index("--attack") + 1]
+    attack_strength = 50.0
+    if "--attack-strength" in sys.argv:
+        attack_strength = float(
+            sys.argv[sys.argv.index("--attack-strength") + 1])
+
+    cfg = ExperimentConfig()
+    data, n_real, _ = build_data(cfg, 10)
+
+    rows = []
+
+    def emit(row):
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # ---- the dropout x crash grid (clean Byzantine-wise) ----
+    for crash_p in CRASHES:
+        for dropout_p in DROPOUTS:
+            spec = None if (dropout_p == 0 and crash_p == 0) else \
+                ChaosSpec(dropout_p=dropout_p, crash_p=crash_p)
+            emit(run_cell(cfg, data, n_real, spec,
+                          label="baseline" if spec is None else "grid"))
+
+    # ---- composition: Byzantine aggregator PLUS churn (the paper's actual
+    # threat model; round 0 clean to build verification history) ----
+    attack = AttackSpec(kind=attack_kind, strength=attack_strength,
+                        start_round=1)
+    emit(run_cell(cfg, data, n_real, None, attack_spec=attack,
+                  label="attack-only"))
+    emit(run_cell(cfg, data, n_real,
+                  ChaosSpec(dropout_p=0.3, crash_p=0.1),
+                  attack_spec=attack, label="attack+churn"))
+
+    # ---- burst recovery: transient faults, then measure the comeback ----
+    b0, b1 = BURST
+    emit(run_cell(cfg, data, n_real, None,
+                  attack_spec=AttackSpec(kind="zero", start_round=b0,
+                                         stop_round=b1),
+                  rounds=2 * ROUNDS, burst=BURST, label="attack-burst"))
+    emit(run_cell(cfg, data, n_real,
+                  ChaosSpec(dropout_p=0.8, crash_p=0.5, start_round=b0,
+                            stop_round=b1),
+                  rounds=2 * ROUNDS, burst=BURST, label="churn-burst"))
+
+    device = jax.devices()[0]
+    out = {
+        "protocol": f"quick-run 10-client N-BaIoT IID, hybrid+mse_avg, "
+                    f"{ROUNDS} fused rounds (bursts: {2 * ROUNDS}); grid "
+                    f"dropout {list(DROPOUTS)} x crash {list(CRASHES)}, "
+                    f"chaos from round 0; composition rows add a "
+                    f"{attack_kind}-{attack_strength:g} malicious "
+                    f"aggregator from round 1; burst rows inject rounds "
+                    f"[{b0}, {b1}) then stop and report rounds_to_recover "
+                    f"(fedmse_tpu/chaos/metrics.py)",
+        "device": str(device), "platform": device.platform,
+        "rows": rows,
+        **capture_provenance(),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"wrote": out_path, "n_rows": len(rows)}))
+
+
+if __name__ == "__main__":
+    main()

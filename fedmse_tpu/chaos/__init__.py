@@ -1,0 +1,30 @@
+"""Array-native fault injection: client churn, stragglers, aggregator
+crashes, broadcast loss — compiled into the fused round program as
+precomputed per-round mask tensors (DESIGN.md §9).
+
+    from fedmse_tpu.chaos import ChaosSpec
+    engine = RoundEngine(..., fused=True,
+                         chaos=ChaosSpec(dropout_p=0.3, crash_p=0.1))
+
+Composable with the Byzantine attack axis (federation/attack.py) — peers
+that lie AND peers that vanish is the paper's actual threat model
+(chaos_sweep.py sweeps both)."""
+
+from fedmse_tpu.chaos.masks import (ChaosMasks, all_clear_masks,
+                                    make_batched_chaos_masks,
+                                    make_chaos_masks)
+from fedmse_tpu.chaos.metrics import (mean_auc_curve, quota_exhaustion_round,
+                                      resilience_metrics, rounds_to_recover)
+from fedmse_tpu.chaos.spec import ChaosSpec
+
+__all__ = [
+    "ChaosMasks",
+    "ChaosSpec",
+    "all_clear_masks",
+    "make_batched_chaos_masks",
+    "make_chaos_masks",
+    "mean_auc_curve",
+    "quota_exhaustion_round",
+    "resilience_metrics",
+    "rounds_to_recover",
+]

@@ -1,0 +1,101 @@
+"""ChaosSpec -> precomputed per-round mask tensors.
+
+Failure scenarios compile into the fused array program the same way PR 1's
+early-stop freeze mask and the selection schedule do: as SCAN INPUTS, not
+control flow. `make_chaos_masks` expands a spec into `[T, N]`
+availability / straggler / broadcast-loss masks and `[T]` aggregator-crash
+bits; the round body (federation/fused.py) consumes one `[N]`-leaved slice
+per round. The effective cohort becomes `selected ∧ available ∧ ¬straggler`,
+a crash bit triggers the on-device re-election pass, and broadcast-loss
+clients keep their local params via masked selects.
+
+Determinism contract:
+  * round t's draws come from `fold_in(chaos_key, t)` with t the ABSOLUTE
+    round index — masks are invariant to how the driver chunks the schedule
+    (the mid-chunk rewind+replay recomputes identical masks);
+  * the chaos key is the domain-separated stream from
+    `ExperimentRngs.chaos_key()` (utils/seeding.py): drawing masks advances
+    no other stream, so enabling chaos leaves training/eval/selection draws
+    bit-identical;
+  * outside the `[start_round, stop_round)` window every mask is all-clear,
+    and a zero probability never fires (bernoulli(p=0) is identically
+    False) — a null spec's masks are exactly the all-clear constants the
+    zero-chaos equivalence test pins (tests/test_chaos.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.chaos.spec import ChaosSpec
+
+
+class ChaosMasks(NamedTuple):
+    """Per-round fault tensors. As built by `make_chaos_masks` every leaf
+    carries a leading [T] rounds axis (and [T, R] / [T, R, N] from
+    `make_batched_chaos_masks`); `lax.scan` slices one round off the front,
+    so the round body sees `available`/`straggler`/`bcast_drop` as [N] and
+    `crash` as a scalar."""
+
+    available: jax.Array   # f32 1 = client is up this round
+    straggler: jax.Array   # f32 1 = trained but missed the round deadline
+    crash: jax.Array       # bool: the elected aggregator crashes this round
+    bcast_drop: jax.Array  # f32 1 = client misses the aggregated broadcast
+
+
+def all_clear_masks(n_clients: int) -> ChaosMasks:
+    """The no-fault single-round masks (what a null spec draws)."""
+    return ChaosMasks(
+        available=jnp.ones((n_clients,), jnp.float32),
+        straggler=jnp.zeros((n_clients,), jnp.float32),
+        crash=jnp.asarray(False),
+        bcast_drop=jnp.zeros((n_clients,), jnp.float32))
+
+
+def make_chaos_masks(spec: ChaosSpec, chaos_key: jax.Array, start_round: int,
+                     n_rounds: int, n_clients: int) -> ChaosMasks:
+    """Masks for rounds [start_round, start_round + n_rounds), leaves
+    stacked on a leading [T] axis. Pure function of (spec, chaos_key,
+    absolute round index) — reproducible across chunkings and replays."""
+
+    def one_round(t: jax.Array) -> ChaosMasks:
+        k_avail, k_strag, k_crash, k_drop = jax.random.split(
+            jax.random.fold_in(chaos_key, t), 4)
+        in_window = t >= spec.start_round
+        if spec.stop_round is not None:
+            in_window = in_window & (t < spec.stop_round)
+        down = jax.random.bernoulli(k_avail, spec.dropout_p, (n_clients,))
+        strag = jax.random.bernoulli(k_strag, spec.straggler_p, (n_clients,))
+        crash = jax.random.bernoulli(k_crash, spec.crash_p)
+        drop = jax.random.bernoulli(k_drop, spec.broadcast_loss_p,
+                                    (n_clients,))
+        f32 = jnp.float32
+        return ChaosMasks(
+            available=jnp.where(in_window & down, f32(0), f32(1)),
+            straggler=jnp.where(in_window & strag, f32(1), f32(0)),
+            crash=in_window & crash,
+            bcast_drop=jnp.where(in_window & drop, f32(1), f32(0)))
+
+    return jax.vmap(one_round)(
+        jnp.arange(start_round, start_round + n_rounds))
+
+
+def make_batched_chaos_masks(spec: ChaosSpec, chaos_keys, start_round: int,
+                             n_rounds: int, n_clients: int) -> ChaosMasks:
+    """The runs-axis variant: one independent mask stream per run (run r
+    draws from its OWN domain-separated chaos key, exactly what r
+    sequential federations would draw), leaves stacked [T, R, ...] to match
+    the batched scan's xs layout (federation/fused.py
+    make_batched_runs_scan).
+
+    All R streams draw in ONE vmapped dispatch — fold_in/bernoulli are pure
+    per-element, so batching over the key axis preserves each run's stream
+    bit-exactly (the same lever as seeding.batched_run_keys; per-run eager
+    builds would serialize R dispatch chains per chunk on the tunnel)."""
+    per_run = jax.vmap(
+        lambda k: make_chaos_masks(spec, k, start_round, n_rounds,
+                                   n_clients))(jnp.stack(list(chaos_keys)))
+    return jax.tree.map(lambda leaf: jnp.moveaxis(leaf, 0, 1), per_run)

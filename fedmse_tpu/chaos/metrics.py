@@ -1,0 +1,129 @@
+"""Resilience metrics: turn a chaotic schedule's round outputs into the
+numbers the paper's robustness claim actually needs.
+
+Consumes the `RoundResult` stream the engines emit (federation/rounds.py;
+under chaos each result carries the effective cohort, the crashed-and-
+replaced aggregator if any, and the per-client parameter divergence from
+the federation mean — federation/fused.py FusedRoundOut). Everything is
+host-side numpy over tiny per-round arrays; nothing re-enters the device.
+
+Metrics:
+  * effective participation — fraction of the selected cohort that actually
+    contributed (survived dropout + the straggler deadline), per round and
+    averaged;
+  * re-elections — rounds where the elected aggregator crashed and the
+    on-device re-election pass found a replacement, vs crash outages where
+    it could not (no quota-eligible survivor -> no_aggregate round);
+  * no-aggregator rounds + the quota-exhaustion horizon — the first round of
+    a terminal no-aggregator streak: under churn the anti-monopolization
+    quota (max_aggregation_threshold) burns out the eligible pool faster,
+    and past the horizon the federation coasts on local training only;
+  * divergence spread — per-round mean/max of each client's parameter
+    distance to the FEDERATION-mean model (all real clients, not just the
+    round's cohort): broadcast loss and rejected merges leave
+    clients stranded on stale models, and this is the drift the verifier
+    must absorb;
+  * rounds-to-recover — after a fault/attack burst ends (AttackSpec
+    stop_round / ChaosSpec stop_round), how many rounds until mean AUC is
+    back within `eps` of its pre-burst best (None = never recovered).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def mean_auc_curve(results: Sequence) -> List[float]:
+    """Per-round nanmean of the client metric stream (AUC under the default
+    metric; f1 under metric='classification')."""
+    return [float(np.nanmean(r.client_metrics)) for r in results]
+
+
+def rounds_to_recover(curve: Sequence[float], burst_start: int,
+                      burst_stop: int, eps: float = 0.01) -> Optional[int]:
+    """Rounds after `burst_stop` until the curve regains its pre-burst best
+    minus `eps`. 0 = already recovered at the first post-burst round; None =
+    never recovered within the schedule (or no pre-burst rounds exist to
+    define a baseline)."""
+    if burst_start <= 0 or burst_start > len(curve):
+        return None  # no clean prefix -> no baseline to recover to
+    baseline = float(np.nanmax(curve[:burst_start]))
+    for t in range(burst_stop, len(curve)):
+        if curve[t] >= baseline - eps:
+            return t - burst_stop
+    return None
+
+
+def quota_exhaustion_round(results: Sequence) -> Optional[int]:
+    """First round of the TERMINAL no-aggregator streak (None when the
+    schedule's last round still elected someone). Under churn this is the
+    horizon past which the quota-eligible pool never recovers."""
+    horizon = None
+    for r in results:
+        if r.aggregator is None:
+            if horizon is None:
+                horizon = r.round_index
+        else:
+            horizon = None
+    return horizon
+
+
+def resilience_metrics(results: Sequence, burst_start: Optional[int] = None,
+                       burst_stop: Optional[int] = None,
+                       recover_eps: float = 0.01) -> Dict:
+    """The full resilience bundle for one schedule's RoundResult list.
+
+    `burst_start`/`burst_stop` (optional) delimit a transient fault or
+    attack window [start, stop) — typically the ChaosSpec/AttackSpec
+    schedule bounds — and switch on the rounds-to-recover metric."""
+    curve = mean_auc_curve(results)
+    n_rounds = len(results)
+
+    part = []
+    re_elections = 0
+    crash_outages = 0
+    for r in results:
+        if r.effective is not None and r.selected:
+            part.append(len(r.effective) / len(r.selected))
+        if r.crashed_aggregator is not None:
+            if r.aggregator is not None:
+                re_elections += 1   # re-election pass found a replacement
+            else:
+                crash_outages += 1  # crash burned the round (no_aggregate)
+
+    div_mean_curve = [
+        float(np.nanmean(r.divergence)) if r.divergence is not None else None
+        for r in results]
+    div_known = [d for d in div_mean_curve if d is not None]
+
+    out = {
+        "rounds": n_rounds,
+        "effective_participation": (
+            round(float(np.mean(part)), 4) if part else None),
+        "effective_participation_curve": [round(p, 4) for p in part],
+        "re_elections": re_elections,
+        "crash_outages": crash_outages,
+        "no_aggregator_rounds": sum(
+            1 for r in results if r.aggregator is None),
+        "quota_exhaustion_round": quota_exhaustion_round(results),
+        "divergence_mean_curve": [
+            None if d is None else round(d, 5) for d in div_mean_curve],
+        "final_divergence_mean": (
+            round(div_known[-1], 5) if div_known else None),
+        "max_divergence": (
+            round(float(np.nanmax([np.nanmax(r.divergence)
+                                   for r in results
+                                   if r.divergence is not None])), 5)
+            if div_known else None),
+        "auc_curve": [round(v, 5) for v in curve],
+        "final_auc": round(curve[-1], 5) if curve else None,
+    }
+    if burst_start is not None and burst_stop is not None:
+        rec = rounds_to_recover(curve, burst_start, burst_stop,
+                                eps=recover_eps)
+        out["burst"] = {"start": burst_start, "stop": burst_stop,
+                        "recover_eps": recover_eps,
+                        "rounds_to_recover": rec}
+    return out

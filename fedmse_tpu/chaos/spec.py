@@ -1,0 +1,67 @@
+"""Declarative failure-scenario description (mirrors AttackSpec's
+eager-validation idiom, federation/attack.py).
+
+A `ChaosSpec` names the four failure modes the paper's decentralized
+federation is supposed to survive but the reference never simulates:
+
+  * dropout_p        — per-client per-round availability failure: the client
+                       never trains this round (churn);
+  * straggler_p      — per-client per-round deadline miss: the client trains
+                       but its update arrives too late to count;
+  * crash_p          — per-round aggregator crash: the ELECTED aggregator
+                       dies after winning the election, triggering an
+                       on-device re-election over the surviving
+                       quota-eligible cohort (federation/fused.py);
+  * broadcast_loss_p — per-client probability of missing the aggregated
+                       broadcast: the client keeps its local params across
+                       the merge (producing model divergence the verifier
+                       must absorb next round).
+
+`start_round`/`stop_round` bound the chaos window [start_round, stop_round)
+— a finite burst whose aftermath the rounds-to-recover metric measures
+(chaos/metrics.py). All draws come from a dedicated domain-separated key
+stream (utils/seeding.py chaos_key), so enabling chaos NEVER perturbs
+training/eval/selection draws; a zero-probability spec is bit-identical to
+a chaos-free schedule (tests/test_chaos.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+_PROB_FIELDS = ("dropout_p", "straggler_p", "crash_p", "broadcast_loss_p")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSpec:
+    """Failure probabilities + the active-window schedule."""
+
+    dropout_p: float = 0.0
+    straggler_p: float = 0.0
+    crash_p: float = 0.0
+    broadcast_loss_p: float = 0.0
+    start_round: int = 0             # first chaotic round (window anchor)
+    stop_round: Optional[int] = None  # first round chaos STOPS (None = never)
+
+    def __post_init__(self):
+        for name in _PROB_FIELDS:
+            p = getattr(self, name)
+            # a bad probability would silently skew (or never fire) the
+            # bernoulli draws under jit — reject eagerly instead
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {p}")
+        if self.start_round < 0:
+            raise ValueError(
+                f"start_round must be >= 0, got {self.start_round}")
+        if self.stop_round is not None and self.stop_round <= self.start_round:
+            raise ValueError(
+                f"stop_round ({self.stop_round}) must be > start_round "
+                f"({self.start_round}); the window [start, stop) is else "
+                f"empty and the spec is a silent no-op")
+
+    @property
+    def is_null(self) -> bool:
+        """True when every failure probability is zero (the spec injects
+        nothing; schedules must be bit-identical to chaos-free runs)."""
+        return all(getattr(self, name) == 0.0 for name in _PROB_FIELDS)

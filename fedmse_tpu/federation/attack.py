@@ -27,7 +27,7 @@ so noise draws differ per round but stay reproducible.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,12 +37,19 @@ ATTACK_KINDS = ("scale", "noise", "sign_flip", "zero")
 
 @dataclasses.dataclass(frozen=True)
 class AttackSpec:
-    """Declarative attack description (kind + strength + schedule)."""
+    """Declarative attack description (kind + strength + schedule).
+
+    The attacked rounds are `start_round, start_round + every_k, ...` up to
+    (exclusive) `stop_round` — a TRANSIENT burst when stop_round is set,
+    which is what the chaos axis's rounds-to-recover metric measures: how
+    long the federation takes to regain its pre-burst AUC once the attacker
+    stops (fedmse_tpu/chaos/metrics.py)."""
 
     kind: str = "scale"
     strength: float = 10.0
     every_k: int = 1          # attack every k-th round from start_round
     start_round: int = 0      # first attacked round (schedule anchor)
+    stop_round: Optional[int] = None  # first round NOT attacked (None: never)
 
     def __post_init__(self):
         if self.kind not in ATTACK_KINDS:
@@ -52,6 +59,12 @@ class AttackSpec:
             # would become a traced mod-by-zero under jit (undefined result,
             # no ZeroDivisionError) — reject eagerly instead
             raise ValueError(f"every_k must be >= 1, got {self.every_k}")
+        if self.stop_round is not None and self.stop_round <= self.start_round:
+            # an empty window would silently never attack — reject eagerly
+            # (same idiom as every_k above)
+            raise ValueError(
+                f"stop_round ({self.stop_round}) must be > start_round "
+                f"({self.start_round})")
 
 
 def poison_params(params: Any, spec: AttackSpec, rng: jax.Array) -> Any:
@@ -81,6 +94,8 @@ def make_poison_fn(spec: AttackSpec) -> Callable:
         round_index = jnp.asarray(round_index)
         active = (round_index >= spec.start_round) & \
                  (((round_index - spec.start_round) % spec.every_k) == 0)
+        if spec.stop_round is not None:  # transient burst: a..b then stop
+            active = active & (round_index < spec.stop_round)
         return jax.lax.cond(
             active,
             lambda p: poison_params(p, spec, rng),

@@ -67,7 +67,7 @@ class BatchedRunEngine:
 
     def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
                  n_real: int, runs: int, model_type: str, update_type: str,
-                 poison_fn=None):
+                 poison_fn=None, chaos=None):
         if cfg.metric == "time":
             raise ValueError(
                 "metric='time' is host-side wall-clock and cannot be traced "
@@ -81,6 +81,10 @@ class BatchedRunEngine:
         self.model_type = model_type
         self.update_type = update_type
         self.poison_fn = poison_fn
+        # chaos fault injection (fedmse_tpu/chaos/): per-run mask streams,
+        # each drawn from that run's own domain-separated chaos key — the
+        # batched lanes see bit-identical faults to R sequential chaos runs
+        self.chaos = chaos
 
         programs = _engine_programs(model, cfg, model_type, update_type)
         self.tx = programs["tx"]
@@ -105,6 +109,8 @@ class BatchedRunEngine:
         self.states = init_batched_client_states(self.model, self.tx,
                                                  init_keys, self.n_pad)
         self.host = [HostState.create(self.n_real) for _ in range(self.runs)]
+        self._chaos_keys = ([r.chaos_key() for r in self.rngs]
+                            if self.chaos is not None else None)
 
     @property
     def compact(self) -> bool:
@@ -119,11 +125,12 @@ class BatchedRunEngine:
         from fedmse_tpu.federation.fused import make_batched_runs_scan
         self._scan_compact = self.compact
         args = self._builder_args + (self._scan_compact, self.poison_fn)
-        key = ("batched_runs",) + args[:-1]
+        with_chaos = self.chaos is not None  # program depends on the BOOL
+        key = ("batched_runs",) + args[:-1] + (with_chaos,)
         if self.poison_fn is None and key in _PROGRAM_CACHE:
             self._scan = _PROGRAM_CACHE[key]
             return
-        self._scan = make_batched_runs_scan(*args)
+        self._scan = make_batched_runs_scan(*args, chaos=with_chaos)
         if self.poison_fn is None:
             _cache_put(key, self._scan)
 
@@ -175,11 +182,18 @@ class BatchedRunEngine:
         for i in range(k):
             for r in range(self.runs):
                 masks[i, r, schedule[i][r]] = 1.0
+        extra = ()
+        if self.chaos is not None:
+            from fedmse_tpu.chaos import make_batched_chaos_masks
+            # pure function of (spec, per-run keys, absolute round index):
+            # a replay recomputes bit-identical fault tensors
+            extra = (make_batched_chaos_masks(self.chaos, self._chaos_keys,
+                                              start_round, k, self.n_pad),)
         self.states, _, outs = self._scan(
             self.states, self.data, self._ver_x, self._ver_m,
             jnp.asarray(sel_idx), jnp.asarray(masks), agg_count,
             keys, jnp.arange(start_round, start_round + k, dtype=jnp.int32),
-            jnp.asarray(np.ascontiguousarray(active_rounds)))
+            jnp.asarray(np.ascontiguousarray(active_rounds)), *extra)
         return host_fetch(outs), schedule, keys
 
     def process_round(self, run: int, round_index: int, selected: List[int],
@@ -191,7 +205,8 @@ class BatchedRunEngine:
         out_slice = jax.tree.map(lambda t: t[chunk_pos, run], outs)
         return absorb_fused_out(out_slice, round_index, selected, self.n_real,
                                 self.host[run],
-                                self.cfg.max_rejected_updates)
+                                self.cfg.max_rejected_updates,
+                                chaos=self.chaos is not None)
 
     def evaluate_final(self) -> np.ndarray:
         """[R, n_real] (or [R, n_real, 3] for classification) final metrics —

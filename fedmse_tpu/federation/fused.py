@@ -41,7 +41,8 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from fedmse_tpu.federation.state import ClientStates, tree_select_clients
+from fedmse_tpu.federation.state import (ClientStates, tree_client_divergence,
+                                         tree_select_clients)
 
 
 class FusedRoundOut(NamedTuple):
@@ -55,6 +56,11 @@ class FusedRoundOut(NamedTuple):
     rejected: jax.Array      # [N] i32 consecutive rejected updates
     min_valid: jax.Array     # [N] best local valid loss this round
     tracking: jax.Array      # [N, E, 3] train/valid loss curves
+    # chaos observability (fedmse_tpu/chaos/, DESIGN.md §9); placeholders
+    # (eff_mask == sel_mask, crashed == -1, divergence == 0) without chaos
+    eff_mask: jax.Array      # [N] f32 effective cohort after churn/stragglers
+    crashed: jax.Array       # i32 scalar: crashed-then-replaced aggregator
+    divergence: jax.Array    # [N] f32 param distance to the federation mean
 
 
 def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
@@ -84,7 +90,11 @@ def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
         scores = scores_fn(params, vote_x, vote_m, jax.random.fold_in(rng, i))
         cand = (sel_mask > 0) & (client_ids != voter) & \
                (agg_count < max_threshold)
-        found = jnp.any(cand)
+        # a voter masked out of the (effective) cohort casts no vote: under
+        # chaos `sel_mask` is selected ∧ available ∧ ¬straggler, and a
+        # dropped-out voter's turn passes to the next selected client
+        # (chaos-free, every sel_indices entry is in the mask — no-op)
+        found = jnp.any(cand) & (sel_mask[voter] > 0)
         # NaN scores (diverged training) rank worst; if EVERY candidate is
         # NaN the earliest selected candidate wins — the pick is always a
         # genuine candidate
@@ -104,12 +114,13 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                     aggregate: Callable, verify: Callable,
                     evaluate_all: Callable, max_threshold: int,
                     compact_cohort: bool = False,
-                    poison_fn: Optional[Callable] = None) -> Callable:
+                    poison_fn: Optional[Callable] = None,
+                    chaos: bool = False) -> Callable:
     """Build the traceable round body (jit-wrapped by make_fused_round,
     scanned directly by make_fused_rounds_scan):
 
     fn(states, data, ver_x [N,V,D], ver_m [N,V], sel_indices [S],
-       sel_mask [N], agg_count [N], rng, round_index)
+       sel_mask [N], agg_count [N], rng, round_index[, chaos_in])
       -> (states, agg_count, FusedRoundOut)
 
     `data` (FederatedData) and the verification tensors are ARGUMENTS, not
@@ -122,34 +133,97 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
     aggregated model between aggregation and broadcast — the malicious-
     aggregator threat the verification subsystem defends against
     (federation/attack.py).
+
+    `chaos=True` adds a trailing `chaos_in` argument (a single-round
+    ChaosMasks slice, chaos/masks.py) and compiles the fault semantics into
+    the program (DESIGN.md §9):
+      * the effective cohort is selected ∧ available ∧ ¬straggler — lost
+        clients' training is discarded (their state passes through), they
+        cast no vote and carry no aggregation weight;
+      * a crash bit fells the ELECTED aggregator: an on-device re-election
+        pass runs over the surviving quota-eligible cohort, falling back to
+        the no_aggregate path when nobody remains;
+      * broadcast-loss clients (and the crashed ex-aggregator) keep their
+        ENTIRE local state across the merge — params, verifier history and
+        rejected counters — producing the model divergence the verifier
+        must absorb next round (reported per client in `divergence`).
+    All-clear masks make every chaos op the identity (multiply by 1.0,
+    where on an all-true predicate), so a zero-probability ChaosSpec is
+    bit-identical to the chaos-free program (tests/test_chaos.py).
     """
 
     def round_body(states: ClientStates, data, ver_x, ver_m, sel_indices,
-                   sel_mask, agg_count, rng, round_index):
+                   sel_mask, agg_count, rng, round_index, chaos_in=None):
         n_pad = data.num_clients_padded
         client_ids = jnp.arange(n_pad)
+        if chaos:
+            eff_mask = sel_mask * chaos_in.available * \
+                (1.0 - chaos_in.straggler)
+        else:
+            eff_mask = sel_mask
         # ---- local training of the selected cohort (src/main.py:276-279) ----
         params, opt_state, best_params, min_valid, tracking = train_all(
             states.params, states.opt_state, states.prev_global, sel_mask,
             data.train_xb, data.train_mb, data.valid_xb, data.valid_mb,
             sel_idx=sel_indices if compact_cohort else None)
+        if chaos:
+            # selected clients that dropped out (never trained) or straggled
+            # past the round deadline (trained too late to count) contribute
+            # nothing: their state passes through and their curves blank to
+            # NaN like an unselected client's
+            lost = (sel_mask > 0) & (eff_mask <= 0)
+            params = tree_select_clients(~lost, params, states.params)
+            opt_state = tree_select_clients(~lost, opt_state,
+                                            states.opt_state)
+            min_valid = jnp.where(lost, jnp.nan, min_valid)
+            tracking = jnp.where(lost[:, None, None], jnp.nan, tracking)
         states = ClientStates(
             params=params, opt_state=opt_state, prev_global=states.prev_global,
             hist_params=states.hist_params, hist_perf=states.hist_perf,
             hist_seen=states.hist_seen, rejected=states.rejected)
 
         # ---- election (src/main.py:282-288): voting data is the FIRST
-        # selected client's valid split (src/main.py:285) ----
-        vote_x = data.valid_x[sel_indices[0]]
-        vote_m = data.valid_m[sel_indices[0]]
+        # selected client's valid split (src/main.py:285) — under chaos the
+        # first EFFECTIVE one (argmax of an all-true cohort is index 0, so
+        # the chaos-free gather is unchanged) ----
+        if chaos:
+            vote_owner = sel_indices[jnp.argmax(eff_mask[sel_indices] > 0)]
+        else:
+            vote_owner = sel_indices[0]
+        vote_x = data.valid_x[vote_owner]
+        vote_m = data.valid_m[vote_owner]
         aggregator, scores = _elect_on_device(
-            scores_fn, states.params, sel_indices, sel_mask, agg_count,
+            scores_fn, states.params, sel_indices, eff_mask, agg_count,
             vote_x, vote_m, rng, max_threshold)
+
+        # ---- aggregator crash -> on-device re-election (chaos only) ----
+        crashed = jnp.int32(-1)
+        if chaos:
+            crash_now = chaos_in.crash & (aggregator >= 0)
+
+            def reelect(_):
+                # the crashed aggregator leaves the cohort; the surviving
+                # quota-eligible voters elect again (fresh tie-break stream:
+                # a fold constant neither the voter loop nor poison_fn uses)
+                mask2 = jnp.where(client_ids == aggregator, 0.0, eff_mask)
+                return _elect_on_device(
+                    scores_fn, states.params, sel_indices, mask2, agg_count,
+                    vote_x, vote_m, jax.random.fold_in(rng, 0x7FFFFFFE),
+                    max_threshold)
+
+            crashed = jnp.where(crash_now, aggregator, jnp.int32(-1))
+            aggregator, scores = jax.lax.cond(
+                crash_now, reelect, lambda _: (aggregator, scores), None)
+
+        # the aggregation cohort excludes the crashed ex-aggregator (its
+        # update died with it); chaos-free, crashed == -1 matches nobody
+        agg_mask = jnp.where(client_ids == crashed, 0.0, eff_mask) \
+            if chaos else eff_mask
 
         # ---- aggregate + broadcast + verify (src/main.py:291-312) ----
         def do_aggregate(states):
             agg_params, weights = aggregate(
-                states.params, sel_mask, data.dev_x,
+                states.params, agg_mask, data.dev_x,
                 sel_idx=sel_indices if compact_cohort else None)
             if poison_fn is not None:  # malicious-aggregator tampering point
                 # fold constant is any index the voter loop can't reach
@@ -158,7 +232,22 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
             onehot = (client_ids == aggregator).astype(jnp.float32)
             outcome = verify(states, agg_params, ver_x, ver_m, onehot,
                              data.client_mask)
-            return outcome.states, weights
+            new_states = outcome.states
+            if chaos:
+                # broadcast loss: a client that never RECEIVED the broadcast
+                # keeps its entire pre-merge state — params, prev_global,
+                # verifier history, rejected counter. Down clients (dropout,
+                # crashed ex-aggregator) miss it by definition — offline is
+                # offline whether or not they were selected; stragglers are
+                # merely SLOW, still online, and do receive. The elected
+                # aggregator holds the aggregate locally (nothing to lose).
+                received = ((chaos_in.bcast_drop <= 0)
+                            & (chaos_in.available > 0)
+                            & (client_ids != crashed)) \
+                    | (client_ids == aggregator)
+                new_states = tree_select_clients(received, new_states,
+                                                 states)
+            return new_states, weights
 
         def no_aggregate(states):
             return states, jnp.zeros(n_pad, jnp.float32)
@@ -172,27 +261,34 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
         metrics = evaluate_all(states.params, data.test_x, data.test_m,
                                data.test_y, data.train_xb, data.train_mb)
 
+        # resilience observable: post-merge per-client parameter divergence
+        # (chaos runs only — the clean program does not pay for it)
+        divergence = tree_client_divergence(states.params, data.client_mask) \
+            if chaos else jnp.zeros(n_pad, jnp.float32)
+
         out = FusedRoundOut(aggregator=aggregator, metrics=metrics,
                             scores=scores, weights=weights,
                             rejected=states.rejected, min_valid=min_valid,
-                            tracking=tracking)
+                            tracking=tracking, eff_mask=eff_mask,
+                            crashed=crashed, divergence=divergence)
         return states, agg_count, out
 
     return round_body
 
 
-def make_fused_round(*args) -> Callable:
+def make_fused_round(*args, chaos: bool = False) -> Callable:
     """The single-dispatch round: jitted round body with the incoming states
-    buffers donated (they are consumed and replaced every round)."""
-    return jax.jit(make_round_body(*args), donate_argnums=(0,))
+    buffers donated (they are consumed and replaced every round). With
+    `chaos=True` the call takes a trailing single-round ChaosMasks slice."""
+    return jax.jit(make_round_body(*args, chaos=chaos), donate_argnums=(0,))
 
 
-def make_fused_rounds_scan(*args) -> Callable:
+def make_fused_rounds_scan(*args, chaos: bool = False) -> Callable:
     """Build the whole-schedule runner: `lax.scan` of the raw round body over
     a precomputed selection schedule.
 
     fn(states, data, ver_x, ver_m, sel_schedule [R, S], sel_masks [R, N],
-       agg_count [N], keys [R])
+       agg_count [N], keys [R], round_indices [R][, chaos_masks])
       -> (states, agg_count, FusedRoundOut stacked on a leading [R] axis)
 
     `keys` is one PRNG key per round, drawn from the SAME host stream the
@@ -201,36 +297,54 @@ def make_fused_rounds_scan(*args) -> Callable:
     rounds; host early stopping cannot interleave (the driver scans in chunks
     and replays the tail of a chunk when a stop fires mid-chunk —
     main.py:run_combination).
+
+    With `chaos=True` the precomputed fault tensors (`chaos_masks`, a
+    ChaosMasks with [R, N] / [R] leaves — chaos/masks.py) ride the scan's
+    xs exactly like the selection schedule: failure is an INPUT to the
+    program, not control flow around it (DESIGN.md §9).
     """
-    round_body = make_round_body(*args)
+    round_body = make_round_body(*args, chaos=chaos)
 
     @partial(jax.jit, donate_argnums=(0,))
     def run_all(states: ClientStates, data, ver_x, ver_m, sel_schedule,
-                sel_masks, agg_count, keys, round_indices):
+                sel_masks, agg_count, keys, round_indices, chaos_masks=None):
         def step(carry, xs):
             states, agg_count = carry
-            sel_indices, sel_mask, key, round_index = xs
+            if chaos:
+                sel_indices, sel_mask, key, round_index, ch = xs
+            else:
+                sel_indices, sel_mask, key, round_index = xs
+                ch = None
             states, agg_count, out = round_body(states, data, ver_x, ver_m,
                                                 sel_indices, sel_mask,
-                                                agg_count, key, round_index)
+                                                agg_count, key, round_index,
+                                                ch)
             return (states, agg_count), out
 
-        (states, agg_count), outs = jax.lax.scan(
-            step, (states, agg_count),
-            (sel_schedule, sel_masks, keys, round_indices))
+        xs = (sel_schedule, sel_masks, keys, round_indices)
+        if chaos:
+            xs = xs + (chaos_masks,)
+        (states, agg_count), outs = jax.lax.scan(step, (states, agg_count),
+                                                 xs)
         return states, agg_count, outs
 
     return run_all
 
 
-def make_batched_runs_scan(*args) -> Callable:
+def make_batched_runs_scan(*args, chaos: bool = False) -> Callable:
     """Build the batched-runs whole-schedule runner: the round body vmapped
     over a leading `runs` axis, scanned over a per-run selection schedule.
 
     fn(states [R, N, ...], data, ver_x, ver_m, sel_schedule [K, R, S],
        sel_masks [K, R, N], agg_count [R, N], keys [K, R],
-       round_indices [K], active [K, R])
+       round_indices [K], active [K, R][, chaos_masks])
       -> (states, agg_count, FusedRoundOut stacked on leading [K, R] axes)
+
+    With `chaos=True`, `chaos_masks` carries [K, R, N] / [K, R] fault
+    tensors (one independent stream per run, drawn from each run's own
+    domain-separated chaos key — chaos/masks.py make_batched_chaos_masks);
+    the scan slices the round axis and the run vmap slices the runs axis,
+    so each lane sees exactly the masks its sequential federation would.
 
     R independent federations — each with its own PRNG stream, client
     states, selection masks, elections and quota counters — execute as ONE
@@ -253,31 +367,41 @@ def make_batched_runs_scan(*args) -> Callable:
     identical to the first pass and the host keeps its first-pass
     bookkeeping.
     """
-    round_body = make_round_body(*args)
+    round_body = make_round_body(*args, chaos=chaos)
 
     @partial(jax.jit, donate_argnums=(0,))
     def run_all(states: ClientStates, data, ver_x, ver_m, sel_schedule,
-                sel_masks, agg_count, keys, round_indices, active):
+                sel_masks, agg_count, keys, round_indices, active,
+                chaos_masks=None):
         def one_run(run_states, sel_indices, sel_mask, count, key,
-                    round_index):
+                    round_index, ch=None):
             return round_body(run_states, data, ver_x, ver_m, sel_indices,
-                              sel_mask, count, key, round_index)
+                              sel_mask, count, key, round_index, ch)
 
         def step(carry, xs):
             states, agg_count = carry
-            sel_indices, sel_mask, key, round_index, act = xs
-            new_states, new_count, out = jax.vmap(
-                one_run, in_axes=(0, 0, 0, 0, 0, None))(
-                    states, sel_indices, sel_mask, agg_count, key,
-                    round_index)
+            if chaos:
+                sel_indices, sel_mask, key, round_index, act, ch = xs
+                new_states, new_count, out = jax.vmap(
+                    one_run, in_axes=(0, 0, 0, 0, 0, None, 0))(
+                        states, sel_indices, sel_mask, agg_count, key,
+                        round_index, ch)
+            else:
+                sel_indices, sel_mask, key, round_index, act = xs
+                new_states, new_count, out = jax.vmap(
+                    one_run, in_axes=(0, 0, 0, 0, 0, None))(
+                        states, sel_indices, sel_mask, agg_count, key,
+                        round_index)
             # early stop as a mask: stopped runs' federations are frozen
             states = tree_select_clients(act, new_states, states)
             agg_count = jnp.where(act[:, None], new_count, agg_count)
             return (states, agg_count), out
 
-        (states, agg_count), outs = jax.lax.scan(
-            step, (states, agg_count),
-            (sel_schedule, sel_masks, keys, round_indices, active))
+        xs = (sel_schedule, sel_masks, keys, round_indices, active)
+        if chaos:
+            xs = xs + (chaos_masks,)
+        (states, agg_count), outs = jax.lax.scan(step, (states, agg_count),
+                                                 xs)
         return states, agg_count, outs
 
     return run_all

@@ -60,6 +60,14 @@ class RoundResult:
     min_valid: np.ndarray               # [n_real] best local valid loss
     metrics_full: Optional[np.ndarray] = None  # [n_real, 3] f1/precision/recall
                                                # (metric='classification' only)
+    # chaos observability (fedmse_tpu/chaos/; fused paths only — the
+    # per-phase path leaves the defaults): selected clients that actually
+    # contributed (survived dropout + straggler deadline), the aggregator
+    # that crashed and was replaced by re-election (None = no crash), and
+    # per-client parameter divergence from the federation mean
+    effective: Optional[List[int]] = None
+    crashed_aggregator: Optional[int] = None
+    divergence: Optional[np.ndarray] = None
 
 
 def split_metric_columns(metrics: np.ndarray):
@@ -151,12 +159,19 @@ def verification_tensors(cfg: ExperimentConfig, data: FederatedData,
 
 
 def absorb_fused_out(out, round_index: int, selected: List[int], n_real: int,
-                     host: HostState, max_rejected_updates: int) -> RoundResult:
+                     host: HostState, max_rejected_updates: int,
+                     chaos: bool = False) -> RoundResult:
     """Host bookkeeping + RoundResult from ONE host-fetched FusedRoundOut
     bundle: quota/vote counters, reference verification rows, attack
     flagging. Shared by the per-run fused path (RoundEngine._fused_result)
     and the batched-runs path (each run's slice of the stacked outputs —
-    federation/batched.py)."""
+    federation/batched.py).
+
+    `chaos` marks the bundle as coming from a chaos-enabled program: only
+    then is `divergence` a measured quantity (the clean program emits a
+    zeros placeholder, which must surface as None — "not measured", not
+    "measured and zero" — so resilience metrics can't mistake an
+    unmeasured baseline for a perfectly converged one)."""
     aggregator = int(out.aggregator)
     rejected = np.asarray(out.rejected)
     verification_rows: List[Dict] = []
@@ -178,6 +193,8 @@ def absorb_fused_out(out, round_index: int, selected: List[int], n_real: int,
         logger.warning("No aggregator selected for round %d", round_index)
     metrics, metrics_full = split_metric_columns(
         np.asarray(out.metrics)[:n_real])
+    eff = np.asarray(out.eff_mask)
+    crashed = int(out.crashed)
     return RoundResult(
         round_index=round_index,
         selected=list(selected),
@@ -190,6 +207,11 @@ def absorb_fused_out(out, round_index: int, selected: List[int], n_real: int,
         tracking=np.asarray(out.tracking)[:n_real],
         min_valid=np.asarray(out.min_valid)[:n_real],
         metrics_full=metrics_full,
+        # chaos observability: without chaos eff_mask == sel_mask, so
+        # `effective` degenerates to `selected` and crashed stays None
+        effective=[i for i in selected if eff[i] > 0],
+        crashed_aggregator=None if crashed < 0 else crashed,
+        divergence=np.asarray(out.divergence)[:n_real] if chaos else None,
     )
 
 
@@ -211,7 +233,7 @@ class RoundEngine:
     def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
                  n_real: int, rngs: ExperimentRngs, model_type: str,
                  update_type: str, profile: bool = False,
-                 fused: bool = False, poison_fn=None):
+                 fused: bool = False, poison_fn=None, chaos=None):
         self.model = model
         self.cfg = cfg
         self.data = data
@@ -247,6 +269,17 @@ class RoundEngine:
         self.fused = fused
         self._warned_compact_off = False  # log the compact fallback once
         self.poison_fn = poison_fn  # attack simulation (federation/attack.py)
+        # chaos fault injection (fedmse_tpu/chaos/): a ChaosSpec compiled
+        # into the fused program as per-round mask tensors. The per-phase
+        # path has no mask plumbing, so chaos demands the fused engine —
+        # reject eagerly rather than silently running a clean schedule.
+        self.chaos = chaos
+        if chaos is not None and (not fused or profile):
+            raise ValueError(
+                "chaos fault injection is compiled into the fused round "
+                "program; construct the engine with fused=True (and "
+                "profile=False)")
+        self._chaos_key = rngs.chaos_key() if chaos is not None else None
         self._fused_round = None
         self._fused_scan = None
         self._fused_compact = None  # compact value baked into the programs
@@ -267,15 +300,16 @@ class RoundEngine:
         args = (self.train_all, self.scores_fn, self.aggregate, self.verify,
                 self.evaluate_all, self.cfg.max_aggregation_threshold,
                 self._fused_compact, self.poison_fn)
+        with_chaos = self.chaos is not None  # program depends on the BOOL
         # same sharing rationale as _engine_programs; the builders are keyed
         # by the already-cached phase callables, so identity works — except
         # with an attack poison_fn (arbitrary callable, not cache-keyable)
-        key = ("fused",) + args[:-1]
+        key = ("fused",) + args[:-1] + (with_chaos,)
         if self.poison_fn is None and key in _PROGRAM_CACHE:
             self._fused_round, self._fused_scan = _PROGRAM_CACHE[key]
             return
-        self._fused_round = make_fused_round(*args)
-        self._fused_scan = make_fused_rounds_scan(*args)
+        self._fused_round = make_fused_round(*args, chaos=with_chaos)
+        self._fused_scan = make_fused_rounds_scan(*args, chaos=with_chaos)
         if self.poison_fn is None:
             _cache_put(key, (self._fused_round, self._fused_scan))
 
@@ -320,7 +354,8 @@ class RoundEngine:
         """Host bookkeeping + RoundResult from a FusedRoundOut bundle."""
         out = host_fetch(out)  # multi-process-safe (parallel/mesh.py)
         return absorb_fused_out(out, round_index, selected, self.n_real,
-                                self.host, self.cfg.max_rejected_updates)
+                                self.host, self.cfg.max_rejected_updates,
+                                chaos=self.chaos is not None)
 
     def _selection_arrays(self, selected: List[int]):
         sel_mask = np.zeros(self.n_pad, dtype=np.float32)
@@ -342,6 +377,16 @@ class RoundEngine:
         self.states = init_client_states(self.model, self.tx,
                                          self.rngs.next_jax(), self.n_pad)
         self.host = HostState.create(self.n_real)
+        if self.chaos is not None:
+            self._chaos_key = self.rngs.chaos_key()
+
+    def _chaos_masks(self, start_round: int, n_rounds: int):
+        """[n_rounds]-stacked fault tensors for the chunk — a pure function
+        of (spec, chaos key, absolute round index), so chunked, replayed and
+        per-round dispatches all see identical masks (chaos/masks.py)."""
+        from fedmse_tpu.chaos import make_chaos_masks
+        return make_chaos_masks(self.chaos, self._chaos_key, start_round,
+                                n_rounds, self.n_pad)
 
     def run_round_fused(self, round_index: int,
                         selected: Optional[List[int]] = None,
@@ -356,11 +401,15 @@ class RoundEngine:
         if key is None:
             key = self.rngs.next_jax()
         sel_indices, sel_mask = self._selection_arrays(selected)
+        extra = ()
+        if self.chaos is not None:
+            extra = (jax.tree.map(lambda t: t[0],
+                                  self._chaos_masks(round_index, 1)),)
         self.states, _, out = self._fused_round(
             self.states, self.data, self._ver_x, self._ver_m,
             jnp.asarray(sel_indices), jnp.asarray(sel_mask),
             self._agg_count_padded(), key,
-            jnp.asarray(round_index, jnp.int32))
+            jnp.asarray(round_index, jnp.int32), *extra)
         return self._fused_result(round_index, selected, out)
 
     def run_schedule_chunk(self, start_round: int, n_rounds: int):
@@ -381,10 +430,14 @@ class RoundEngine:
         arrays = [self._selection_arrays(sel) for sel in schedule]
         sel_idx = jnp.asarray(np.stack([a[0] for a in arrays]))
         masks = jnp.asarray(np.stack([a[1] for a in arrays]))
+        extra = ()
+        if self.chaos is not None:
+            extra = (self._chaos_masks(start_round, n_rounds),)
         self.states, _, outs = self._fused_scan(
             self.states, self.data, self._ver_x, self._ver_m, sel_idx, masks,
             self._agg_count_padded(), keys,
-            jnp.arange(start_round, start_round + n_rounds, dtype=jnp.int32))
+            jnp.arange(start_round, start_round + n_rounds, dtype=jnp.int32),
+            *extra)
         outs = host_fetch(outs)  # multi-process-safe (parallel/mesh.py)
         results = [self._fused_result(start_round + r, schedule[r],
                                       jax.tree.map(lambda t: t[r], outs))

@@ -133,3 +133,22 @@ def tree_select_clients(accept: jax.Array, a, b):
         c = accept.reshape(accept.shape + (1,) * (x.ndim - 1))
         return jnp.where(c, x, y)
     return jax.tree.map(sel, a, b)
+
+
+def tree_client_divergence(params: Any, client_mask: jax.Array) -> jax.Array:
+    """Per-client parameter divergence [N]: the L2 distance of each client's
+    stacked params from the client_mask-weighted mean model.
+
+    The resilience observable of the chaos axis (fedmse_tpu/chaos/,
+    DESIGN.md §9): broadcast-loss clients and rejected merges strand clients
+    on stale models, and this spread is the drift the verifier has to absorb
+    on the next accepted round. Padded clients carry zero weight in the mean
+    but still report a distance (the caller slices to n_real)."""
+    w = client_mask / jnp.maximum(jnp.sum(client_mask), 1.0)
+    sq = None
+    for leaf in jax.tree.leaves(params):
+        mean = jnp.einsum("n,n...->...", w.astype(leaf.dtype), leaf)
+        d = (leaf - mean).reshape(leaf.shape[0], -1)
+        s = jnp.sum(d * d, axis=1)
+        sq = s if sq is None else sq + s
+    return jnp.sqrt(sq)

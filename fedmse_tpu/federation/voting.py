@@ -85,6 +85,13 @@ def elect_aggregator(
     `score_fn()` returns fresh [N] MSE scores (new tie-breaks per voter call,
     matching main.py:284-288 calling vote_for_aggregator per voter).
     Returns (aggregator_index or None, the winning voter's scores or None).
+
+    Chaos fault injection (fedmse_tpu/chaos/) deliberately has NO hook
+    here: the effective-cohort / re-election semantics live in the fused
+    election (federation/fused.py _elect_on_device, where ineligible
+    voters' turns pass on and masked clients win nothing), and engines
+    reject chaos on the per-phase path eagerly — so this host path always
+    sees the full selected cohort.
     """
     for voter in selected_indices:
         scores = score_fn()

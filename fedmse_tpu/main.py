@@ -117,12 +117,15 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
                     mesh=None,
                     resume: Optional[CheckpointManager] = None,
                     save_checkpoints: bool = False,
-                    attack=None) -> Dict:
+                    attack=None, chaos=None) -> Dict:
     """One (model_type, update_type, run): the reference round loop
     (src/main.py:267-365) + final evaluation (src/main.py:368-374).
     `attack` (an AttackSpec) simulates a malicious aggregator tampering
     with the broadcast (federation/attack.py) — the adversary the
-    verification subsystem defends against."""
+    verification subsystem defends against. `chaos` (a ChaosSpec,
+    fedmse_tpu/chaos/) injects client churn / stragglers / aggregator
+    crashes / broadcast loss into the fused schedule; the two compose —
+    Byzantine peers PLUS churn is the paper's actual threat model."""
     rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed,
                           run_seed_stride=cfg.run_seed_stride)
     model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
@@ -133,7 +136,8 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
         poison_fn = make_poison_fn(attack)
     engine = RoundEngine(model, cfg, data, n_real=n_real, rngs=rngs,
                          model_type=model_type, update_type=update_type,
-                         fused=cfg.fused_rounds, poison_fn=poison_fn)
+                         fused=cfg.fused_rounds, poison_fn=poison_fn,
+                         chaos=chaos)
     if mesh is not None:
         engine.data, engine.states = shard_federation(data, engine.states, mesh)
         engine._ver_x, engine._ver_m = engine._verification_tensors()
@@ -277,7 +281,7 @@ def run_batched_combination(cfg: ExperimentConfig, data, n_real: int,
                             writer: Optional[ResultsWriter] = None,
                             device_names: Optional[List[str]] = None,
                             save_checkpoints: bool = False,
-                            attack=None) -> List[Dict]:
+                            attack=None, chaos=None) -> List[Dict]:
     """All `cfg.num_runs` seeds of one (model_type, update_type) as ONE
     runs-axis-batched program (federation/batched.py): R federations advance
     chunk-by-chunk in single XLA dispatches, and the per-run results are
@@ -309,7 +313,7 @@ def run_batched_combination(cfg: ExperimentConfig, data, n_real: int,
         poison_fn = make_poison_fn(attack)
     engine = BatchedRunEngine(model, cfg, data, n_real=n_real, runs=runs,
                               model_type=model_type, update_type=update_type,
-                              poison_fn=poison_fn)
+                              poison_fn=poison_fn, chaos=chaos)
     early = [GlobalEarlyStop(inverted=cfg.compat.inverted_global_early_stop,
                              patience=cfg.global_patience)
              for _ in range(runs)]
@@ -406,7 +410,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                    use_mesh: bool = False,
                    save_checkpoints: bool = True,
                    resume_dir: Optional[str] = None,
-                   attack=None, batch_runs: bool = False,
+                   attack=None, chaos=None, batch_runs: bool = False,
                    serve: bool = False, serve_rows: int = 2048) -> Dict:
     """The full sweep (src/main.py:108-399) -> training summary dict.
 
@@ -466,7 +470,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                 run_outs = run_batched_combination(
                     cfg, data, n_real, model_type, update_type,
                     writer=writer, device_names=device_names,
-                    save_checkpoints=save_checkpoints, attack=attack)
+                    save_checkpoints=save_checkpoints, attack=attack,
+                    chaos=chaos)
                 for run, out in enumerate(run_outs):
                     best_metrics[model_type][update_type] = max(
                         best_metrics[model_type][update_type],
@@ -483,7 +488,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                     cfg, data, n_real, model_type, update_type, run,
                     writer=writer, early_stop=early_stop,
                     device_names=device_names, mesh=mesh, resume=resume,
-                    save_checkpoints=save_checkpoints, attack=attack)
+                    save_checkpoints=save_checkpoints, attack=attack,
+                    chaos=chaos)
                 best_metrics[model_type][update_type] = max(
                     best_metrics[model_type][update_type], out["best_final"])
                 all_results[f"{model_type}/{update_type}/run{run}"] = {
@@ -497,6 +503,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
            "summary_path": summary_path}
     if attack is not None:  # record the adversary in the run's own summary
         out["attack"] = dataclasses.asdict(attack)
+    if chaos is not None:  # ... and the fault scenario (fedmse_tpu/chaos/)
+        out["chaos"] = dataclasses.asdict(chaos)
     if serve:
         if not save_checkpoints:
             logger.warning("--serve needs the checkpointed ClientModel tree"
@@ -549,6 +557,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attack-start", type=int, default=1,
                    help="first attacked round (default 1: round 0 builds "
                         "the verification history)")
+    p.add_argument("--attack-stop", type=int, default=None,
+                   help="first round NOT attacked (transient burst a..b; "
+                        "default None: attack to the end of the schedule)")
+    # chaos fault injection (fedmse_tpu/chaos/): any nonzero probability
+    # compiles the fault masks into the fused schedule; composes with
+    # --attack-kind (Byzantine peers + churn, the paper's threat model)
+    p.add_argument("--chaos-dropout", type=float, default=0.0,
+                   help="per-client per-round dropout probability (client "
+                        "churn: never trains, casts no vote)")
+    p.add_argument("--chaos-straggler", type=float, default=0.0,
+                   help="per-client per-round straggler probability (trains "
+                        "but misses the round deadline; update discarded)")
+    p.add_argument("--chaos-crash", type=float, default=0.0,
+                   help="per-round probability the ELECTED aggregator "
+                        "crashes; survivors re-elect on device")
+    p.add_argument("--chaos-broadcast-loss", type=float, default=0.0,
+                   help="per-client probability of missing the aggregated "
+                        "broadcast (keeps local params across the merge)")
+    p.add_argument("--chaos-start", type=int, default=0,
+                   help="first chaotic round")
+    p.add_argument("--chaos-stop", type=int, default=None,
+                   help="first round chaos stops (finite fault burst; "
+                        "default None: chaos to the end)")
     add_cli_overrides(p)
     return p
 
@@ -565,25 +596,51 @@ def main(argv: Optional[List[str]] = None) -> Dict:
     if args.paper_scale:
         from fedmse_tpu.config import paper_scale
         cfg = paper_scale(cfg)
-    dataset = DatasetConfig.from_json(args.dataset_config, args.data_root)
     attack = None
     if args.attack_kind:
         from fedmse_tpu.federation.attack import AttackSpec
         attack = AttackSpec(kind=args.attack_kind,
                             strength=args.attack_strength,
                             every_k=args.attack_every_k,
-                            start_round=args.attack_start)
+                            start_round=args.attack_start,
+                            stop_round=args.attack_stop)
         # attacked artifacts must never commingle with (or be resumed as)
         # clean ones: tag the experiment so ResultsWriter/checkpoints land
         # in their own tree
+        stop_tag = ("" if attack.stop_round is None
+                    else f"e{attack.stop_round}")
         cfg = cfg.replace(experiment_name=(
             f"{cfg.experiment_name}_attack-{attack.kind}"
-            f"-{attack.strength:g}-k{attack.every_k}s{attack.start_round}"))
+            f"-{attack.strength:g}-k{attack.every_k}s{attack.start_round}"
+            f"{stop_tag}"))
+    chaos = None
+    # nonzero (NOT "> 0"): a negative typo must reach ChaosSpec's eager
+    # validation and fail loudly, not silently disable chaos
+    if any(p != 0 for p in (args.chaos_dropout, args.chaos_straggler,
+                            args.chaos_crash, args.chaos_broadcast_loss)):
+        from fedmse_tpu.chaos import ChaosSpec
+        chaos = ChaosSpec(dropout_p=args.chaos_dropout,
+                          straggler_p=args.chaos_straggler,
+                          crash_p=args.chaos_crash,
+                          broadcast_loss_p=args.chaos_broadcast_loss,
+                          start_round=args.chaos_start,
+                          stop_round=args.chaos_stop)
+        # same isolation rule as attacked artifacts: chaotic runs get their
+        # own ResultsWriter/checkpoint tree
+        stop_tag = ("" if chaos.stop_round is None
+                    else f"e{chaos.stop_round}")
+        cfg = cfg.replace(experiment_name=(
+            f"{cfg.experiment_name}_chaos-d{chaos.dropout_p:g}"
+            f"g{chaos.straggler_p:g}c{chaos.crash_p:g}"
+            f"b{chaos.broadcast_loss_p:g}s{chaos.start_round}{stop_tag}"))
+    # dataset IO comes AFTER the eager spec validation above: a malformed
+    # --attack-*/--chaos-* flag fails loudly before any file is touched
+    dataset = DatasetConfig.from_json(args.dataset_config, args.data_root)
     return run_experiment(cfg, dataset, use_mesh=args.use_mesh,
                           save_checkpoints=not args.no_save,
                           resume_dir=args.resume_dir, attack=attack,
-                          batch_runs=args.batch_runs, serve=args.serve,
-                          serve_rows=args.serve_rows)
+                          chaos=chaos, batch_runs=args.batch_runs,
+                          serve=args.serve, serve_rows=args.serve_rows)
 
 
 def cli() -> int:

@@ -25,6 +25,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Domain tag for the chaos fault-injection stream (fedmse_tpu/chaos/):
+# chaos masks draw from fold_in(jax_root, CHAOS_STREAM_TAG), a branch of the
+# key tree the training/eval stream can never reach — next_jax folds the
+# counters 1, 2, 3, ..., so colliding with the tag would take ~1.13e9 draws.
+# Drawing chaos masks advances NO counter and no host RNG, which is the
+# separation contract tests/test_chaos.py pins: enabling chaos (or a
+# zero-probability ChaosSpec) leaves every other draw bit-identical.
+CHAOS_STREAM_TAG = 0x4348414F  # "CHAO"
+
 
 def set_seeds(seed: int) -> None:
     """Global fallback seeding (reference set_seeds, src/main.py:73-78)."""
@@ -55,6 +64,15 @@ class ExperimentRngs:
     def next_jax(self) -> jax.Array:
         self._fold += 1
         return jax.random.fold_in(self.jax_root, self._fold)
+
+    def chaos_key(self) -> jax.Array:
+        """Root of this run's domain-separated chaos stream (see
+        CHAOS_STREAM_TAG). Pure function of the run's jax_root — calling it
+        consumes nothing, so fault injection cannot perturb the model-init /
+        tie-break stream, and per-run chaos streams are as independent as
+        the run roots themselves (the batched-runs axis reuses this
+        per run — chaos/masks.py make_batched_chaos_masks)."""
+        return jax.random.fold_in(self.jax_root, CHAOS_STREAM_TAG)
 
     def next_jax_batch(self, n: int) -> jax.Array:
         """A [n]-stacked key array identical to n successive `next_jax()`

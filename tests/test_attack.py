@@ -136,3 +136,85 @@ def test_attack_schedule_every_k():
 def test_every_k_zero_rejected():
     with pytest.raises(ValueError):
         AttackSpec(kind="scale", every_k=0)
+
+
+def test_stop_round_bounds_the_attack_window():
+    """stop_round makes the attack a transient burst: rounds in
+    [start_round, stop_round) are attacked, everything after is clean —
+    the schedule the chaos rounds-to-recover metric measures
+    (fedmse_tpu/chaos/metrics.py)."""
+    spec = AttackSpec(kind="scale", strength=50.0, start_round=1,
+                      stop_round=3)
+    fn = make_poison_fn(spec)
+    m = make_model("hybrid", DIM, shrink_lambda=1.0)
+    params = init_client_params(m, jax.random.key(0))
+    leaf0 = np.asarray(jax.tree.leaves(params)[0])
+    expected = {0: 1.0, 1: 50.0, 2: 50.0, 3: 1.0, 4: 1.0}
+    for rnd, factor in expected.items():
+        out = fn(params, jnp.asarray(rnd), jax.random.key(1))
+        np.testing.assert_allclose(np.asarray(jax.tree.leaves(out)[0]),
+                                   factor * leaf0, rtol=1e-6,
+                                   err_msg=f"round {rnd}")
+
+
+def test_stop_round_respects_every_k():
+    """The burst window composes with the every_k cadence: start=0, k=2,
+    stop=4 attacks rounds 0 and 2 only."""
+    spec = AttackSpec(kind="scale", strength=50.0, every_k=2,
+                      start_round=0, stop_round=4)
+    fn = make_poison_fn(spec)
+    m = make_model("hybrid", DIM, shrink_lambda=1.0)
+    params = init_client_params(m, jax.random.key(0))
+    leaf0 = np.asarray(jax.tree.leaves(params)[0])
+    for rnd, factor in {0: 50.0, 1: 1.0, 2: 50.0, 3: 1.0,
+                        4: 1.0, 6: 1.0}.items():
+        out = fn(params, jnp.asarray(rnd), jax.random.key(1))
+        np.testing.assert_allclose(np.asarray(jax.tree.leaves(out)[0]),
+                                   factor * leaf0, rtol=1e-6,
+                                   err_msg=f"round {rnd}")
+
+
+def test_stop_round_validation():
+    """An empty window would silently never attack — rejected eagerly,
+    same idiom as every_k=0."""
+    with pytest.raises(ValueError, match="stop_round"):
+        AttackSpec(kind="scale", start_round=2, stop_round=2)
+    with pytest.raises(ValueError, match="stop_round"):
+        AttackSpec(kind="scale", start_round=5, stop_round=3)
+    # a valid window constructs fine
+    AttackSpec(kind="scale", start_round=2, stop_round=5)
+
+
+def test_transient_attack_stop_round_threads_through_engine():
+    """End-to-end gate on stop_round INSIDE the fused schedule (not just
+    the poison_fn unit): a stop_round=3 burst and a never-stopping attack
+    share the exact poison schedule through rounds 0-2, so their round
+    streams are equal up to the stop — then they MUST diverge, because
+    each round's aggregator loads its own aggregate unconditionally
+    (client_trainer.py:333): the stopping run seats an honest aggregate,
+    the other a 50x-scaled one. An engine path that silently dropped
+    stop_round would keep the streams identical and fail this test.
+    (No claim about counter RECOVERY is made: trashed ex-aggregators
+    pollute later aggregates, so even honest post-burst broadcasts keep
+    being rejected — the history-poisoning dynamic attack_sweep.py
+    measures.)"""
+    def run(stop_round):
+        spec = AttackSpec(kind="scale", strength=50.0, start_round=1,
+                          stop_round=stop_round)
+        eng = build_engine(poison_fn=make_poison_fn(spec))
+        return [eng.run_round(r) for r in range(6)]
+
+    burst = run(stop_round=3)
+    forever = run(stop_round=None)
+    for ra, rb in zip(burst[:3], forever[:3]):  # identical through the burst
+        assert ra.selected == rb.selected
+        assert ra.aggregator == rb.aggregator
+        np.testing.assert_allclose(ra.client_metrics, rb.client_metrics,
+                                   rtol=1e-6, atol=0)
+    post_aggregated = [r for r in range(3, 6)
+                      if forever[r].aggregator is not None]
+    assert post_aggregated  # the comparison needs a post-burst broadcast
+    assert any(
+        not np.allclose(burst[r].client_metrics, forever[r].client_metrics,
+                        rtol=1e-6, atol=0)
+        for r in range(3, 6)), "stop_round had no effect on the schedule"

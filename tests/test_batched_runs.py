@@ -178,6 +178,52 @@ def test_batched_single_run_works():
     assert np.all(np.isfinite(res.client_metrics))
 
 
+def test_batched_attack_matches_sequential_attacked_runs():
+    """Attack x batched-runs composition: R=3 runs-axis-batched federations
+    under a poisoning aggregator must reproduce 3 sequential attacked runs
+    — same elections, same rejected-counter trajectories, same metric
+    streams. The poison_fn's lax.cond schedule (start_round, every_k) must
+    fire identically inside the vmapped scan."""
+    from fedmse_tpu.federation.attack import AttackSpec, make_poison_fn
+
+    cfg = build_cfg()
+    data = build_data(cfg)
+    model = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+    spec = AttackSpec(kind="scale", strength=50.0, start_round=1)
+
+    seq = {}
+    for r in range(RUNS):
+        eng = RoundEngine(model, cfg, data, n_real=N,
+                          rngs=ExperimentRngs(run=r), model_type="hybrid",
+                          update_type="mse_avg", fused=True,
+                          poison_fn=make_poison_fn(spec))
+        seq[r] = eng.run_rounds(0, cfg.num_rounds)
+
+    bat = BatchedRunEngine(model, cfg, data, n_real=N, runs=RUNS,
+                           model_type="hybrid", update_type="mse_avg",
+                           poison_fn=make_poison_fn(spec))
+    outs, schedule, _ = bat.run_schedule_chunk(0, cfg.num_rounds,
+                                               np.ones(RUNS, bool))
+    attack_bit = False
+    for i in range(cfg.num_rounds):
+        for r in range(RUNS):
+            res = bat.process_round(r, i, schedule[i][r], outs, i)
+            ref = seq[r][i]
+            assert res.selected == ref.selected
+            assert res.aggregator == ref.aggregator
+            assert [row["rejected_updates"]
+                    for row in res.verification_results] == \
+                   [row["rejected_updates"]
+                    for row in ref.verification_results]
+            np.testing.assert_allclose(res.client_metrics,
+                                       ref.client_metrics,
+                                       rtol=1e-5, atol=1e-6)
+            attack_bit = attack_bit or any(
+                row["rejected_updates"] > 0
+                for row in res.verification_results)
+    assert attack_bit  # the attack actually bit (rejections occurred)
+
+
 def test_batched_time_metric_rejected():
     cfg = build_cfg(metric="time")
     data = build_data(cfg)

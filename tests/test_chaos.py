@@ -1,0 +1,346 @@
+"""Chaos fault injection (fedmse_tpu/chaos/): failure scenarios compiled
+into the fused schedule as per-round mask tensors, with the acceptance
+contracts pinned:
+
+  * zero-chaos equivalence — a ChaosSpec with every probability 0 produces
+    bit-identical states/metrics/selections to a chaos-free schedule on CPU
+    (the mask plumbing is the identity when all-clear, and the chaos key
+    stream is domain-separated so no other draw moves);
+  * a full-dropout round takes the no_aggregate path and freezes the
+    federation;
+  * an aggregator crash re-elects a surviving quota-eligible candidate on
+    device;
+  * broadcast-loss clients keep their entire local state across the merge;
+  * masks reproduce from seed (and respect the [start, stop) window);
+  * chaos composes with the batched runs axis (R batched chaotic runs ==
+    R sequential chaotic runs).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedmse_tpu.chaos import (ChaosSpec, make_chaos_masks, resilience_metrics,
+                              rounds_to_recover)
+from fedmse_tpu.config import CompatConfig, ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+from fedmse_tpu.federation import BatchedRunEngine, RoundEngine
+from fedmse_tpu.models import make_model
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+pytestmark = pytest.mark.chaos
+
+DIM = 12
+N = 4
+RUNS = 2
+
+
+def build_cfg(**kw):
+    return ExperimentConfig(
+        dim_features=DIM, network_size=N, epochs=2, batch_size=8,
+        compat=CompatConfig(vote_tie_break=False), **kw)
+
+
+def build_data(cfg):
+    clients = synthetic_clients(n_clients=N, dim=DIM, n_normal=120,
+                                n_abnormal=60)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(run=0).data_rng)
+    return stack_clients(clients, dev_x, cfg.batch_size)
+
+
+def build_engine(cfg, data, chaos=None, run=0, update_type="avg"):
+    m = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+    return RoundEngine(m, cfg, data, n_real=N, rngs=ExperimentRngs(run=run),
+                       model_type="hybrid", update_type=update_type,
+                       fused=True, chaos=chaos)
+
+
+# ---------------------------------------------------------------- spec ----
+
+def test_spec_validation_rejects_bad_probabilities():
+    for field in ("dropout_p", "straggler_p", "crash_p", "broadcast_loss_p"):
+        with pytest.raises(ValueError, match=field):
+            ChaosSpec(**{field: 1.5})
+        with pytest.raises(ValueError, match=field):
+            ChaosSpec(**{field: -0.1})
+
+
+def test_spec_validation_rejects_empty_window():
+    with pytest.raises(ValueError, match="stop_round"):
+        ChaosSpec(dropout_p=0.5, start_round=3, stop_round=3)
+    with pytest.raises(ValueError, match="start_round"):
+        ChaosSpec(start_round=-1)
+    assert ChaosSpec().is_null
+    assert not ChaosSpec(crash_p=0.1).is_null
+
+
+def test_chaos_requires_fused_engine():
+    cfg = build_cfg()
+    data = build_data(cfg)
+    m = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+    with pytest.raises(ValueError, match="fused"):
+        RoundEngine(m, cfg, data, n_real=N, rngs=ExperimentRngs(run=0),
+                    model_type="hybrid", update_type="avg", fused=False,
+                    chaos=ChaosSpec(dropout_p=0.5))
+
+
+# --------------------------------------------------------------- masks ----
+
+def test_masks_reproduce_from_seed_and_respect_window():
+    spec = ChaosSpec(dropout_p=0.5, straggler_p=0.3, crash_p=0.5,
+                     broadcast_loss_p=0.4, start_round=2, stop_round=5)
+    key = ExperimentRngs(run=0).chaos_key()
+    a = make_chaos_masks(spec, key, 0, 8, N)
+    b = make_chaos_masks(spec, key, 0, 8, N)
+    for la, lb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    # chunking-invariance: rounds [3, 6) sliced from a full build == a
+    # build that starts at 3 (masks key on the ABSOLUTE round index)
+    c = make_chaos_masks(spec, key, 3, 3, N)
+    for la, lc in zip(a, c):
+        np.testing.assert_array_equal(np.asarray(la)[3:6], np.asarray(lc))
+    # outside [2, 5) everything is all-clear
+    avail, strag, crash, drop = (np.asarray(t) for t in a)
+    clear = [0, 1, 5, 6, 7]
+    assert (avail[clear] == 1.0).all() and (strag[clear] == 0.0).all()
+    assert (drop[clear] == 0.0).all() and not crash[clear].any()
+    # ... and inside the window the nonzero probabilities actually fire
+    window = slice(2, 5)
+    assert (avail[window] == 0.0).any()
+    # a different run's chaos key gives a different stream
+    other = make_chaos_masks(spec, ExperimentRngs(run=1).chaos_key(), 0, 8, N)
+    assert any(not np.array_equal(np.asarray(la), np.asarray(lo))
+               for la, lo in zip(a, other))
+
+
+def test_chaos_key_is_domain_separated():
+    """Building masks must consume NOTHING from the training/eval streams:
+    chaos_key is a pure fold of the run root, and the fold counter + host
+    RNGs are untouched."""
+    rngs = ExperimentRngs(run=0)
+    fold_before = rngs._fold
+    state_before = rngs.select_rng.getstate()
+    k1 = rngs.chaos_key()
+    make_chaos_masks(ChaosSpec(dropout_p=0.5), k1, 0, 4, N)
+    k2 = rngs.chaos_key()
+    assert rngs._fold == fold_before
+    assert rngs.select_rng.getstate() == state_before
+    np.testing.assert_array_equal(jax.random.key_data(k1),
+                                  jax.random.key_data(k2))
+    # ... and the chaos key is not any key the training stream will draw
+    for _ in range(16):
+        assert not np.array_equal(jax.random.key_data(rngs.next_jax()),
+                                  jax.random.key_data(k1))
+
+
+# ------------------------------------------------- zero-chaos identity ----
+
+def test_zero_chaos_bit_identical_schedule():
+    """The acceptance contract: all-probabilities-0 ChaosSpec ==> the fused
+    schedule's states, metrics and host streams are bit-identical to a
+    chaos-free run on CPU."""
+    cfg = build_cfg()
+    data = build_data(cfg)
+    base = build_engine(cfg, data, chaos=None)
+    base_res = base.run_rounds(0, 3)
+    zero = build_engine(cfg, data, chaos=ChaosSpec())
+    zero_res = zero.run_rounds(0, 3)
+
+    for rb, rz in zip(base_res, zero_res):
+        assert rb.selected == rz.selected          # host stream untouched
+        assert rb.aggregator == rz.aggregator
+        assert rz.effective == rz.selected         # all-clear cohort
+        assert rz.crashed_aggregator is None
+        # a chaos-free program's divergence is NOT measured (None), while
+        # the chaos program measures it — even at probability zero
+        assert rb.divergence is None
+        assert rz.divergence is not None
+        np.testing.assert_array_equal(rb.client_metrics, rz.client_metrics)
+        np.testing.assert_array_equal(rb.min_valid, rz.min_valid)
+        np.testing.assert_array_equal(rb.tracking, rz.tracking)
+    for lb, lz in zip(jax.tree.leaves(jax.device_get(base.states)),
+                      jax.tree.leaves(jax.device_get(zero.states))):
+        np.testing.assert_array_equal(np.asarray(lb), np.asarray(lz))
+    assert base.host.aggregation_count.tolist() == \
+        zero.host.aggregation_count.tolist()
+
+
+# ------------------------------------------------------ fault semantics ----
+
+def test_full_dropout_takes_no_aggregate_path():
+    """Every client down => nobody trains, nobody votes, no_aggregate runs,
+    and the federation is frozen at its pre-round state."""
+    cfg = build_cfg()
+    data = build_data(cfg)
+    eng = build_engine(cfg, data, chaos=ChaosSpec(dropout_p=1.0))
+    p0 = [np.asarray(t).copy()
+          for t in jax.tree.leaves(jax.device_get(eng.states.params))]
+    results = eng.run_rounds(0, 2)
+    assert all(r.aggregator is None for r in results)
+    assert all(r.effective == [] for r in results)
+    for before, after in zip(
+            p0, jax.tree.leaves(jax.device_get(eng.states.params))):
+        np.testing.assert_array_equal(before, np.asarray(after))
+    mets = resilience_metrics(results)
+    assert mets["effective_participation"] == 0.0
+    assert mets["no_aggregator_rounds"] == 2
+    assert mets["quota_exhaustion_round"] == 0
+
+
+def test_aggregator_crash_reelects_quota_eligible_survivor():
+    """crash_p=1: the elected aggregator dies every round; the on-device
+    re-election pass must seat a DIFFERENT quota-eligible client."""
+    cfg = build_cfg(num_participants=1.0)  # full cohort: survivors exist
+    data = build_data(cfg)
+    eng = build_engine(cfg, data, chaos=ChaosSpec(crash_p=1.0))
+    results = eng.run_rounds(0, 3)
+    for r in results:
+        assert r.crashed_aggregator is not None
+        assert r.aggregator is not None
+        assert r.aggregator != r.crashed_aggregator
+        # the replacement obeys the anti-monopolization quota like any winner
+        assert r.aggregator in r.selected
+    # host quota books only the SEATED aggregator, never the crashed one
+    counts = eng.host.aggregation_count
+    crashed_only = set(r.crashed_aggregator for r in results) - \
+        set(r.aggregator for r in results)
+    for c in crashed_only:
+        assert counts[c] == 0
+    mets = resilience_metrics(results)
+    assert mets["re_elections"] == 3
+
+
+def test_crash_with_no_survivor_falls_back_to_no_aggregate():
+    """S=2 cohort: the crash leaves one survivor, who cannot vote for
+    itself — the re-election must come up empty (no_aggregate path)."""
+    cfg = build_cfg()  # num_participants=0.5 -> S=2
+    data = build_data(cfg)
+    eng = build_engine(cfg, data, chaos=ChaosSpec(crash_p=1.0))
+    results = eng.run_rounds(0, 2)
+    for r in results:
+        assert r.crashed_aggregator is not None
+        assert r.aggregator is None
+    mets = resilience_metrics(results)
+    assert mets["crash_outages"] == 2 and mets["re_elections"] == 0
+
+
+def test_broadcast_loss_keeps_local_state_across_merge():
+    """broadcast_loss_p=1: every receiver misses the broadcast — verifier
+    history never forms, rejected counters never move, prev_global stays at
+    init, and the only client holding the aggregate is the aggregator."""
+    cfg = build_cfg()
+    data = build_data(cfg)
+    eng = build_engine(cfg, data, chaos=ChaosSpec(broadcast_loss_p=1.0))
+    prev0 = [np.asarray(t).copy() for t in
+             jax.tree.leaves(jax.device_get(eng.states.prev_global))]
+    results = eng.run_rounds(0, 2)
+    assert any(r.aggregator is not None for r in results)
+    st = jax.device_get(eng.states)
+    assert not np.asarray(st.hist_seen).any()
+    assert (np.asarray(st.rejected) == 0).all()
+    for before, after in zip(prev0, jax.tree.leaves(st.prev_global)):
+        np.testing.assert_array_equal(before, np.asarray(after))
+    # divergence is reported (clients drifted apart on local training)
+    assert results[-1].divergence is not None
+    assert (results[-1].divergence >= 0).all()
+
+
+def test_chaos_composes_with_batched_runs():
+    """R batched chaotic runs == R sequential chaotic runs: same faults
+    (per-run domain-separated chaos streams), same elections, same metrics."""
+    cfg = build_cfg(num_rounds=3, num_runs=RUNS)
+    data = build_data(cfg)
+    spec = ChaosSpec(dropout_p=0.3, crash_p=0.3, broadcast_loss_p=0.2)
+    m = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+
+    seq = {}
+    for r in range(RUNS):
+        eng = RoundEngine(m, cfg, data, n_real=N, rngs=ExperimentRngs(run=r),
+                          model_type="hybrid", update_type="mse_avg",
+                          fused=True, chaos=spec)
+        seq[r] = eng.run_rounds(0, cfg.num_rounds)
+
+    bat = BatchedRunEngine(m, cfg, data, n_real=N, runs=RUNS,
+                           model_type="hybrid", update_type="mse_avg",
+                           chaos=spec)
+    outs, schedule, _ = bat.run_schedule_chunk(0, cfg.num_rounds,
+                                               np.ones(RUNS, bool))
+    fault_seen = False
+    for i in range(cfg.num_rounds):
+        for r in range(RUNS):
+            res = bat.process_round(r, i, schedule[i][r], outs, i)
+            ref = seq[r][i]
+            assert res.selected == ref.selected
+            assert res.aggregator == ref.aggregator
+            assert res.effective == ref.effective
+            assert res.crashed_aggregator == ref.crashed_aggregator
+            np.testing.assert_allclose(res.client_metrics,
+                                       ref.client_metrics,
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(res.divergence, ref.divergence,
+                                       rtol=1e-4, atol=1e-6)
+            fault_seen = fault_seen or res.effective != res.selected \
+                or res.crashed_aggregator is not None
+    assert fault_seen  # the spec actually injected something
+
+
+def test_chaos_chunking_invariant():
+    """Masks key on the ABSOLUTE round index, so the driver's chunked scan
+    and the per-round replay path (mid-chunk early-stop rewind,
+    main.py:run_combination) see identical faults: 3 chunks of 2 == 6
+    single-round dispatches."""
+    cfg = build_cfg()
+    data = build_data(cfg)
+    spec = ChaosSpec(dropout_p=0.3, crash_p=0.3, broadcast_loss_p=0.3)
+    a = build_engine(cfg, data, chaos=spec, update_type="mse_avg")
+    res_a = a.run_rounds(0, 2) + a.run_rounds(2, 2) + a.run_rounds(4, 2)
+    b = build_engine(cfg, data, chaos=spec, update_type="mse_avg")
+    res_b = [b.run_round_fused(i) for i in range(6)]
+    for ra, rb in zip(res_a, res_b):
+        assert ra.selected == rb.selected
+        assert ra.aggregator == rb.aggregator
+        assert ra.effective == rb.effective
+        assert ra.crashed_aggregator == rb.crashed_aggregator
+        np.testing.assert_allclose(ra.client_metrics, rb.client_metrics,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_dropped_clients_miss_the_broadcast():
+    """Offline is offline: a client that dropped out this round receives
+    no broadcast either — its verifier history must not move even when an
+    aggregation DID happen (the asymmetry the crash handling already has;
+    stragglers are merely slow, still online, and do receive)."""
+    cfg = build_cfg(num_participants=1.0)
+    data = build_data(cfg)
+    spec = ChaosSpec(dropout_p=0.5)
+    eng = build_engine(cfg, data, chaos=spec)
+    saw_down_while_aggregating = False
+    for r in range(4):
+        before = np.asarray(jax.device_get(eng.states.hist_seen)).copy()
+        res = eng.run_round_fused(r)
+        after = np.asarray(jax.device_get(eng.states.hist_seen))
+        # recompute this round's masks (pure function of key + round index)
+        masks = make_chaos_masks(spec, eng._chaos_key, r, 1, N)
+        down = np.asarray(masks.available)[0] <= 0
+        if res.aggregator is None:
+            np.testing.assert_array_equal(after, before)
+            continue
+        # down clients' history is frozen; online receivers all saw it
+        np.testing.assert_array_equal(after[down], before[down])
+        up_receivers = ~down
+        up_receivers[res.aggregator] = False
+        assert after[up_receivers].all()
+        saw_down_while_aggregating |= down.any()
+    assert saw_down_while_aggregating  # the scenario actually occurred
+
+
+# -------------------------------------------------------------- metrics ----
+
+def test_rounds_to_recover():
+    curve = [0.9, 0.5, 0.4, 0.6, 0.91, 0.92]
+    # burst rounds 1-2; pre-burst best 0.9; recovery (>= 0.89) at t=4
+    assert rounds_to_recover(curve, 1, 3, eps=0.01) == 1
+    assert rounds_to_recover(curve, 1, 3, eps=0.5) == 0   # 0.6 clears 0.4
+    assert rounds_to_recover([0.9, 0.1, 0.1, 0.1], 1, 2) is None  # never
+    assert rounds_to_recover(curve, 0, 3) is None  # no pre-burst baseline
