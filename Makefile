@@ -8,7 +8,7 @@ CXXFLAGS ?= -O3 -fPIC -Wall -Wextra
 LIB := fedmse_tpu/native/libfedmse_io.so
 
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
-        serve-bench chaos-sweep tpu-check
+        serve-bench chaos-sweep pipeline-bench tpu-check
 
 native: $(LIB)
 
@@ -43,6 +43,14 @@ serve-bench:
 chaos-sweep:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		python chaos_sweep.py --out CHAOS_r06.json
+
+# dispatch-pipeline benchmark (federation/pipeline.py): pipelined vs
+# serial chunk loop + host-gap telemetry (writes BENCH_PIPELINE_r06_cpu.json;
+# hermetic CPU like the tests — CPU must be neutral, the win is the
+# dispatch-bound TPU tunnel)
+pipeline-bench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python bench.py --pipeline-bench --out BENCH_PIPELINE_r06_cpu.json
 
 tpu-check:
 	python tpu_check.py

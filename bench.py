@@ -247,6 +247,79 @@ def measure_sweep(cfg, data, n_real: int, runs: int, timed_rounds: int):
     }
 
 
+def measure_pipeline(cfg, data, n_real: int, timed_rounds: int):
+    """sec/round for the CHUNKED DRIVER LOOP, pipelined vs serial (ISSUE 4
+    tentpole metric). Both sides run the same chunk split and pay the same
+    per-round host bookkeeping the real driver pays (RoundResult
+    absorption + metric reduction); the serial side dispatches, harvests
+    and bookkeeps before the next dispatch, the pipelined side
+    (federation/pipeline.py) enqueues chunk k+1 before chunk k's harvest
+    and bookkeeps while it runs. On dispatch-bound backends (the TPU
+    tunnel) the overlap hides the host phase; on compute-bound CPU the two
+    must be within noise — the device queue is never the bottleneck there.
+    Warm-up passes compile both programs; reported numbers are the min
+    over repeated warm passes (_min_over_reps bursty-tunnel rule)."""
+    import numpy as np
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.federation.pipeline import run_pipelined_schedule
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    model = make_model("hybrid", cfg.dim_features,
+                       shrink_lambda=cfg.shrink_lambda)
+    engine = RoundEngine(model, cfg, data, n_real=n_real,
+                         rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                         model_type="hybrid", update_type="mse_avg",
+                         fused=True)
+    chunk = cfg.fused_schedule_chunk
+
+    def bookkeep(results, sink):
+        # the host work the real driver pays per round (main.py bookkeep):
+        # per-round metric reduction over the absorbed RoundResults
+        sink.extend(float(np.nanmean(r.client_metrics)) for r in results)
+
+    def serial_pass():
+        engine.reset_federation()
+        sink, start = [], 0
+        t0 = time.time()
+        while start < timed_rounds:
+            k = min(chunk, timed_rounds - start)
+            results, _, _ = engine.run_schedule_chunk(start, k)
+            bookkeep(results, sink)
+            start += k
+        return time.time() - t0, sink
+
+    telemetry = {}
+
+    def pipelined_pass():
+        engine.reset_federation()
+        sink = []
+        t0 = time.time()
+        stats = run_pipelined_schedule(
+            engine, 0, timed_rounds, chunk,
+            lambda results, sec: bookkeep(results, sink),
+            can_rewind=False)
+        elapsed = time.time() - t0
+        telemetry["stats"] = stats
+        return elapsed, sink
+
+    serial_pass()     # warm-up: every jit compile lands here
+    pipelined_pass()
+    ser_sec, ser_curve = _min_over_reps(serial_pass)
+    pip_sec, pip_curve = _min_over_reps(pipelined_pass)
+    np.testing.assert_array_equal(ser_curve, pip_curve)  # same math, timed
+    return {
+        "rounds": timed_rounds,
+        "fused_schedule_chunk": chunk,
+        "serial_sec_per_round": round(ser_sec / timed_rounds, 5),
+        "pipelined_sec_per_round": round(pip_sec / timed_rounds, 5),
+        "speedup_pipelined_vs_serial": (round(ser_sec / pip_sec, 3)
+                                        if pip_sec else None),
+        "pipeline": telemetry["stats"].summary(),
+        "final_round_mean_auc": round(float(pip_curve[-1]), 5),
+    }
+
+
 def build_data(cfg, n_clients: int = 10, dataset=None):
     """Stacked federation tensors for a benchmark scenario.
 
@@ -313,18 +386,22 @@ def main():
     # the prep tool when absent).
     paper = "--paper-scale" in sys.argv
 
-    def _int_flag(name, default):
+    def _flag(name, default, cast=str):
         value = default  # last occurrence wins, like argparse
         for i, a in enumerate(sys.argv):
             if a == name and i + 1 < len(sys.argv):
-                value = int(sys.argv[i + 1])
+                value = cast(sys.argv[i + 1])
             elif a.startswith(name + "="):
-                value = int(a.split("=", 1)[1])
+                value = cast(a.split("=", 1)[1])
         return value
+
+    def _int_flag(name, default):
+        return _flag(name, default, cast=int)
 
     n_clients = _int_flag("--clients", 10)
     num_runs = _int_flag("--num-runs", None)
     sweep_runs = _int_flag("--sweep-runs", None)
+    pipeline_bench = "--pipeline-bench" in sys.argv
     if sweep_runs is not None and sweep_runs < 1:
         sys.exit(f"--sweep-runs expects a positive integer, got {sweep_runs}")
     chunk = _int_flag("--chunk", None)
@@ -353,6 +430,44 @@ def main():
         from fedmse_tpu.config import paper_scale
         cfg = paper_scale(cfg)
     data, n_real, rngs = build_data(cfg, n_clients)
+
+    if pipeline_bench:
+        # pipelined-vs-serial chunk-loop mode (ISSUE 4): the whole driver
+        # loop including host bookkeeping, chunk k+1 overlapping chunk k's
+        # harvest. Defaults favor multiple chunk boundaries per pass
+        # (chunk 4 x 4 chunks); --chunk / --rounds override.
+        chunk = chunk or 4
+        timed_rounds = _int_flag("--rounds", 4 * chunk)
+        cfg = cfg.replace(fused_schedule_chunk=chunk)
+        device = jax.devices()[0]
+        out = {
+            "metric": f"sec/round, pipelined vs serial chunk loop "
+                      f"({timed_rounds} rounds, chunk {chunk}, N-BaIoT "
+                      f"{n_clients}-client IID, hybrid SAE-CEN + mse_avg, "
+                      f"50% participation)",
+            "value": None,  # filled from pipelined_sec_per_round below
+            "unit": "s",
+            "device": str(device),
+            "platform": device.platform,
+            "mode": "pipelined vs serial fused-scan chunk loop "
+                    "(federation/pipeline.py)",
+            "data_seed": cfg.data_seed,
+            "data_source": ("nbaiot" if os.path.isdir(NBAIOT_ROOT)
+                            or n_clients != 10 else "synthetic-fallback"),
+        }
+        out.update(measure_pipeline(cfg, data, n_real, timed_rounds))
+        out["value"] = out["pipelined_sec_per_round"]
+        reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
+        if reason and reason != "1":
+            out["tpu_fallback_reason"] = reason
+        out.update(capture_provenance())
+        line = json.dumps(out)
+        print(line)
+        dest = _flag("--out", None)
+        if dest:
+            with open(dest, "w") as f:
+                f.write(line + "\n")
+        return
 
     if sweep_runs is not None:
         # sec/sweep mode (ISSUE 1): R runs of the quick-run schedule,

@@ -108,10 +108,41 @@ def main():
         max_bucket=max(BATCHES))
     calibration = fit_calibration(
         engine, rng.normal(size=(N_GATEWAYS, 256, dim)).astype(np.float32))
-    engine.warmup()  # every bucket compiles outside the timed sections
+    # every bucket compiles outside the timed sections; per-bucket compile
+    # seconds ride into the artifact (the cost --serve-warmup front-loads)
+    warmup_sec = engine.warmup()
 
     rows = rng.normal(size=(total_rows, dim)).astype(np.float32)
     gws = rng.integers(0, N_GATEWAYS, size=total_rows).astype(np.int32)
+
+    # cold-vs-warm first request (ISSUE 4 satellite): a FRESH engine whose
+    # largest bucket has never been hit pays trace + compile (or a
+    # persistent-cache load when enable_compilation_cache found a prior
+    # run's binary) on the first request; the same request repeated is the
+    # steady-state dispatch. This is the tail-latency spike --serve-warmup
+    # removes from the served stream.
+    cold_engine = ServingEngine.from_federation(
+        model, model_type, params,
+        train_x=train_x if model_type == "hybrid" else None,
+        max_bucket=max(BATCHES))
+    probe_n = max(BATCHES)
+    t0 = time.perf_counter()
+    cold_engine.score(rows[:probe_n], gws[:probe_n])
+    cold_ms = (time.perf_counter() - t0) * 1000
+    t0 = time.perf_counter()
+    cold_engine.score(rows[:probe_n], gws[:probe_n])
+    warm_ms = (time.perf_counter() - t0) * 1000
+    first_request = {
+        "rows": probe_n,
+        "bucket": probe_n,
+        "cold_first_request_ms": round(cold_ms, 3),
+        "warm_request_ms": round(warm_ms, 3),
+        "cold_vs_warm": round(cold_ms / warm_ms, 1) if warm_ms else None,
+        "note": "cold = fresh engine, first hit of its largest bucket "
+                "(trace + compile/cache-load + dispatch); warm = same "
+                "request repeated. --serve-warmup precompiles every "
+                "bucket so served streams never pay the cold column.",
+    }
 
     # steady-state protocol: untimed warm pass per configuration, then the
     # timed pass (the bursty-tunnel min-over-reps rule is bench.py's; this
@@ -144,6 +175,9 @@ def main():
         "unbatched_baseline": baseline,
         "batched": results,
         "speedup_batch1024_vs_unbatched": results[-1]["speedup_vs_unbatched"],
+        "first_request": first_request,
+        "warmup_sec_per_bucket": {str(k): round(v, 4)
+                                  for k, v in warmup_sec.items()},
         "buckets": engine.buckets,
         "device": str(device),
         "platform": device.platform,

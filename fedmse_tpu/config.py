@@ -259,6 +259,20 @@ class ExperimentConfig:
     # unaffected (the driver clamps the chunk to the rounds remaining).
     fused_schedule: bool = True
     fused_schedule_chunk: int = 32
+    # pipelined chunk execution (federation/pipeline.py): chunk k+1's scan
+    # is enqueued BEFORE chunk k's outputs are consumed (the quota carry
+    # feeds forward on device, so the dispatch does not wait for host
+    # bookkeeping), and chunk k is harvested one chunk late from
+    # async-started device→host copies — host logging/IO overlaps the
+    # in-flight scan instead of idling the device through it. Final states
+    # and artifacts are pinned bit-identical to the serial chunk loop
+    # (tests/test_pipeline.py), including mid-chunk early stop (the stop
+    # reuses the snapshot + rewind-and-replay machinery; the speculative
+    # in-flight chunk is discarded). Default ON for the fused schedule;
+    # --no-pipeline (or fused_pipeline=False) keeps the serial loop, and
+    # the driver falls back to serial automatically with --resume-dir
+    # (per-chunk checkpoints need a synchronous consistent state).
+    fused_pipeline: bool = True
 
     compat: CompatConfig = dataclasses.field(default_factory=CompatConfig)
 

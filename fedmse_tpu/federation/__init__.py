@@ -6,13 +6,20 @@ from fedmse_tpu.federation.voting import elect_aggregator, make_mse_scores_fn
 from fedmse_tpu.federation.verification import make_verify_fn
 from fedmse_tpu.federation.rounds import RoundEngine, RoundResult
 from fedmse_tpu.federation.batched import BatchedRunEngine
+from fedmse_tpu.federation.pipeline import (InFlightChunk, PipelineStats,
+                                            run_pipelined_batched,
+                                            run_pipelined_schedule)
 
 __all__ = [
     "AttackSpec",
     "BatchedRunEngine",
     "ClientStates",
+    "InFlightChunk",
+    "PipelineStats",
     "RoundEngine",
     "RoundResult",
+    "run_pipelined_batched",
+    "run_pipelined_schedule",
     "elect_aggregator",
     "init_client_states",
     "make_aggregate_fn",

@@ -35,20 +35,25 @@ and early-stop rounds (tests/test_batched_runs.py).
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+# hoisted import (no cycle: chaos.masks pulls only jax + chaos.spec) —
+# per-chunk dispatch prep pays no import lookup
+from fedmse_tpu.chaos.masks import make_batched_chaos_masks
 from fedmse_tpu.config import ExperimentConfig
 from fedmse_tpu.data.stacking import FederatedData
+from fedmse_tpu.federation.pipeline import InFlightChunk
 from fedmse_tpu.federation.rounds import (RoundResult, _PROGRAM_CACHE,
                                           _cache_put, _client_axis_is_sharded,
                                           _engine_programs, absorb_fused_out,
                                           verification_tensors)
 from fedmse_tpu.federation.state import (HostState, init_batched_client_states)
-from fedmse_tpu.parallel.mesh import host_fetch
+from fedmse_tpu.parallel.mesh import host_fetch, host_fetch_async
 from fedmse_tpu.utils.seeding import batched_run_keys, make_run_rngs
 
 
@@ -111,6 +116,24 @@ class BatchedRunEngine:
         self.host = [HostState.create(self.n_real) for _ in range(self.runs)]
         self._chaos_keys = ([r.chaos_key() for r in self.rngs]
                             if self.chaos is not None else None)
+        # whole-schedule per-run chaos-mask cache (see _chaos_masks)
+        self._chaos_premade = None
+        self._chaos_horizon = 0
+
+    def _chaos_masks(self, start_round: int, k: int):
+        """[k, R, ...]-stacked per-run fault tensors for the chunk — same
+        hoist as RoundEngine._chaos_masks: the whole schedule's masks are
+        expanded once (pure function of spec × per-run keys × absolute
+        round index) and chunks take slices; a replay recomputes nothing
+        and an out-of-horizon request regrows the cache once."""
+        end = start_round + k
+        if self._chaos_premade is None or end > self._chaos_horizon:
+            self._chaos_horizon = max(end, self.cfg.num_rounds)
+            self._chaos_premade = make_batched_chaos_masks(
+                self.chaos, self._chaos_keys, 0, self._chaos_horizon,
+                self.n_pad)
+        return jax.tree.map(lambda t: t[start_round:end],
+                            self._chaos_premade)
 
     @property
     def compact(self) -> bool:
@@ -146,28 +169,30 @@ class BatchedRunEngine:
                             for h in self.host]).astype(np.int32)
         return jnp.asarray(stacked)
 
-    def run_schedule_chunk(self, start_round: int, k: int,
-                           active: np.ndarray,
-                           schedule: Optional[list] = None,
-                           keys: Optional[jax.Array] = None,
-                           active_rounds: Optional[np.ndarray] = None,
-                           agg_count: Optional[jnp.ndarray] = None):
-        """k rounds × R runs in ONE dispatch.
+    def dispatch_schedule_chunk(self, start_round: int, k: int,
+                                active: np.ndarray,
+                                schedule: Optional[list] = None,
+                                keys: Optional[jax.Array] = None,
+                                active_rounds: Optional[np.ndarray] = None,
+                                agg_count: Optional[jnp.ndarray] = None,
+                                snapshot: bool = False) -> InFlightChunk:
+        """ENQUEUE k rounds × R runs as one dispatch and return without
+        waiting (the runs-axis twin of RoundEngine.dispatch_schedule_chunk;
+        federation/pipeline.py). Device→host output copies start
+        immediately; `agg_count` feeds the previous chunk's device-resident
+        quota forward; `snapshot=True` copies the chunk-entry states for
+        the rewind protocol.
 
         `active` [R] bool marks runs whose early stop has not fired; their
-        lanes advance, the rest stay frozen. Returns (outs, schedule, keys):
-        the host-fetched FusedRoundOut stacked on leading [k, R] axes plus
-        the selections/keys that produced it, so the driver can REPLAY the
-        chunk after a mid-chunk stop — same `schedule`/`keys`, a tighter
-        `active_rounds` [k, R], and the chunk-ENTRY `agg_count` (the host
-        counters have absorbed the chunk's valid rounds by replay time, and
-        feeding post-chunk quota into the replay would change elections).
-        Selections and keys are drawn from each run's own streams in round
-        order — stream-identical to k successive sequential-driver rounds
-        per run; on a replay nothing new is drawn.
-        """
+        lanes advance, the rest stay frozen. `schedule`/`keys`/
+        `active_rounds` replay a chunk with recorded draws and a tighter
+        [k, R] freeze matrix (see run_schedule_chunk). Selections and keys
+        are drawn from each run's own streams in round order —
+        stream-identical to k successive sequential-driver rounds per run;
+        on a replay nothing new is drawn."""
         if self._scan is None or self._scan_compact != self.compact:
             self._build()
+        snap = (jax.tree.map(jnp.copy, self.states) if snapshot else None)
         if schedule is None:
             schedule = [[self.select_clients(r) for r in range(self.runs)]
                         for _ in range(k)]
@@ -184,17 +209,46 @@ class BatchedRunEngine:
                 masks[i, r, schedule[i][r]] = 1.0
         extra = ()
         if self.chaos is not None:
-            from fedmse_tpu.chaos import make_batched_chaos_masks
-            # pure function of (spec, per-run keys, absolute round index):
-            # a replay recomputes bit-identical fault tensors
-            extra = (make_batched_chaos_masks(self.chaos, self._chaos_keys,
-                                              start_round, k, self.n_pad),)
-        self.states, _, outs = self._scan(
+            # sliced from the hoisted whole-schedule expansion; a replay
+            # sees bit-identical fault tensors (absolute-round keying)
+            extra = (self._chaos_masks(start_round, k),)
+        t0 = time.time()
+        self.states, out_agg, outs = self._scan(
             self.states, self.data, self._ver_x, self._ver_m,
             jnp.asarray(sel_idx), jnp.asarray(masks), agg_count,
             keys, jnp.arange(start_round, start_round + k, dtype=jnp.int32),
             jnp.asarray(np.ascontiguousarray(active_rounds)), *extra)
-        return host_fetch(outs), schedule, keys
+        return InFlightChunk(start_round=start_round, n_rounds=k,
+                             schedule=schedule, keys=keys, outs=outs,
+                             agg_count=out_agg,
+                             harvest=host_fetch_async(outs),
+                             t_dispatch=t0, snap_states=snap)
+
+    def harvest_schedule_chunk(self, chunk: InFlightChunk):
+        """Block on a dispatched chunk's device→host copies. Returns
+        (outs, schedule, keys) — host-counter absorption stays with the
+        driver via process_round (see class docstring)."""
+        return chunk.harvest(), chunk.schedule, chunk.keys
+
+    def run_schedule_chunk(self, start_round: int, k: int,
+                           active: np.ndarray,
+                           schedule: Optional[list] = None,
+                           keys: Optional[jax.Array] = None,
+                           active_rounds: Optional[np.ndarray] = None,
+                           agg_count: Optional[jnp.ndarray] = None):
+        """k rounds × R runs in ONE dispatch (dispatch + immediate harvest;
+        the pipelined executor splits the two — federation/pipeline.py).
+
+        Returns (outs, schedule, keys): the host-fetched FusedRoundOut
+        stacked on leading [k, R] axes plus the selections/keys that
+        produced it, so the driver can REPLAY the chunk after a mid-chunk
+        stop — same `schedule`/`keys`, a tighter `active_rounds` [k, R],
+        and the chunk-ENTRY `agg_count` (the host counters have absorbed
+        the chunk's valid rounds by replay time, and feeding post-chunk
+        quota into the replay would change elections)."""
+        return self.harvest_schedule_chunk(self.dispatch_schedule_chunk(
+            start_round, k, active, schedule=schedule, keys=keys,
+            active_rounds=active_rounds, agg_count=agg_count))
 
     def process_round(self, run: int, round_index: int, selected: List[int],
                       outs, chunk_pos: int) -> RoundResult:

@@ -1,0 +1,300 @@
+"""Pipelined chunk execution: overlap host bookkeeping with the next
+chunk's in-flight scan (DESIGN.md §10).
+
+PROFILE_r04 pins the regime this module exists for: the federation is
+dispatch-bound on TPU (device busy share 0.87%, ~0.29 s of per-dispatch
+overhead against ~11 ms of per-round compute). Chunked `lax.scan`
+amortized per-ROUND dispatches, but the chunk loop itself stayed strictly
+serial — `run_schedule_chunk` blocked on `host_fetch(outs)` before any
+bookkeeping, and the next chunk was not enqueued until bookkeeping
+finished, so the device idled through every host phase and the host
+blocked through every scan. The standard cure at this regime (MLPerf TPU
+pod scaling, arxiv 1909.09756; TPU-KNN, arxiv 2206.14286) is to keep the
+accelerator's queue non-empty, not to make kernels faster.
+
+Three moves, all exploiting JAX's async dispatch:
+
+  1. **Pre-dispatch.** Chunk k+1's host prep (selection stacking, key
+     batch, chaos-mask slice — the masks themselves are hoisted to one
+     whole-schedule expansion) runs and its scan is ENQUEUED before chunk
+     k's outputs are touched. The only true data dependency between
+     chunks — the aggregation-quota counter that gates elections — is
+     carried on DEVICE: the fused scan already returns its post-chunk
+     `agg_count`, and feeding that array straight into the next dispatch
+     unties the dispatch from host bookkeeping entirely (the device value
+     is bit-identical to the host-recomputed one: both increment the
+     elected aggregator once per aggregated round).
+  2. **Non-blocking harvest.** `host_fetch_async` (parallel/mesh.py)
+     starts device→host copies of chunk k's output stack immediately
+     after its dispatch; the copies land while chunk k+1 computes, and
+     the harvest — one chunk late — finds the bytes already host-side.
+     `RoundResult` construction, logging and ResultsWriter IO then
+     overlap the in-flight scan.
+  3. **Late early-stop.** A stop detected in chunk k's results while
+     chunk k+1 is already in flight reuses the existing snapshot +
+     rewind-and-replay machinery: the speculative chunk is discarded
+     (its states overwritten from a snapshot, its outputs never
+     harvested), and a mid-chunk stop replays the prefix with the SAME
+     recorded selections/keys — so final states stay bit-identical to
+     the serial path, including under chaos masks and attack bursts
+     (tests/test_pipeline.py).
+
+Host-state subtlety: the host-side snapshots a chunk needs for its own
+rewind (host counters at chunk ENTRY) cannot be taken at dispatch time —
+in pipelined order the predecessor's bookkeeping has not run yet. They
+are attached LAZILY, right after the predecessor chunk is absorbed, when
+`engine.host` is exactly the chunk-entry state.
+
+Telemetry: `PipelineStats.host_gaps` records, per chunk boundary,
+`t_dispatch(k+1) - t_harvest_done(k)` — the wall time the device queue
+sat empty waiting for the host (harvest completion is the measurable
+proxy for device completion). Serial execution makes this positive (the
+whole host phase); the pipeline makes it negative by construction
+(dispatch precedes harvest in program order). profile_fused.py persists
+it so future PROFILE captures track dispatch-overlap regressions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from fedmse_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class InFlightChunk:
+    """One dispatched-but-not-yet-harvested schedule chunk.
+
+    Built by `dispatch_schedule_chunk` (federation/rounds.py single-run,
+    federation/batched.py runs-axis): the scan is enqueued, device→host
+    output copies are started, and the host moves on. `harvest` blocks on
+    those copies and returns the host-side output stack.
+    """
+
+    start_round: int
+    n_rounds: int
+    schedule: list                 # host-drawn selections (replay input)
+    keys: Any                      # per-round PRNG keys (replay input)
+    outs: Any                      # device-resident stacked FusedRoundOut
+    agg_count: Any                 # device post-chunk quota (feeds the next
+                                   # dispatch without a host round-trip)
+    harvest: Callable[[], Any]     # blocks → host outs (copies pre-started)
+    t_dispatch: float              # host clock when the scan was enqueued
+    snap_states: Any = None        # chunk-entry device snapshot (the scan
+                                   # donates its input buffers)
+    # attached LAZILY by the pipeline once the predecessor chunk's
+    # bookkeeping completes — only then is the host state current at this
+    # chunk's entry (see module docstring)
+    host_snap: Any = None          # single-run: HostState copy at entry
+    entry_agg: Any = None          # batched: host-derived quota at entry
+    active: Any = None             # batched: [R] live-run mask at dispatch
+
+
+@dataclasses.dataclass
+class PipelineStats:
+    """Per-run telemetry of the pipelined executor."""
+
+    chunks: int = 0
+    redispatches: int = 0          # speculative chunks discarded + re-run
+    host_gaps: List[float] = dataclasses.field(default_factory=list)
+
+    def summary(self) -> dict:
+        gaps = self.host_gaps
+        return {
+            "chunks": self.chunks,
+            "redispatches": self.redispatches,
+            "host_gap_s": [round(g, 5) for g in gaps],
+            "host_gap_mean_s": (round(float(np.mean(gaps)), 5)
+                                if gaps else None),
+            # HOST-side enqueue ordering: every next dispatch was enqueued
+            # before the previous harvest completed. This guards against
+            # the loop re-serializing (a driver change that harvests
+            # before dispatching flips the gap positive); it cannot see a
+            # BACKEND that went synchronous under the same loop order —
+            # that regression shows up in the pipelined-vs-serial
+            # sec/round comparison (bench.py --pipeline-bench), not here.
+            "overlapped": bool(gaps) and all(g <= 0 for g in gaps),
+        }
+
+
+def run_pipelined_schedule(engine, start_round: int, num_rounds: int,
+                           chunk_size: int,
+                           consume: Callable[[list, float], Optional[int]],
+                           can_rewind: bool = True) -> PipelineStats:
+    """Drive a RoundEngine's fused schedule with double-buffered chunks.
+
+    `consume(results, sec_per_round)` absorbs one harvested chunk's
+    RoundResults into driver bookkeeping (logging, writer IO, early-stop
+    evaluation) and returns the 0-based position of the stop round inside
+    the chunk, or None. It runs while the NEXT chunk's scan is in flight.
+
+    `can_rewind=False` promises consume never stops (no early stopping):
+    snapshots are skipped entirely. With `can_rewind=True` every chunk
+    carries a chunk-entry device snapshot + (lazily attached) host
+    snapshot, and a stop follows the serial loop's exact protocol:
+
+      * stop at a NON-final round of chunk k → restore chunk k's entry
+        snapshots, replay the prefix round-by-round with the recorded
+        selections/keys (`run_round_fused`), discard the in-flight k+1;
+      * stop at the FINAL round of chunk k → chunk k's outputs stand; the
+        correct final states are the in-flight k+1's ENTRY snapshot (the
+        speculative dispatch donated-and-advanced `engine.states` past
+        the stop), which is restored; k+1's outputs are never harvested.
+
+    The host RNG streams advance one chunk ahead of the serial loop after
+    a stop (chunk k+1's selections were drawn before the stop was known),
+    but nothing observes them afterwards — the combination is over and
+    every replay uses recorded draws.
+    """
+    stats = PipelineStats()
+    prev: Optional[InFlightChunk] = None
+    round_index = start_round
+
+    def absorb(chunk: InFlightChunk,
+               successor: Optional[InFlightChunk]) -> bool:
+        results, schedule, keys = engine.harvest_schedule_chunk(chunk)
+        t_done = time.time()
+        if successor is not None:
+            stats.host_gaps.append(successor.t_dispatch - t_done)
+        sec = (t_done - chunk.t_dispatch) / chunk.n_rounds
+        stop = consume(results, sec)
+        if stop is None:
+            return False
+        done = stop + 1
+        if done < chunk.n_rounds:
+            # mid-chunk stop: rewind to the chunk-entry snapshots and
+            # replay the prefix with identical inputs (serial protocol)
+            engine.states = chunk.snap_states
+            engine.host = chunk.host_snap
+            for jj in range(done):
+                engine.run_round_fused(chunk.start_round + jj,
+                                       selected=schedule[jj], key=keys[jj])
+        elif successor is not None:
+            # stop at the chunk's final round with the successor already
+            # in flight: its entry snapshot IS the post-stop state
+            engine.states = successor.snap_states
+        return True
+
+    while round_index < num_rounds:
+        k = min(chunk_size, num_rounds - round_index)
+        cur = engine.dispatch_schedule_chunk(
+            round_index, k,
+            agg_count=None if prev is None else prev.agg_count,
+            snapshot=can_rewind)
+        stats.chunks += 1
+        if prev is not None and absorb(prev, cur):
+            return stats  # cur is speculative garbage: never harvested
+        if can_rewind:
+            cur.host_snap = engine.host.copy()
+        prev = cur
+        round_index += k
+    if prev is not None:
+        absorb(prev, None)
+    return stats
+
+
+def run_pipelined_batched(engine, num_rounds: int, chunk_size: int,
+                          consume) -> PipelineStats:
+    """Drive a BatchedRunEngine's schedule with double-buffered chunks.
+
+    `consume(outs, schedule, keys, start_round, k, sec, active)` absorbs
+    one harvested chunk — calling `engine.process_round` for every valid
+    (round, run) entry, exactly like the serial loop — and returns a
+    per-run list of newly-fired stop positions (None = run did not stop
+    in this chunk). Runs whose `active` flag is False are already frozen
+    and must be skipped by consume.
+
+    Stop protocol (the batched serial loop's, adapted to speculation):
+    when ANY run stops in chunk k while chunk k+1 is in flight, k+1 was
+    dispatched with a stale active mask (the stopped lane advanced), so
+    it is discarded and — unless every run is now stopped — RE-dispatched
+    with the same recorded schedule/keys, the corrected mask, and the
+    host-derived (now-correct) quota. Mid-chunk stops additionally rewind
+    chunk k to its entry snapshot and replay it with the per-round freeze
+    matrix and the chunk-entry quota, matching the serial rewind exactly;
+    final-round-only stops restore the speculative chunk's entry snapshot
+    (= the correct post-chunk-k states). Re-dispatches are rare (one per
+    stopping chunk) and cost one extra dispatch — the price of
+    speculation, paid only when the speculation was wrong.
+    """
+    runs = engine.runs
+    stopped = np.zeros(runs, dtype=bool)
+    stats = PipelineStats()
+    prev: Optional[InFlightChunk] = None
+    round_index = 0
+
+    def fix_states(chunk: InFlightChunk, stop_pos,
+                   successor: Optional[InFlightChunk]) -> bool:
+        """Serial-equivalent device state after chunk's stops; True when
+        any run newly stopped (the successor must be re-dispatched)."""
+        if not any(p is not None for p in stop_pos):
+            return False
+        if any(p is not None and p < chunk.n_rounds - 1 for p in stop_pos):
+            # mid-chunk stop: rewind + replay with the freeze matrix and
+            # the chunk-ENTRY quota (federation/batched.py docstring)
+            engine.states = chunk.snap_states
+            act2 = np.zeros((chunk.n_rounds, runs), dtype=bool)
+            for i in range(chunk.n_rounds):
+                for r in range(runs):
+                    act2[i, r] = chunk.active[r] and (
+                        stop_pos[r] is None or i <= stop_pos[r])
+            engine.run_schedule_chunk(chunk.start_round, chunk.n_rounds,
+                                      chunk.active, schedule=chunk.schedule,
+                                      keys=chunk.keys, active_rounds=act2,
+                                      agg_count=chunk.entry_agg)
+        elif successor is not None:
+            # stops only at the final round: post-chunk states are the
+            # speculative successor's entry snapshot
+            engine.states = successor.snap_states
+        return True
+
+    while round_index < num_rounds and not stopped.all():
+        k = min(chunk_size, num_rounds - round_index)
+        active = ~stopped
+        cur = engine.dispatch_schedule_chunk(
+            round_index, k, active,
+            agg_count=None if prev is None else prev.agg_count,
+            snapshot=True)
+        cur.active = active.copy()
+        stats.chunks += 1
+        if prev is not None:
+            outs, schedule, keys = engine.harvest_schedule_chunk(prev)
+            t_done = time.time()
+            stats.host_gaps.append(cur.t_dispatch - t_done)
+            sec = (t_done - prev.t_dispatch) / prev.n_rounds
+            stop_pos = consume(outs, schedule, keys, prev.start_round,
+                               prev.n_rounds, sec, prev.active)
+            if fix_states(prev, stop_pos, cur):
+                for r in range(runs):
+                    if stop_pos[r] is not None:
+                        stopped[r] = True
+                if stopped.all():
+                    return stats  # cur discarded; states already fixed
+                # the speculative chunk ran stopped lanes live (and, after
+                # a mid-chunk rewind, from pre-replay states): re-dispatch
+                # from the corrected state with the SAME recorded
+                # schedule/keys and the corrected lane mask
+                active = ~stopped
+                cur = engine.dispatch_schedule_chunk(
+                    cur.start_round, cur.n_rounds, active,
+                    schedule=cur.schedule, keys=cur.keys, snapshot=True)
+                cur.active = active.copy()
+                stats.redispatches += 1
+        # host counters are current through cur's predecessor only now —
+        # attach cur's entry quota for a potential future rewind
+        cur.entry_agg = engine._agg_count()
+        prev = cur
+        round_index += k
+    if prev is not None:
+        outs, schedule, keys = engine.harvest_schedule_chunk(prev)
+        sec = (time.time() - prev.t_dispatch) / prev.n_rounds
+        stop_pos = consume(outs, schedule, keys, prev.start_round,
+                           prev.n_rounds, sec, prev.active)
+        fix_states(prev, stop_pos, None)
+    return stats

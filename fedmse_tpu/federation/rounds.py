@@ -25,6 +25,7 @@ voter + scalar metrics out. Everything heavy stays on device.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -32,15 +33,19 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+# masks.py only pulls jax + chaos.spec — no cycle back into federation;
+# hoisted to module scope so per-chunk dispatch prep pays no import lookup
+from fedmse_tpu.chaos.masks import make_chaos_masks
 from fedmse_tpu.config import ExperimentConfig
 from fedmse_tpu.data.stacking import FederatedData
 from fedmse_tpu.evaluation.evaluator import make_evaluate_all
 from fedmse_tpu.federation.aggregation import make_aggregate_fn
 from fedmse_tpu.federation.local_training import make_local_train_all
+from fedmse_tpu.federation.pipeline import InFlightChunk
 from fedmse_tpu.federation.state import ClientStates, HostState, init_client_states
 from fedmse_tpu.federation.verification import make_verify_fn
 from fedmse_tpu.federation.voting import elect_aggregator, make_mse_scores_fn
-from fedmse_tpu.parallel.mesh import host_fetch
+from fedmse_tpu.parallel.mesh import host_fetch, host_fetch_async
 from fedmse_tpu.utils.logging import get_logger
 from fedmse_tpu.utils.seeding import ExperimentRngs
 
@@ -280,6 +285,10 @@ class RoundEngine:
                 "program; construct the engine with fused=True (and "
                 "profile=False)")
         self._chaos_key = rngs.chaos_key() if chaos is not None else None
+        # whole-schedule chaos-mask cache (see _chaos_masks): expanded once,
+        # sliced per chunk — keeps mask generation off the dispatch path
+        self._chaos_premade = None
+        self._chaos_horizon = 0
         self._fused_round = None
         self._fused_scan = None
         self._fused_compact = None  # compact value baked into the programs
@@ -379,14 +388,30 @@ class RoundEngine:
         self.host = HostState.create(self.n_real)
         if self.chaos is not None:
             self._chaos_key = self.rngs.chaos_key()
+            # callers may have swapped self.rngs (bench re-seeds runs), so
+            # the key — and the premade mask tensors — can change here
+            self._chaos_premade = None
+            self._chaos_horizon = 0
 
     def _chaos_masks(self, start_round: int, n_rounds: int):
         """[n_rounds]-stacked fault tensors for the chunk — a pure function
         of (spec, chaos key, absolute round index), so chunked, replayed and
-        per-round dispatches all see identical masks (chaos/masks.py)."""
-        from fedmse_tpu.chaos import make_chaos_masks
-        return make_chaos_masks(self.chaos, self._chaos_key, start_round,
-                                n_rounds, self.n_pad)
+        per-round dispatches all see identical masks (chaos/masks.py).
+
+        Hoisted off the per-chunk critical path: the WHOLE schedule's masks
+        are expanded in one dispatch the first time any chunk asks, and
+        every chunk takes a slice — identical tensors to a per-chunk build
+        (absolute-round keying), no per-dispatch mask generation. A request
+        past the cached horizon (bench schedules longer than
+        cfg.num_rounds) regrows the cache once."""
+        end = start_round + n_rounds
+        if self._chaos_premade is None or end > self._chaos_horizon:
+            self._chaos_horizon = max(end, self.cfg.num_rounds)
+            self._chaos_premade = make_chaos_masks(
+                self.chaos, self._chaos_key, 0, self._chaos_horizon,
+                self.n_pad)
+        return jax.tree.map(lambda t: t[start_round:end],
+                            self._chaos_premade)
 
     def run_round_fused(self, round_index: int,
                         selected: Optional[List[int]] = None,
@@ -412,17 +437,28 @@ class RoundEngine:
             jnp.asarray(round_index, jnp.int32), *extra)
         return self._fused_result(round_index, selected, out)
 
-    def run_schedule_chunk(self, start_round: int, n_rounds: int):
-        """n_rounds in ONE `lax.scan` dispatch.
+    def dispatch_schedule_chunk(self, start_round: int, n_rounds: int,
+                                agg_count=None,
+                                snapshot: bool = False) -> InFlightChunk:
+        """ENQUEUE one `lax.scan` dispatch for n_rounds and return without
+        waiting for its outputs (federation/pipeline.py).
 
-        Returns (results, schedule, keys): per-round RoundResults plus the
-        host-drawn selections and PRNG keys that produced them, so a caller
-        that must early-stop mid-chunk can restore a snapshot and replay the
-        prefix round-by-round with identical inputs. Selections and keys are
-        drawn from the same host streams, in the same order, as n_rounds
-        successive `run_round_fused` calls."""
+        Device→host copies of the output stack are started immediately
+        (host_fetch_async), so a harvest one chunk later finds the bytes
+        already host-side while the next scan computes. `agg_count`
+        overrides the host-derived quota with the PREVIOUS chunk's
+        device-resident scan output — the feed-forward that unties this
+        dispatch from the previous chunk's host bookkeeping (the device
+        value is bit-identical to the host-recomputed one: both increment
+        the elected aggregator once per aggregated round). `snapshot=True`
+        captures an on-device copy of the chunk-entry states (the scan
+        donates its input buffers) for the mid-chunk early-stop rewind.
+
+        Selections and keys are drawn from the same host streams, in the
+        same order, as n_rounds successive `run_round_fused` calls."""
         if self._fused_scan is None or self._fused_compact != self.compact:
             self._build_fused()  # rebuild when a data swap flipped compact
+        snap = (jax.tree.map(jnp.copy, self.states) if snapshot else None)
         schedule = [self.select_clients() for _ in range(n_rounds)]
         # one dispatch for all R round keys (vs R fold_in round-trips; the
         # stream is identical — see ExperimentRngs.next_jax_batch)
@@ -433,16 +469,43 @@ class RoundEngine:
         extra = ()
         if self.chaos is not None:
             extra = (self._chaos_masks(start_round, n_rounds),)
-        self.states, _, outs = self._fused_scan(
+        if agg_count is None:
+            agg_count = self._agg_count_padded()
+        t0 = time.time()
+        self.states, out_agg, outs = self._fused_scan(
             self.states, self.data, self._ver_x, self._ver_m, sel_idx, masks,
-            self._agg_count_padded(), keys,
+            agg_count, keys,
             jnp.arange(start_round, start_round + n_rounds, dtype=jnp.int32),
             *extra)
-        outs = host_fetch(outs)  # multi-process-safe (parallel/mesh.py)
-        results = [self._fused_result(start_round + r, schedule[r],
-                                      jax.tree.map(lambda t: t[r], outs))
-                   for r in range(n_rounds)]
-        return results, schedule, keys
+        return InFlightChunk(start_round=start_round, n_rounds=n_rounds,
+                             schedule=schedule, keys=keys, outs=outs,
+                             agg_count=out_agg,
+                             harvest=host_fetch_async(outs),
+                             t_dispatch=t0, snap_states=snap)
+
+    def harvest_schedule_chunk(self, chunk: InFlightChunk):
+        """Block on a dispatched chunk's device→host copies and absorb the
+        host bookkeeping (quota/vote counters, verification rows) — the
+        host half of run_schedule_chunk. Returns (results, schedule,
+        keys)."""
+        outs = chunk.harvest()  # multi-process-safe (parallel/mesh.py)
+        results = [self._fused_result(chunk.start_round + r,
+                                      chunk.schedule[r],
+                                      jax.tree.map(lambda t, r=r: t[r], outs))
+                   for r in range(chunk.n_rounds)]
+        return results, chunk.schedule, chunk.keys
+
+    def run_schedule_chunk(self, start_round: int, n_rounds: int):
+        """n_rounds in ONE `lax.scan` dispatch (dispatch + immediate
+        harvest; the pipelined executor splits the two so bookkeeping
+        overlaps the next chunk's scan — federation/pipeline.py).
+
+        Returns (results, schedule, keys): per-round RoundResults plus the
+        host-drawn selections and PRNG keys that produced them, so a caller
+        that must early-stop mid-chunk can restore a snapshot and replay the
+        prefix round-by-round with identical inputs."""
+        return self.harvest_schedule_chunk(
+            self.dispatch_schedule_chunk(start_round, n_rounds))
 
     def run_rounds(self, start_round: int, n_rounds: int) -> List[RoundResult]:
         """n_rounds in ONE dispatch (lax.scan schedule; no early stopping)."""

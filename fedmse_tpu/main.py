@@ -188,8 +188,26 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     use_schedule = (cfg.fused_schedule and cfg.fused_rounds
                     and engine.fused and not engine.timer.enabled)
     can_rewind = early_stop is not None
-    if use_schedule:
-        # whole-schedule scan in chunks: K rounds per XLA dispatch. Early
+    # pipelined chunk execution (federation/pipeline.py): chunk k+1's scan
+    # is enqueued before chunk k's outputs are consumed, so bookkeeping/IO
+    # overlap the in-flight dispatch. Resume forces the serial loop — its
+    # per-chunk checkpoint must snapshot a consistent (non-speculative)
+    # state at every chunk boundary.
+    if use_schedule and cfg.fused_pipeline and resume is None:
+        from fedmse_tpu.federation.pipeline import run_pipelined_schedule
+
+        def consume(results, sec):
+            for j, result in enumerate(results):
+                if bookkeep(result, sec):
+                    return j
+            return None
+
+        run_pipelined_schedule(engine, start_round, cfg.num_rounds,
+                               cfg.fused_schedule_chunk, consume,
+                               can_rewind=can_rewind)
+    elif use_schedule:
+        # serial chunk loop (--no-pipeline / --resume-dir): K rounds per
+        # XLA dispatch, host bookkeeping between dispatches. Early
         # stopping is evaluated per round from the stacked outputs; a stop
         # at a non-final round of a chunk restores the chunk-entry snapshot
         # and replays the prefix with the SAME selections/keys, so the final
@@ -321,24 +339,18 @@ def run_batched_combination(cfg: ExperimentConfig, data, n_real: int,
     all_tracking: List[List[np.ndarray]] = [[] for _ in range(runs)]
     stopped = [False] * runs
 
-    round_index = 0
-    while round_index < cfg.num_rounds and not all(stopped):
-        k = min(cfg.fused_schedule_chunk, cfg.num_rounds - round_index)
-        active = np.asarray([not s for s in stopped])
-        # scan donates states; snapshot (on-device copy) + chunk-entry quota
-        # so a mid-chunk stop can rewind and replay with freeze masks
-        snap_states = jax.tree.map(jnp.copy, engine.states)
-        entry_agg = engine._agg_count()
-        t0 = time.time()
-        outs, schedule, keys = engine.run_schedule_chunk(round_index, k,
-                                                         active)
-        sec = (time.time() - t0) / k
+    def consume_chunk(outs, schedule, keys, start_round, k, sec, active):
+        """Absorb one harvested chunk's valid (round, run) entries into the
+        host books; returns each run's newly-fired stop position (None =
+        no stop in this chunk). Shared verbatim by the pipelined and the
+        serial chunk loop — identical absorption order, so artifacts stay
+        byte-compatible between the two."""
         stop_pos: List[Optional[int]] = [None] * runs
         for i in range(k):
             for r in range(runs):
-                if stopped[r] or stop_pos[r] is not None:
+                if not active[r] or stop_pos[r] is not None:
                     continue  # post-stop lanes never reach the host books
-                result = engine.process_round(r, round_index + i,
+                result = engine.process_round(r, start_round + i,
                                               schedule[i][r], outs, i)
                 round_times[r].append(sec)
                 all_tracking[r].append(result.tracking)
@@ -357,24 +369,51 @@ def run_batched_combination(cfg: ExperimentConfig, data, n_real: int,
                         early[r].should_stop(result.client_metrics)):
                     logger.info("Early stopping in global round!")
                     stop_pos[r] = i
-        if any(p is not None and p < k - 1 for p in stop_pos):
-            # mid-chunk stop: rewind device states and replay the chunk with
-            # the per-round freeze matrix so stopped runs end at their stop
-            # round; live lanes recompute identical results (discarded)
-            engine.states = snap_states
-            act2 = np.zeros((k, runs), dtype=bool)
-            for i in range(k):
-                for r in range(runs):
-                    act2[i, r] = active[r] and (stop_pos[r] is None
-                                                or i <= stop_pos[r])
-            engine.run_schedule_chunk(round_index, k, active,
-                                      schedule=schedule, keys=keys,
-                                      active_rounds=act2,
-                                      agg_count=entry_agg)
-        for r in range(runs):
-            if stop_pos[r] is not None:
-                stopped[r] = True
-        round_index += k
+        return stop_pos
+
+    if cfg.fused_pipeline:
+        # pipelined chunk execution (federation/pipeline.py): the next
+        # chunk's dispatch is enqueued before this chunk's outputs are
+        # consumed; a stop discards (and, if runs remain, re-dispatches)
+        # the speculative chunk from the serial-equivalent state
+        from fedmse_tpu.federation.pipeline import run_pipelined_batched
+        run_pipelined_batched(engine, cfg.num_rounds,
+                              cfg.fused_schedule_chunk, consume_chunk)
+    else:
+        round_index = 0
+        while round_index < cfg.num_rounds and not all(stopped):
+            k = min(cfg.fused_schedule_chunk, cfg.num_rounds - round_index)
+            active = np.asarray([not s for s in stopped])
+            # scan donates states; snapshot (on-device copy) + chunk-entry
+            # quota so a mid-chunk stop can rewind and replay with freeze
+            # masks
+            snap_states = jax.tree.map(jnp.copy, engine.states)
+            entry_agg = engine._agg_count()
+            t0 = time.time()
+            outs, schedule, keys = engine.run_schedule_chunk(round_index, k,
+                                                             active)
+            sec = (time.time() - t0) / k
+            stop_pos = consume_chunk(outs, schedule, keys, round_index, k,
+                                     sec, active)
+            if any(p is not None and p < k - 1 for p in stop_pos):
+                # mid-chunk stop: rewind device states and replay the chunk
+                # with the per-round freeze matrix so stopped runs end at
+                # their stop round; live lanes recompute identical results
+                # (discarded)
+                engine.states = snap_states
+                act2 = np.zeros((k, runs), dtype=bool)
+                for i in range(k):
+                    for r in range(runs):
+                        act2[i, r] = active[r] and (stop_pos[r] is None
+                                                    or i <= stop_pos[r])
+                engine.run_schedule_chunk(round_index, k, active,
+                                          schedule=schedule, keys=keys,
+                                          active_rounds=act2,
+                                          agg_count=entry_agg)
+            for r in range(runs):
+                if stop_pos[r] is not None:
+                    stopped[r] = True
+            round_index += k
 
     # final evaluation: all runs in one dispatch on their frozen states
     finals = engine.evaluate_final()
@@ -411,7 +450,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                    save_checkpoints: bool = True,
                    resume_dir: Optional[str] = None,
                    attack=None, chaos=None, batch_runs: bool = False,
-                   serve: bool = False, serve_rows: int = 2048) -> Dict:
+                   serve: bool = False, serve_rows: int = 2048,
+                   serve_warmup: bool = False) -> Dict:
     """The full sweep (src/main.py:108-399) -> training summary dict.
 
     `serve=True` appends a serving smoke pass (fedmse_tpu/serving/): the
@@ -515,7 +555,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                 cfg, data, n_real, writer, device_names,
                 model_type=cfg.model_types[0],
                 update_type=cfg.update_types[0], run=0,
-                max_rows=serve_rows)
+                max_rows=serve_rows, warmup=serve_warmup)
     return out
 
 
@@ -542,6 +582,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(fedmse_tpu/serving/)")
     p.add_argument("--serve-rows", type=int, default=2048,
                    help="max test rows streamed by the --serve smoke pass")
+    p.add_argument("--serve-warmup", action="store_true",
+                   help="precompile every power-of-two serving bucket at "
+                        "startup (serving/engine.py warmup) so a first-hit "
+                        "bucket no longer spikes tail latency inside the "
+                        "served stream; compile times land in the report")
+    p.add_argument("--no-pipeline", action="store_true",
+                   help="disable pipelined chunk execution (federation/"
+                        "pipeline.py) and run the serial chunk loop: "
+                        "dispatch, harvest, bookkeep, then the next "
+                        "dispatch (the pre-pipeline oracle; also what "
+                        "--resume-dir falls back to automatically)")
     p.add_argument("--no-save", action="store_true",
                    help="skip per-client model/tracking artifacts")
     p.add_argument("--paper-scale", action="store_true",
@@ -593,6 +644,8 @@ def main(argv: Optional[List[str]] = None) -> Dict:
     enable_compilation_cache()  # persistent XLA cache across driver runs
     args = build_parser().parse_args(argv)
     cfg = apply_cli_overrides(ExperimentConfig(), args)
+    if args.no_pipeline:
+        cfg = cfg.replace(fused_pipeline=False)
     if args.paper_scale:
         from fedmse_tpu.config import paper_scale
         cfg = paper_scale(cfg)
@@ -640,7 +693,8 @@ def main(argv: Optional[List[str]] = None) -> Dict:
                           save_checkpoints=not args.no_save,
                           resume_dir=args.resume_dir, attack=attack,
                           chaos=chaos, batch_runs=args.batch_runs,
-                          serve=args.serve, serve_rows=args.serve_rows)
+                          serve=args.serve, serve_rows=args.serve_rows,
+                          serve_warmup=args.serve_warmup)
 
 
 def cli() -> int:

@@ -1,6 +1,7 @@
 from fedmse_tpu.parallel.mesh import (
     client_mesh,
     host_fetch,
+    host_fetch_async,
     pad_to_multiple,
     replicate,
     shard_clients,
@@ -13,6 +14,7 @@ from fedmse_tpu.parallel.multihost import uniform_decision
 __all__ = [
     "client_mesh",
     "host_fetch",
+    "host_fetch_async",
     "initialize_multihost",
     "uniform_decision",
     "make_shardmap_aggregate",

@@ -103,6 +103,32 @@ def host_fetch(tree: Any) -> Any:
     return jax.tree.map(fetch, tree)
 
 
+def host_fetch_async(tree: Any):
+    """Start device→host copies for `tree` NOW; return a zero-arg harvest
+    callable that blocks and produces exactly what `host_fetch(tree)` would.
+
+    The pipelined chunk executor (federation/pipeline.py) calls this right
+    after enqueueing a scan dispatch: `copy_to_host_async` schedules the
+    transfer of each output buffer as soon as the device produces it, so by
+    the time the harvest callable runs — one chunk later, with the next
+    scan already in flight — the bytes are (mostly) host-resident and
+    `device_get` degenerates to a wait-free copy-out instead of a
+    device-blocking round-trip.
+
+    Multi-controller runs keep the synchronous seam: `process_allgather`
+    is a collective that every process must enter together, so it cannot be
+    started early from one side — the returned callable just defers to
+    `host_fetch`. Overlap is a single-process optimization; correctness is
+    identical either way."""
+    if jax.process_count() == 1:
+        for leaf in jax.tree.leaves(tree):
+            copy_async = getattr(leaf, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        return lambda: jax.device_get(tree)
+    return lambda: host_fetch(tree)
+
+
 def shard_federation(data, states, mesh: Mesh, axis_name: str = "clients"):
     """Shard a FederatedData + ClientStates pair onto the mesh.
 

@@ -30,7 +30,8 @@ shape, requests padded up to the nearest bucket.
 from __future__ import annotations
 
 import collections
-from typing import Any, Optional
+import time
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -173,13 +174,23 @@ class ServingEngine:
             self._score_fn = self._build_scorer()
         return self._score_fn
 
-    def warmup(self) -> None:
+    def warmup(self) -> Dict[int, float]:
         """Compile every bucket program ahead of traffic (the first real
-        request must not pay tens of seconds of XLA compile)."""
+        request must not pay tens of seconds of XLA compile — a first-HIT
+        bucket otherwise spikes tail latency mid-stream; `--serve-warmup`
+        in the driver, cold-vs-warm columns in bench_serve.py).
+
+        Returns per-bucket wall seconds (trace + compile + one dispatch)
+        for observability; a warm bucket's entry is its bare dispatch
+        cost."""
         fn = self._scorer()
+        out: Dict[int, float] = {}
         for b in self.buckets:
+            t0 = time.perf_counter()
             jax.block_until_ready(fn(jnp.zeros((b, self.dim), jnp.float32),
                                      jnp.zeros((b,), jnp.int32)))
+            out[b] = time.perf_counter() - t0
+        return out
 
     # ----------------------------- scoring ------------------------------ #
 

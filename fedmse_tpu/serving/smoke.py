@@ -51,8 +51,16 @@ def run_serve_smoke(cfg, data, n_real: int, writer, device_names: Sequence[str],
                     model_type: str, update_type: str, run: int = 0,
                     max_rows: int = 2048, max_batch: int = 256,
                     max_wait_ms: float = 2.0,
-                    percentile: float = 95.0) -> Dict:
-    """One serving smoke pass over a just-checkpointed combination."""
+                    percentile: float = 95.0, warmup: bool = False) -> Dict:
+    """One serving smoke pass over a just-checkpointed combination.
+
+    `warmup=True` (`--serve-warmup`) precompiles every power-of-two bucket
+    before the stream starts, so a first-HIT bucket cannot spike tail
+    latency mid-stream; the per-bucket compile seconds land in the report.
+    Default False: the stream is served cold — the realistic first-boot
+    deployment — and any compile spikes show up honestly in the latency
+    percentiles (calibration already compiles the buckets it happens to
+    touch either way)."""
     from fedmse_tpu.models import make_model
 
     model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
@@ -73,7 +81,8 @@ def run_serve_smoke(cfg, data, n_real: int, writer, device_names: Sequence[str],
 
     batcher = MicroBatcher(engine, max_batch=max_batch,
                            max_wait_ms=max_wait_ms, calibration=calib)
-    engine.warmup()  # compiles land before the timed stream
+    # --serve-warmup: every bucket compiles before the timed stream
+    warmup_sec = engine.warmup() if warmup else None
     # the report's bucket_dispatches must describe the served test stream,
     # not the calibration/warmup scoring that already went through score()
     engine.dispatches.clear()
@@ -114,6 +123,10 @@ def run_serve_smoke(cfg, data, n_real: int, writer, device_names: Sequence[str],
         "bucket_dispatches": {str(k): int(v)
                               for k, v in sorted(engine.dispatches.items())},
         "drift": drift.report(),
+        "warmup": warmup,
+        "warmup_sec_per_bucket": (
+            None if warmup_sec is None
+            else {str(k): round(v, 4) for k, v in warmup_sec.items()}),
     }
     logger.info(
         "serve smoke [%s/%s]: %d rows, %.0f rows/s (service), p95 %.2f ms, "

@@ -23,6 +23,13 @@ Answers, with measurements rather than wall-clock assertions:
      fused scan is rebuilt with each phase (train / vote scoring / verify /
      eval) replaced by a shape-matched stub; the drop in the fitted
      marginal b attributes that phase's compute. See _phase_ablation.
+  5. How long does the device queue sit EMPTY between chunks (ISSUE 4)?
+     The host gap — wall time from a chunk's harvest completion (the
+     measurable proxy for device completion) to the next chunk's dispatch
+     enqueue. The serial loop leaves the whole host phase in that gap; the
+     pipelined executor (federation/pipeline.py) enqueues chunk k+1 BEFORE
+     chunk k's harvest, driving the gap negative. See _host_gap; persisted
+     so future PROFILE captures track dispatch-overlap regressions.
 
 Usage:
   python profile_fused.py [--out PROFILE.json] [--chunks 1,8,32,128]
@@ -278,6 +285,51 @@ def _phase_ablation(engine, chunks=(8, 32)):
     return out
 
 
+def _host_gap(engine, chunk: int = 8, n_chunks: int = 4):
+    """The quantity the dispatch pipeline drives toward (and past) zero:
+    wall seconds between a chunk's harvest completion and the next chunk's
+    dispatch enqueue, measured for the serial loop (dispatch → harvest →
+    next dispatch; the gap IS the host phase the device idles through) and
+    the pipelined executor (negative gap = dispatch k+1 was enqueued
+    before chunk k's harvest completed). Uses the same dispatch/harvest
+    seam the drivers use (rounds.py dispatch_schedule_chunk)."""
+    import numpy as np
+
+    from fedmse_tpu.federation.pipeline import run_pipelined_schedule
+
+    engine.reset_federation()
+    engine.run_rounds(0, chunk)  # compile + warm
+    engine.reset_federation()
+    serial_gaps, prev_done = [], None
+    for c in range(n_chunks):
+        inflight = engine.dispatch_schedule_chunk(c * chunk, chunk)
+        if prev_done is not None:
+            serial_gaps.append(inflight.t_dispatch - prev_done)
+        engine.harvest_schedule_chunk(inflight)
+        prev_done = time.time()
+    engine.reset_federation()
+    stats = run_pipelined_schedule(engine, 0, n_chunks * chunk, chunk,
+                                   lambda results, sec: None,
+                                   can_rewind=False)
+    return {
+        "chunk": chunk,
+        "n_chunks": n_chunks,
+        "serial_gap_s": [round(g, 5) for g in serial_gaps],
+        "serial_gap_mean_s": round(float(np.mean(serial_gaps)), 5),
+        "pipelined": stats.summary(),
+        "method": "gap = t_dispatch(k+1) - t_harvest_done(k); harvest "
+                  "completion is the measurable proxy for device "
+                  "completion. pipelined.overlapped=true means every next "
+                  "dispatch was ENQUEUED before the previous harvest "
+                  "completed (the ISSUE 4 acceptance signal). This is a "
+                  "host-order guard: it catches the chunk loop "
+                  "re-serializing, not a backend gone synchronous under "
+                  "the same loop order — cross-check the serial-vs-"
+                  "pipelined sec/round in BENCH_PIPELINE captures for "
+                  "end-to-end overlap",
+    }
+
+
 def main():
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -340,6 +392,12 @@ def main():
     except Exception as e:
         ablation = {"error": repr(e)}
 
+    # ---- 5. host gap: serial vs pipelined chunk loop (ISSUE 4) ----
+    try:
+        host_gap = _host_gap(engine)
+    except Exception as e:
+        host_gap = {"error": repr(e)}
+
     device = jax.devices()[0]
     out = {
         "workload": "quick-run fused-scan chunk (10-client N-BaIoT, hybrid "
@@ -358,6 +416,7 @@ def main():
         "mfu": (achieved / peak) if achieved else None,
         "trace": trace_info if trace_info else {"unavailable": trace_err},
         "phase_ablation": ablation,
+        "host_gap": host_gap,
     }
     reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
     if reason and reason != "1":
@@ -369,7 +428,12 @@ def main():
                       "dispatch_overhead_s": out["fit"]["dispatch_overhead_s"],
                       "marginal_sec_per_round":
                           out["fit"]["marginal_sec_per_round"],
-                      "mfu": out["mfu"]}))
+                      "mfu": out["mfu"],
+                      "host_gap_serial_mean_s":
+                          host_gap.get("serial_gap_mean_s"),
+                      "host_gap_pipelined_mean_s":
+                          host_gap.get("pipelined", {}).get(
+                              "host_gap_mean_s")}))
 
 
 if __name__ == "__main__":

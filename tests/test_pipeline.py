@@ -1,0 +1,234 @@
+"""Pipelined chunk execution (federation/pipeline.py): the double-buffered
+executor — chunk k+1's scan enqueued before chunk k's outputs are consumed,
+device quota fed forward, harvest one chunk late — must be BIT-IDENTICAL on
+CPU to the serial chunk loop it overlaps: states, metrics, host counters and
+ResultsWriter artifacts, across mid-chunk early stop (rewind + replay with
+the speculative chunk discarded), final-round-of-chunk stop (the in-flight
+successor's entry snapshot is the correct final state), chaos masks, attack
+bursts, and batched runs. The serial loop is the oracle (ISSUE 4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fedmse_tpu.checkpointing import ResultsWriter
+from fedmse_tpu.config import CompatConfig, ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+from fedmse_tpu.federation import BatchedRunEngine, RoundEngine
+from fedmse_tpu.main import (GlobalEarlyStop, run_batched_combination,
+                             run_combination)
+from fedmse_tpu.models import make_model
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+pytestmark = pytest.mark.pipeline
+
+DIM = 12
+N = 4
+RUNS = 3
+
+
+def build_cfg(**kw):
+    kw.setdefault("num_rounds", 6)
+    kw.setdefault("fused_schedule_chunk", 4)
+    return ExperimentConfig(
+        dim_features=DIM, network_size=N, epochs=2, batch_size=8,
+        num_runs=RUNS, compat=CompatConfig(vote_tie_break=False), **kw)
+
+
+def build_data(cfg):
+    clients = synthetic_clients(n_clients=N, dim=DIM, n_normal=120,
+                                n_abnormal=60)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(run=0).data_rng)
+    return stack_clients(clients, dev_x, cfg.batch_size)
+
+
+def _walk_files(root):
+    out = {}
+    for d, _, files in os.walk(root):
+        for name in files:
+            p = os.path.join(d, name)
+            out[os.path.relpath(p, root)] = p
+    return out
+
+
+def _assert_artifact_trees_equal(root_a, root_b):
+    files_a, files_b = _walk_files(root_a), _walk_files(root_b)
+    assert set(files_a) == set(files_b)
+    for rel in files_a:
+        if rel.endswith(".json"):
+            with open(files_a[rel], "rb") as a, open(files_b[rel],
+                                                     "rb") as b:
+                assert a.read() == b.read(), f"{rel} not byte-compatible"
+        elif rel.endswith("model.npz"):
+            a, b = np.load(files_a[rel]), np.load(files_b[rel])
+            assert set(a.files) == set(b.files)
+            for k in a.files:
+                np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_dispatch_harvest_split_matches_run_schedule_chunk():
+    """The dispatch/harvest split + device quota feed-forward reproduces
+    run_schedule_chunk exactly: same per-round bundles, same host counters
+    — including chunk 2 dispatched from chunk 1's DEVICE agg_count before
+    any host bookkeeping absorbed chunk 1."""
+    cfg = build_cfg()
+    data = build_data(cfg)
+    model = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+
+    ref = RoundEngine(model, cfg, data, n_real=N,
+                      rngs=ExperimentRngs(run=0), model_type="hybrid",
+                      update_type="mse_avg", fused=True)
+    ref_results = []
+    for start in (0, 3):
+        ref_results.extend(ref.run_schedule_chunk(start, 3)[0])
+
+    eng = RoundEngine(model, cfg, data, n_real=N,
+                      rngs=ExperimentRngs(run=0), model_type="hybrid",
+                      update_type="mse_avg", fused=True)
+    c1 = eng.dispatch_schedule_chunk(0, 3, snapshot=True)
+    # pipelined order: chunk 2 in flight on the device-resident quota
+    # BEFORE chunk 1's host bookkeeping runs
+    c2 = eng.dispatch_schedule_chunk(3, 3, agg_count=c1.agg_count)
+    results = eng.harvest_schedule_chunk(c1)[0]
+    results.extend(eng.harvest_schedule_chunk(c2)[0])
+
+    for got, want in zip(results, ref_results):
+        assert got.selected == want.selected
+        assert got.aggregator == want.aggregator
+        np.testing.assert_array_equal(got.client_metrics,
+                                      want.client_metrics)
+        np.testing.assert_array_equal(got.min_valid, want.min_valid)
+    assert eng.host.aggregation_count.tolist() == \
+        ref.host.aggregation_count.tolist()
+    assert eng.host.votes_received.tolist() == \
+        ref.host.votes_received.tolist()
+
+
+@pytest.mark.parametrize("chunk", [3, 4])
+def test_pipelined_driver_matches_serial_artifacts(tmp_path, chunk):
+    """run_combination pipelined (default) vs --no-pipeline serial loop:
+    identical stop rounds, counters, final metrics, byte-identical artifact
+    trees. chunk=4 stops mid-chunk (rewind + replay, speculative successor
+    discarded); chunk=3 stops at a chunk's FINAL round while the successor
+    is in flight (the successor's entry snapshot is the final state) —
+    both late-stop paths of federation/pipeline.py."""
+    cfg = build_cfg(fused_schedule_chunk=chunk)
+    data = build_data(cfg)
+    outs, roots = {}, {}
+    for name, c in (("pipe", cfg),
+                    ("serial", cfg.replace(fused_pipeline=False))):
+        roots[name] = str(tmp_path / name)
+        writer = ResultsWriter(roots[name], c.network_size,
+                               c.experiment_name, c.scen_name, c.metric,
+                               c.num_participants)
+        early = GlobalEarlyStop(inverted=c.compat.inverted_global_early_stop,
+                                patience=c.global_patience)
+        outs[name] = run_combination(
+            c, data, N, "hybrid", "mse_avg", 0, writer=writer,
+            early_stop=early, device_names=[f"dev-{i}" for i in range(N)],
+            save_checkpoints=True)
+    a, b = outs["pipe"], outs["serial"]
+    assert a["rounds_run"] == b["rounds_run"]
+    assert a["rounds_run"] < cfg.num_rounds  # the stop actually fired
+    assert a["aggregation_count"] == b["aggregation_count"]
+    assert a["votes_received"] == b["votes_received"]
+    np.testing.assert_array_equal(a["final_metrics"], b["final_metrics"])
+    _assert_artifact_trees_equal(roots["pipe"], roots["serial"])
+
+
+@pytest.mark.chaos
+def test_pipelined_chaos_attack_burst_matches_serial():
+    """Chaos masks + a transient attack burst ride the pipelined schedule
+    bit-identically: the hoisted whole-schedule mask expansion slices per
+    chunk (absolute-round keying), the poison_fn's lax.cond schedule fires
+    in the speculative dispatches, and the mid-chunk rewind replays both
+    faithfully."""
+    from fedmse_tpu.chaos import ChaosSpec
+    from fedmse_tpu.federation.attack import AttackSpec
+
+    cfg = build_cfg()
+    data = build_data(cfg)
+    chaos = ChaosSpec(dropout_p=0.3, crash_p=0.2, broadcast_loss_p=0.2)
+    attack = AttackSpec(kind="scale", strength=50.0, start_round=1,
+                        stop_round=3)
+    outs = {}
+    for name, c in (("pipe", cfg),
+                    ("serial", cfg.replace(fused_pipeline=False))):
+        early = GlobalEarlyStop(inverted=c.compat.inverted_global_early_stop,
+                                patience=c.global_patience)
+        outs[name] = run_combination(c, data, N, "hybrid", "mse_avg", 0,
+                                     early_stop=early, attack=attack,
+                                     chaos=chaos)
+    a, b = outs["pipe"], outs["serial"]
+    assert a["rounds_run"] == b["rounds_run"]
+    assert a["aggregation_count"] == b["aggregation_count"]
+    np.testing.assert_array_equal(a["final_metrics"], b["final_metrics"])
+
+
+def test_pipelined_batched_matches_serial_artifacts(tmp_path):
+    """run_batched_combination pipelined vs serial: per-run stop rounds,
+    counters, finals and artifact trees identical. num_rounds=6 over
+    chunk=4 makes runs stop mid-chunk, exercising the batched stop
+    protocol — rewind + freeze-matrix replay of the stopping chunk AND
+    discard + re-dispatch of the speculative successor with the corrected
+    lane mask."""
+    cfg = build_cfg()
+    data = build_data(cfg)
+    device_names = [f"dev-{i}" for i in range(N)]
+    outs, roots = {}, {}
+    for name, c in (("pipe", cfg),
+                    ("serial", cfg.replace(fused_pipeline=False))):
+        roots[name] = str(tmp_path / name)
+        writer = ResultsWriter(roots[name], c.network_size,
+                               c.experiment_name, c.scen_name, c.metric,
+                               c.num_participants)
+        outs[name] = run_batched_combination(
+            c, data, N, "hybrid", "mse_avg", writer=writer,
+            device_names=device_names, save_checkpoints=True)
+    for r in range(RUNS):
+        a, b = outs["pipe"][r], outs["serial"][r]
+        assert a["rounds_run"] == b["rounds_run"]
+        assert a["aggregation_count"] == b["aggregation_count"]
+        np.testing.assert_array_equal(a["final_metrics"],
+                                      b["final_metrics"])
+    assert any(outs["pipe"][r]["rounds_run"] < cfg.num_rounds
+               for r in range(RUNS))  # stops actually fired
+    _assert_artifact_trees_equal(roots["pipe"], roots["serial"])
+
+
+def test_pipeline_overlap_telemetry():
+    """PipelineStats records the host gap — t_dispatch(k+1) minus
+    t_harvest_done(k) — and in pipelined order it is non-positive BY
+    CONSTRUCTION (the next dispatch is enqueued before the previous
+    harvest completes): the acceptance signal profile_fused.py persists."""
+    from fedmse_tpu.federation.pipeline import run_pipelined_schedule
+
+    cfg = build_cfg(num_rounds=9, fused_schedule_chunk=3)
+    data = build_data(cfg)
+    model = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+    eng = RoundEngine(model, cfg, data, n_real=N,
+                      rngs=ExperimentRngs(run=0), model_type="hybrid",
+                      update_type="mse_avg", fused=True)
+    seen = []
+    stats = run_pipelined_schedule(
+        eng, 0, cfg.num_rounds, cfg.fused_schedule_chunk,
+        lambda results, sec: seen.extend(results) or None,
+        can_rewind=False)
+    assert len(seen) == cfg.num_rounds
+    assert stats.chunks == 3
+    assert len(stats.host_gaps) == 2  # one per chunk boundary
+    assert all(g <= 0 for g in stats.host_gaps)
+    assert stats.summary()["overlapped"] is True
+
+
+def test_pipeline_default_on_and_cli_escape_hatch():
+    """Pipelined mode is the fused schedule's default; --no-pipeline is the
+    documented escape hatch on the driver CLI."""
+    from fedmse_tpu.main import build_parser
+
+    assert ExperimentConfig().fused_pipeline is True
+    opts = {s for a in build_parser()._actions for s in a.option_strings}
+    assert "--no-pipeline" in opts
+    assert "--serve-warmup" in opts  # bucket precompile rides the same PR

@@ -121,6 +121,20 @@ def test_checkpoint_roundtrip_is_exact():
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_warmup_precompiles_every_bucket_and_reports_seconds():
+    """warmup() touches every power-of-two bucket exactly once and returns
+    per-bucket wall seconds (--serve-warmup's report; the cold-vs-warm
+    column in bench_serve.py). A warmed engine's first real request at any
+    bucket is a bare dispatch — no compile spike in the served stream."""
+    _, _, _, eng = _engine("autoencoder", max_bucket=8)
+    secs = eng.warmup()
+    assert sorted(secs) == eng.buckets == [1, 2, 4, 8]
+    assert all(v > 0 for v in secs.values())
+    # warmup drives the jitted fn directly: the request-path bucket
+    # accounting (engine.dispatches) must not count synthetic traffic
+    assert sum(eng.dispatches.values()) == 0
+
+
 def test_engine_rejects_bad_gateway_and_missing_centroids():
     model, params, data, eng = _engine("autoencoder")
     with pytest.raises(ValueError, match="gateway ids"):
