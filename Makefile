@@ -8,7 +8,7 @@ CXXFLAGS ?= -O3 -fPIC -Wall -Wextra
 LIB := fedmse_tpu/native/libfedmse_io.so
 
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
-        serve-bench chaos-sweep pipeline-bench tpu-check
+        serve-bench chaos-sweep pipeline-bench precision-bench tpu-check
 
 native: $(LIB)
 
@@ -51,6 +51,14 @@ chaos-sweep:
 pipeline-bench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		python bench.py --pipeline-bench --out BENCH_PIPELINE_r06_cpu.json
+
+# mixed-precision sweep (ops/precision.py): f32 vs bf16 sec/round, AUC
+# deltas and program operand bytes on the fused round body + serving score
+# path (writes BENCH_PRECISION_r07_cpu.json; hermetic CPU — bytes ratios
+# are dtype-true there, the wall-clock win targets the memory-bound TPU)
+precision-bench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python bench.py --precision-bench --out BENCH_PRECISION_r07_cpu.json
 
 tpu-check:
 	python tpu_check.py

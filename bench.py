@@ -320,6 +320,128 @@ def measure_pipeline(cfg, data, n_real: int, timed_rounds: int):
     }
 
 
+def measure_precision(cfg, timed_rounds: int = 3, serve_bucket: int = 1024,
+                      n_clients: int = 10, dataset=None):
+    """f32-vs-bf16 sweep (ISSUE 5 tentpole metric): sec/round, AUC and
+    program bytes for BOTH model types under each precision policy
+    (ops/precision.py), plus the serving score path at `serve_bucket` rows.
+
+    Bytes are reported three ways, because the backends disagree on what
+    "accessed" means:
+      * `argument_bytes` — XLA memory analysis of the compiled program's
+        operand buffers (the device-resident / H2D quantity; dtype-true on
+        every backend). THIS is the headline ratio: the [N, rows, 115]
+        data tensors and the weight gathers halve under bf16.
+      * `data_bytes` — raw nbytes of the stacked federation pytree
+        (backend-independent sanity check of the same claim).
+      * `xla_cost_bytes_accessed` — XLA HLO cost analysis. On CPU this
+        OVERSTATES bf16 traffic: the CPU lowering emulates bf16 matmuls by
+        inserting f32 converts and the cost model counts their
+        materialization, so the CPU number moves the WRONG way; the TPU
+        lowering computes natively in bf16 (capture the TPU row when the
+        tunnel allows — the committed artifact is BENCH_PRECISION_r07_cpu
+        until then).
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.models import init_stacked_params, make_model
+    from fedmse_tpu.serving.engine import ServingEngine, fit_gateway_centroids
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    def analyses(jfn, *args):
+        compiled = jfn.lower(*args).compile()
+        mem = compiled.memory_analysis()
+        cost = compiled.cost_analysis()
+        cost = cost[0] if isinstance(cost, list) else cost
+        return {
+            "argument_bytes": int(mem.argument_size_in_bytes),
+            "temp_bytes": int(mem.temp_size_in_bytes),
+            "output_bytes": int(mem.output_size_in_bytes),
+            "xla_cost_bytes_accessed": int(cost.get("bytes accessed", 0)),
+            "flops": int(cost.get("flops", 0)),
+        }
+
+    rows = {}
+    for precision in ("f32", "bf16"):
+        pcfg = cfg.replace(precision=precision)
+        data, n_real, _ = build_data(pcfg, n_clients, dataset)
+        prow = {"data_bytes": int(sum(
+            l.nbytes for l in jax.tree.leaves(data)))}
+        for model_type in ("hybrid", "autoencoder"):
+            model = make_model(model_type, pcfg.dim_features,
+                               shrink_lambda=pcfg.shrink_lambda,
+                               precision=precision)
+            engine = RoundEngine(
+                model, pcfg, data, n_real=n_real,
+                rngs=ExperimentRngs(run=0, data_seed=pcfg.data_seed),
+                model_type=model_type, update_type="mse_avg", fused=True)
+            _timed_pass(engine, True, timed_rounds)  # compile + warm
+            sec, results = _min_over_reps(
+                lambda: _timed_pass(engine, True, timed_rounds))
+            # program analyses of the single-round fused body (the scan
+            # body XLA repeats; one round keeps the numbers comparable
+            # across chunk settings)
+            engine._build_fused()
+            sel_idx, sel_mask = engine._selection_arrays(
+                engine.select_clients())
+            body = analyses(
+                engine._fused_round, engine.states, data, engine._ver_x,
+                engine._ver_m, jnp.asarray(sel_idx), jnp.asarray(sel_mask),
+                engine._agg_count_padded(), jax.random.key(0), jnp.int32(0))
+            prow[model_type] = {
+                "sec_per_round": round(sec / timed_rounds, 5),
+                "final_auc": round(float(np.nanmean(
+                    results[-1].client_metrics)), 5),
+                "round_body": body,
+            }
+            # serving score path at the largest bucket
+            params = init_stacked_params(model, jax.random.key(2), n_real)
+            cen = None
+            if model_type == "hybrid":
+                cen = fit_gateway_centroids(model, params, data.train_xb,
+                                            data.train_mb)
+            srv = ServingEngine(model, model_type, params, cen,
+                                max_bucket=serve_bucket, precision=precision)
+            cdt = srv.policy.compute_dtype
+            prow[model_type]["serve_score_path"] = analyses(
+                srv._scorer(), jnp.zeros((serve_bucket, srv.dim), cdt),
+                jnp.zeros((serve_bucket,), jnp.int32))
+        rows[precision] = prow
+
+    out = {"rounds": timed_rounds, "serve_bucket": serve_bucket,
+           "policies": rows}
+    for model_type in ("hybrid", "autoencoder"):
+        f32 = rows["f32"][model_type]
+        bf16 = rows["bf16"][model_type]
+        rb = f32["round_body"]["argument_bytes"] / max(
+            bf16["round_body"]["argument_bytes"], 1)
+        sb = f32["serve_score_path"]["argument_bytes"] / max(
+            bf16["serve_score_path"]["argument_bytes"], 1)
+        out[f"{model_type}_auc_delta"] = round(
+            abs(f32["final_auc"] - bf16["final_auc"]), 5)
+        out[f"{model_type}_round_body_bytes_ratio_f32_over_bf16"] = \
+            round(rb, 2)
+        out[f"{model_type}_serve_bytes_ratio_f32_over_bf16"] = round(sb, 2)
+        out[f"{model_type}_speedup_bf16_vs_f32"] = round(
+            f32["sec_per_round"] / max(bf16["sec_per_round"], 1e-9), 2)
+    out["data_bytes_ratio_f32_over_bf16"] = round(
+        rows["f32"]["data_bytes"] / max(rows["bf16"]["data_bytes"], 1), 2)
+    out["bytes_note"] = (
+        "argument_bytes (XLA memory analysis of program operands) is the "
+        "headline ratio - dtype-true on every backend; "
+        "xla_cost_bytes_accessed on CPU overstates bf16 traffic because "
+        "the CPU lowering emulates bf16 via f32 converts (TPU computes "
+        "natively in bf16; capture the TPU row when the tunnel allows)")
+    out["speed_note"] = (
+        "sec/round on CPU is EXPECTED to regress under bf16 (the same f32-"
+        "convert emulation); the wall-clock win targets the memory-bound "
+        "TPU round body (PROFILE_r04: 719 MB accessed / 824 MFLOP, MFU "
+        "5e-5) where halved operand bytes are the lever")
+    return out
+
+
 def build_data(cfg, n_clients: int = 10, dataset=None):
     """Stacked federation tensors for a benchmark scenario.
 
@@ -331,6 +453,8 @@ def build_data(cfg, n_clients: int = 10, dataset=None):
     from fedmse_tpu.data import (build_dev_dataset, prepare_clients,
                                  stack_clients, synthetic_clients)
     from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    from fedmse_tpu.ops.precision import get_policy
 
     rngs = ExperimentRngs(run=0, data_seed=cfg.data_seed)
     if dataset is not None:
@@ -347,7 +471,9 @@ def build_data(cfg, n_clients: int = 10, dataset=None):
         clients = synthetic_clients(n_clients=10, dim=cfg.dim_features,
                                     n_normal=1700, n_abnormal=3300)
     dev_x = build_dev_dataset(clients, rngs.data_rng)
-    return stack_clients(clients, dev_x, cfg.batch_size), len(clients), rngs
+    data = stack_clients(clients, dev_x, cfg.batch_size,
+                         dtype=get_policy(cfg.precision).compute_dtype)
+    return data, len(clients), rngs
 
 
 def main():
@@ -402,6 +528,7 @@ def main():
     num_runs = _int_flag("--num-runs", None)
     sweep_runs = _int_flag("--sweep-runs", None)
     pipeline_bench = "--pipeline-bench" in sys.argv
+    precision_bench = "--precision-bench" in sys.argv
     if sweep_runs is not None and sweep_runs < 1:
         sys.exit(f"--sweep-runs expects a positive integer, got {sweep_runs}")
     chunk = _int_flag("--chunk", None)
@@ -429,6 +556,39 @@ def main():
     if paper:
         from fedmse_tpu.config import paper_scale
         cfg = paper_scale(cfg)
+
+    if precision_bench:
+        # f32-vs-bf16 sweep (ISSUE 5): sec/round + AUC + program bytes on
+        # both model types, plus the serving score path; one JSON line,
+        # written to BENCH_PRECISION_r07_<platform>.json (or --out)
+        device = jax.devices()[0]
+        out = {
+            "metric": f"precision sweep f32 vs bf16 (N-BaIoT {n_clients}-"
+                      f"client IID, hybrid + autoencoder, mse_avg, "
+                      f"quick-run schedule)",
+            "value": None,  # filled from the hybrid bytes ratio below
+            "unit": "x fewer argument bytes (f32/bf16), fused round body",
+            "device": str(device),
+            "platform": device.platform,
+            "mode": "precision policy sweep (ops/precision.py)",
+            "data_seed": cfg.data_seed,
+            "data_source": ("nbaiot" if os.path.isdir(NBAIOT_ROOT)
+                            or n_clients != 10 else "synthetic-fallback"),
+        }
+        out.update(measure_precision(cfg, n_clients=n_clients))
+        out["value"] = out["hybrid_round_body_bytes_ratio_f32_over_bf16"]
+        reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
+        if reason and reason != "1":
+            out["tpu_fallback_reason"] = reason
+        out.update(capture_provenance())
+        line = json.dumps(out)
+        print(line)
+        dest = _flag("--out",
+                     f"BENCH_PRECISION_r07_{device.platform}.json")
+        with open(dest, "w") as f:
+            f.write(line + "\n")
+        return
+
     data, n_real, rngs = build_data(cfg, n_clients)
 
     if pipeline_bench:

@@ -8,6 +8,12 @@ bucket-1 program). At this model size the dispatch overhead dwarfs the
 ~µs of compute per row (DESIGN.md §2), so batching the dispatch is the
 whole win — the acceptance bar is >=5x rows/sec at batch 1024 on CPU.
 
+A bf16 scoring column (ISSUE 5, ops/precision.py) rides along: the same
+batch-1024 stream through a `precision='bf16'` engine, plus the score
+path's program operand bytes under each policy — the halved resident/H2D
+bytes the precision policy buys the serving half (scores stay f32; see
+DESIGN.md §11 for the accumulation contract).
+
 Prints ONE JSON line and writes BENCH_SERVE_pr02_<platform>.json
 (override with --out). Run on CPU via `make serve-bench`.
 """
@@ -163,6 +169,44 @@ def main():
             r["rows_per_sec"] / baseline["rows_per_sec"], 2)
         results.append(r)
 
+    # bf16 scoring column (ops/precision.py): same stream, bf16-resident
+    # params + bf16 row buffers, f32 scores out. Calibration thresholds are
+    # reused — bf16 scores are quality-pinned to f32 (tests/test_precision)
+    # and thresholds don't affect throughput. The bytes column is the score
+    # path's program operand size under each policy (dtype-true on CPU; the
+    # wall-clock win targets memory-bound accelerators, not the f32-convert
+    # CPU emulation).
+    import jax.numpy as jnp
+    engine_bf16 = ServingEngine.from_federation(
+        model, model_type, params,
+        train_x=train_x if model_type == "hybrid" else None,
+        max_bucket=max(BATCHES), precision="bf16")
+    engine_bf16.warmup()
+    b = max(BATCHES)
+    bench_batched(engine_bf16, rows[:4 * b], gws[:4 * b], b, calibration)
+    bf16_row = bench_batched(engine_bf16, rows, gws, b, calibration)
+    bf16_row["speedup_vs_unbatched"] = round(
+        bf16_row["rows_per_sec"] / baseline["rows_per_sec"], 2)
+
+    def score_path_bytes(e):
+        m = e._scorer().lower(
+            jnp.zeros((b, dim), e.policy.compute_dtype),
+            jnp.zeros((b,), jnp.int32)).compile().memory_analysis()
+        return int(m.argument_size_in_bytes)
+
+    f32_bytes = score_path_bytes(engine)
+    bf16_bytes = score_path_bytes(engine_bf16)
+    bf16_scoring = {
+        "batch_1024": bf16_row,
+        "score_path_argument_bytes_f32": f32_bytes,
+        "score_path_argument_bytes_bf16": bf16_bytes,
+        "bytes_ratio_f32_over_bf16": round(f32_bytes / max(bf16_bytes, 1), 2),
+        "note": "bf16 = bf16-resident params + bf16 row buffers, f32 score "
+                "outputs (ops/precision.py); CPU rows/sec reflects the "
+                "f32-convert emulation, the bytes column is the "
+                "accelerator-relevant win",
+    }
+
     device = jax.devices()[0]
     out = {
         "metric": f"serving rows/sec ({model_type}, {N_GATEWAYS} gateways "
@@ -175,6 +219,7 @@ def main():
         "unbatched_baseline": baseline,
         "batched": results,
         "speedup_batch1024_vs_unbatched": results[-1]["speedup_vs_unbatched"],
+        "bf16_scoring": bf16_scoring,
         "first_request": first_request,
         "warmup_sec_per_bucket": {str(k): round(v, 4)
                                   for k, v in warmup_sec.items()},

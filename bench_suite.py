@@ -11,6 +11,8 @@ repo-grown axes):
   7. chaos churn: 30% dropout + aggregator-crash p=0.1 (fedmse_tpu/chaos/)
   8. pipelined vs serial chunk loop (federation/pipeline.py) + host-gap
      telemetry
+  9. precision sweep f32 vs bf16 (ops/precision.py): sec/round, program
+     bytes and AUC deltas on both model types + the serving score path
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -158,6 +160,19 @@ def scen_batched_runs(cfg, dataset):
             "sweeps": sweeps}
 
 
+def scen_precision(cfg, dataset):
+    """Scenario 9: the mixed-precision sweep (ISSUE 5) — f32 vs bf16 on
+    both model types: sec/round, AUC delta, and program operand bytes for
+    the fused round body and the serving score path. The artifact row is
+    bench.measure_precision's (same bytes/speed caveats; the committed
+    standalone artifact is BENCH_PRECISION_r07_cpu.json)."""
+    from bench import measure_precision
+
+    row = measure_precision(cfg, dataset=dataset)
+    return {"scenario": "precision sweep f32 vs bf16, 10-client, "
+                        "hybrid + autoencoder, 3 rounds", **row}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -180,9 +195,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-8")
-        if not 1 <= only <= 8:
-            sys.exit(f"--only expects a scenario number 1-8, got {only}")
+            sys.exit("--only expects a scenario number 1-9")
+        if not 1 <= only <= 9:
+            sys.exit(f"--only expects a scenario number 1-9, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -255,6 +270,9 @@ def main():
 
     if only in (None, 8):
         emit(scen_pipeline(ExperimentConfig(), nbaiot10))
+
+    if only in (None, 9):
+        emit(scen_precision(ExperimentConfig(), nbaiot10))
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

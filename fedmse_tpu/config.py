@@ -206,6 +206,16 @@ class ExperimentConfig:
     experiment_name: str = "fedmse-tpu"
     checkpoint_dir: str = "Checkpoint"
 
+    # Mixed-precision compute policy (ops/precision.py; no reference
+    # equivalent): 'f32' (default — bit-identical to the pre-policy
+    # pipeline, the parity-pinned mode) or 'bf16' (bf16 compute/activations
+    # and bf16-stored device datasets with f32 master params, f32 Adam
+    # state and f32 score/loss accumulation everywhere — quality-pinned:
+    # quick-run AUC within 2e-3 of f32 on both model types,
+    # tests/test_precision.py; see DESIGN.md §11 for why the accumulation
+    # dtype is a Byzantine-robustness surface, not a quality knob).
+    precision: str = "f32"
+
     # TPU-specific knobs (no reference equivalent)
     mesh_shape: Optional[Tuple[int, ...]] = None  # None => all local devices
     client_axis_name: str = "clients"

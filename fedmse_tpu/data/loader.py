@@ -43,21 +43,34 @@ def _csv_files(path: str) -> List[str]:
 
 
 def load_data(path: str, header: Optional[int] = None,
-              use_native: bool = True) -> pd.DataFrame:
+              use_native: bool = True,
+              dtype: Optional[np.dtype] = np.float32) -> pd.DataFrame:
     """Concatenate every CSV file in `path` (reference dataloader.py:22-30).
 
     Numeric shards parse through the native IO runtime when available
     (native/fedmse_io.cpp via data/fast_csv.py — ~10x faster than pandas,
-    GIL-free, float64 like pandas so results are bit-identical); anything the
-    native parser rejects — malformed/ragged files, header lines — falls back
-    to pandas, so behavior never depends on whether the library built. An
-    explicit `header` directive also disables the native path (honoring a
-    forced header index is a pandas-only feature)."""
+    GIL-free, float64 like pandas so the parsed values are bit-identical);
+    anything the native parser rejects — malformed/ragged files, header
+    lines — falls back to pandas, so behavior never depends on whether the
+    library built. An explicit `header` directive also disables the native
+    path (honoring a forced header index is a pandas-only feature).
+
+    `dtype` is the LOAD-BOUNDARY cast (float32 by default): both parse
+    paths emit float64 and used to keep it all the way to the pre-device
+    `astype(float32)` in prepare_clients, doubling host RAM across the
+    ~70 MB shard pool and every split/scale intermediate for digits the
+    device never sees. One cast here — identical on both paths, so
+    native/pandas bit-equality is preserved — halves the whole host data
+    pipeline. Pass dtype=None for the raw float64 parse (the shard-prep
+    tool rewrites CSVs and must round-trip source digits; data/prep.py)."""
     if use_native and header is None:
         try:
             from fedmse_tpu.data.fast_csv import native_available, read_dir_f64
             if native_available():
-                return pd.DataFrame(read_dir_f64(path, allow_header=False))
+                arr = read_dir_f64(path, allow_header=False)
+                if dtype is not None:
+                    arr = arr.astype(dtype)
+                return pd.DataFrame(arr)
         except Exception as e:
             logger.info("native CSV path failed for %s (%s); using pandas",
                         path, e)
@@ -67,14 +80,27 @@ def load_data(path: str, header: Optional[int] = None,
               for f in _csv_files(path)]
     if not frames:
         raise FileNotFoundError(f"no CSV files in {path}")
-    return pd.concat(frames, ignore_index=True)
+    out = pd.concat(frames, ignore_index=True)
+    if dtype is not None:
+        # numeric columns only: a forced-header parse can carry object cols
+        num = out.select_dtypes(include="number").columns
+        out[num] = out[num].astype(dtype)
+    return out
 
 
 class IoTDataProcessor:
     """Scaler wrapper with label attachment (reference dataloader.py:32-58).
 
     Pure-numpy StandardScaler/MinMaxScaler equivalents (sklearn semantics:
-    biased std, ddof=0; minmax to (0, 1))."""
+    biased std, ddof=0; minmax to (0, 1)).
+
+    Dtype discipline: the processor preserves the input dtype instead of
+    forcing float64 (the pre-PR behavior — the host-side f64 leak that
+    doubled RAM through the whole split/scale pipeline; ISSUE 5). With the
+    load boundary casting to float32 (`load_data`), every fit/transform
+    intermediate is f32; the mean/variance ACCUMULATORS still run in
+    float64 (np `dtype=` arguments) so the statistics keep sklearn-grade
+    accuracy on the ~100k-row shards before rounding to the storage dtype."""
 
     def __init__(self, scaler: str = "standard"):
         self.kind = scaler
@@ -83,30 +109,40 @@ class IoTDataProcessor:
         self.min_: Optional[np.ndarray] = None
 
     def fit(self, data: np.ndarray) -> "IoTDataProcessor":
-        data = np.asarray(data, dtype=np.float64)
+        data = np.asarray(data)
         if self.kind == "standard":
-            self.mean_ = data.mean(axis=0)
-            scale = data.std(axis=0)  # ddof=0, like sklearn StandardScaler
+            self.mean_ = data.mean(axis=0, dtype=np.float64).astype(data.dtype)
+            # ddof=0, like sklearn StandardScaler; f64 accumulation
+            scale = data.std(axis=0, dtype=np.float64).astype(data.dtype)
             # sklearn maps zero variance to scale 1.0
-            self.scale_ = np.where(scale == 0.0, 1.0, scale)
+            self.scale_ = np.where(scale == 0.0,
+                                   np.asarray(1.0, data.dtype), scale)
         elif self.kind == "minmax":
             dmin, dmax = data.min(axis=0), data.max(axis=0)
-            rng = np.where(dmax - dmin == 0.0, 1.0, dmax - dmin)
-            self.scale_ = 1.0 / rng
+            rng = np.where(dmax - dmin == 0.0,
+                           np.asarray(1.0, data.dtype), dmax - dmin)
+            self.scale_ = (np.asarray(1.0, data.dtype) / rng).astype(data.dtype)
             self.min_ = dmin
         else:
             raise ValueError(f"unknown scaler {self.kind!r}")
         return self
 
     def _apply(self, data: np.ndarray) -> np.ndarray:
-        data = np.asarray(data, dtype=np.float64)
-        if self.kind == "standard":
-            return (data - self.mean_) / self.scale_
-        return (data - self.min_) * self.scale_
+        data = np.asarray(data)
+        # float32 standardization can overflow to inf when a train split has
+        # near-zero variance in a feature other rows exercise hard; the
+        # overflow used to happen at the f64->f32 cast instead. Either way
+        # prepare_clients surfaces the non-finite count (cast32's check).
+        with np.errstate(over="ignore"):
+            if self.kind == "standard":
+                return (data - self.mean_) / self.scale_
+            return (data - self.min_) * self.scale_
 
     def transform(self, dataframe, type: str = "normal") -> Tuple[np.ndarray, np.ndarray]:
         processed = self._apply(np.asarray(dataframe))
-        label = np.zeros(len(processed)) if type == "normal" else np.ones(len(processed))
+        label = (np.zeros(len(processed), dtype=np.float32)
+                 if type == "normal"
+                 else np.ones(len(processed), dtype=np.float32))
         return processed, label
 
     def fit_transform(self, dataframe) -> Tuple[np.ndarray, np.ndarray]:
@@ -215,7 +251,9 @@ def prepare_clients(
             # produces the same infs; anomaly scores go through nan_to_num
             # in the evaluator — surfaced here so pathological splits are
             # visible, not silent (inf valid values would also poison the
-            # early-stop/best-restore comparisons).
+            # early-stop/best-restore comparisons). With the f32 load
+            # boundary the astype is a no-op pass-through and the overflow
+            # already happened inside the scaler; the check is what matters.
             with np.errstate(over="ignore"):
                 x32 = x.astype(np.float32)
             n_nonfinite = int((~np.isfinite(x32)).sum())

@@ -70,7 +70,10 @@ def pool_source_shards(source_dir: str) -> Dict[str, Tuple[pd.DataFrame, np.ndar
             path = os.path.join(source_dir, c, split)
             if not os.path.isdir(path):
                 continue
-            df = load_data(path)
+            # full float64: this tool REWRITES shards as CSV, and a float32
+            # round-trip would alter the source digits; the training data
+            # path casts at its own load boundary (loader.load_data)
+            df = load_data(path, dtype=np.float64)
             frames.append(df)
             origins.append(np.full(len(df), i))
         pooled[split] = (pd.concat(frames, ignore_index=True),

@@ -82,8 +82,16 @@ def stack_clients(
     dev_x: np.ndarray,
     batch_size: int,
     pad_clients_to: Optional[int] = None,
+    dtype: Optional[jnp.dtype] = None,
 ) -> FederatedData:
-    """Build the stacked FederatedData pytree from per-client arrays."""
+    """Build the stacked FederatedData pytree from per-client arrays.
+
+    `dtype` (ops/precision.py compute_dtype; None/float32 = unchanged) is
+    the storage dtype of the FEATURE tensors — train/valid/test/dev rows,
+    the [N, rows, 115] bulk that dominates H2D transfer and resident HBM
+    (PROFILE_r04 "bytes accessed"). Row masks, client masks and labels stay
+    float32: they are {0,1} bookkeeping, feed f32 reductions directly, and
+    cost nothing next to the feature bytes."""
     n_real = len(clients)
     n_pad = pad_clients_to or n_real
     assert n_pad >= n_real
@@ -120,10 +128,16 @@ def stack_clients(
 
     client_mask = (np.arange(n_pad) < n_real).astype(np.float32)
     stack = lambda xs: jnp.asarray(np.stack(xs, axis=0))
+    # feature tensors take the policy's storage dtype; a None/float32 dtype
+    # leaves the f32 arrays untouched (bit-identical default)
+    feat = (stack if dtype is None or dtype == jnp.float32
+            else lambda xs: jnp.asarray(np.stack(xs, axis=0), dtype=dtype))
+    dev = (jnp.asarray(dev_x) if dtype is None or dtype == jnp.float32
+           else jnp.asarray(dev_x, dtype=dtype))
     return FederatedData(
-        train_xb=stack(train_xb), train_mb=stack(train_mb),
-        valid_xb=stack(valid_xb), valid_mb=stack(valid_mb),
-        valid_x=stack(valid_x), valid_m=stack(valid_m),
-        test_x=stack(test_x), test_m=stack(test_m), test_y=stack(test_y),
-        dev_x=jnp.asarray(dev_x), client_mask=jnp.asarray(client_mask),
+        train_xb=feat(train_xb), train_mb=stack(train_mb),
+        valid_xb=feat(valid_xb), valid_mb=stack(valid_mb),
+        valid_x=feat(valid_x), valid_m=stack(valid_m),
+        test_x=feat(test_x), test_m=stack(test_m), test_y=stack(test_y),
+        dev_x=dev, client_mask=jnp.asarray(client_mask),
     )

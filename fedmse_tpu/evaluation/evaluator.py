@@ -55,12 +55,15 @@ def make_evaluate_all(model, model_type: str, metric: str = "AUC",
     def anomaly_scores_one(params, test_x, train_xf, train_mf):
         if fused != "off":
             from fedmse_tpu.ops.pallas_ae import fused_forward_stats
+            cdt = getattr(model, "compute_dtype", jnp.float32)
             test_latent, test_mse, _ = fused_forward_stats(
-                params, test_x, latent_dim=model.latent_dim, mode=fused)
+                params, test_x, latent_dim=model.latent_dim, mode=fused,
+                compute_dtype=cdt)
             if model_type == "autoencoder":
                 return test_mse
             train_latent, _, _ = fused_forward_stats(
-                params, train_xf, latent_dim=model.latent_dim, mode=fused)
+                params, train_xf, latent_dim=model.latent_dim, mode=fused,
+                compute_dtype=cdt)
             cen = fit_centroid(train_latent, train_mf)
             return cen.get_density(test_latent)
         test_latent, recon = model.apply({"params": params}, test_x)

@@ -30,9 +30,21 @@ from fedmse_tpu.ops.losses import mse_loss
 
 def weighted_tree_mean(params: Any, weights: jax.Array) -> Any:
     """Σ_n w_n · params_n over the leading client axis (weights already
-    normalized). The core collective of the framework."""
+    normalized). The core collective of the framework.
+
+    The reduction ACCUMULATES in f32 whatever the leaf dtype
+    (`preferred_element_type`; ops/precision.py): this merge produces the
+    global model every client verifies and votes on, so a bf16 accumulator
+    would quantize the federation's consensus state. Weights stay in their
+    own (f32) dtype — casting them to a bf16 leaf dtype first (the pre-PR
+    code) would silently round the normalized weights themselves. The
+    result is cast back to the leaf dtype so the merged tree keeps the
+    input layout (a no-op for the f32 master params this engine stores;
+    bit-identical on all-f32 trees either way)."""
     def reduce_leaf(t: jax.Array) -> jax.Array:
-        return jnp.einsum("n,n...->...", weights.astype(t.dtype), t)
+        acc = jnp.einsum("n,n...->...", weights, t,
+                         preferred_element_type=jnp.float32)
+        return acc.astype(t.dtype)
     return jax.tree.map(reduce_leaf, params)
 
 

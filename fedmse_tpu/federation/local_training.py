@@ -31,6 +31,16 @@ padded batches via row masks. Selection is applied by
 the caller (round engine) with a per-client select mask — unselected clients'
 state passes through unchanged, keeping shapes static (§7: 'selection masking
 instead of Python subsetting').
+
+Mixed precision (ops/precision.py): params and Adam state here are ALWAYS
+f32 masters — under the bf16 policy the model's flax modules cast params +
+inputs to bf16 at each Dense for the forward/backward (gradients return
+f32 through the cast's transpose), while every loss term — batch MSE, the
+shrink latent-norm penalty, the fedprox proximal term — accumulates in f32
+(ops/losses.py), so early-stop comparisons, tracking curves and the
+min_valid stream are f32 under either policy. Nothing in this file
+branches on the policy: the dtype contract rides in on the model and the
+stacked data.
 """
 
 from __future__ import annotations

@@ -147,8 +147,11 @@ def tree_client_divergence(params: Any, client_mask: jax.Array) -> jax.Array:
     w = client_mask / jnp.maximum(jnp.sum(client_mask), 1.0)
     sq = None
     for leaf in jax.tree.leaves(params):
-        mean = jnp.einsum("n,n...->...", w.astype(leaf.dtype), leaf)
+        # f32 accumulation whatever the leaf dtype (ops/precision.py): the
+        # mean-model reduction and the squared-distance sum are score math
+        mean = jnp.einsum("n,n...->...", w, leaf,
+                          preferred_element_type=jnp.float32)
         d = (leaf - mean).reshape(leaf.shape[0], -1)
-        s = jnp.sum(d * d, axis=1)
+        s = jnp.sum(d * d, axis=1, dtype=jnp.float32)
         sq = s if sq is None else sq + s
     return jnp.sqrt(sq)

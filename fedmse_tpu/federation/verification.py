@@ -128,9 +128,17 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
         return 1.0 / (1.0 + mse_loss(ver_x, recon, ver_m))
 
     def frob_delta(prev, new):
-        """Σ per-tensor Frobenius norms of the delta (model_verifier.py:79-84)."""
-        norms = jax.tree.leaves(
-            jax.tree.map(lambda a, b: jnp.linalg.norm((a - b).ravel()), prev, new))
+        """Σ per-tensor Frobenius norms of the delta (model_verifier.py:79-84).
+        f32 SUBTRACTION and accumulation whatever the leaf dtype
+        (ops/precision.py): the delta is compared against
+        verification_threshold — the Byzantine accept/reject decision — so
+        the leaves upcast BEFORE the subtract (a bf16 difference would
+        already quantize the exact quantity the threshold gates; casting
+        only the result would not undo that)."""
+        norms = jax.tree.leaves(jax.tree.map(
+            lambda a, b: jnp.linalg.norm(
+                (a.astype(jnp.float32) - b.astype(jnp.float32)).ravel()),
+            prev, new))
         return jnp.sum(jnp.stack(norms))
 
     @jax.jit

@@ -80,13 +80,18 @@ class GlobalEarlyStop:
 
 def prepare_federation(cfg: ExperimentConfig, dataset: DatasetConfig,
                        pad_multiple: Optional[int] = None):
-    """Load + split + stack the federation once (see module docstring)."""
+    """Load + split + stack the federation once (see module docstring).
+    The stacked feature tensors are stored in the precision policy's
+    compute dtype (ops/precision.py): under --precision bf16 the [N, rows,
+    115] bulk halves its H2D transfer and resident HBM."""
+    from fedmse_tpu.ops.precision import get_policy
     rngs = ExperimentRngs(run=0, data_seed=cfg.data_seed)
     clients = prepare_clients(dataset, cfg, rngs.data_rng)
     dev_x = build_dev_dataset(clients, rngs.data_rng)
     n_real = len(clients)
     pad_to = pad_to_multiple(n_real, pad_multiple) if pad_multiple else n_real
-    data = stack_clients(clients, dev_x, cfg.batch_size, pad_clients_to=pad_to)
+    data = stack_clients(clients, dev_x, cfg.batch_size, pad_clients_to=pad_to,
+                         dtype=get_policy(cfg.precision).compute_dtype)
     return clients, data, n_real
 
 
@@ -98,6 +103,9 @@ def _save_hybrid_latents(cfg: ExperimentConfig, model, stacked_params, data,
     latents = host_fetch(jax.jit(jax.vmap(
         lambda p, x: model.apply({"params": p}, x)[0]))(
             stacked_params, data.test_x))
+    # f32 artifact whatever the compute policy: the t-SNE notebook (and
+    # pickle consumers) expect plain numpy floats, not ml_dtypes bf16
+    latents = np.asarray(latents).astype(np.float32)
     mask = np.asarray(host_fetch(data.test_m)) > 0
     labels = np.asarray(host_fetch(data.test_y))
     lat = np.concatenate([latents[i][mask[i]] for i in range(n_real)])
@@ -129,7 +137,8 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed,
                           run_seed_stride=cfg.run_seed_stride)
     model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
-                       cfg.latent_dim, cfg.shrink_lambda)
+                       cfg.latent_dim, cfg.shrink_lambda,
+                       precision=cfg.precision)
     poison_fn = None
     if attack is not None:
         from fedmse_tpu.federation.attack import make_poison_fn
@@ -324,7 +333,8 @@ def run_batched_combination(cfg: ExperimentConfig, data, n_real: int,
 
     runs = cfg.num_runs
     model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
-                       cfg.latent_dim, cfg.shrink_lambda)
+                       cfg.latent_dim, cfg.shrink_lambda,
+                       precision=cfg.precision)
     poison_fn = None
     if attack is not None:
         from fedmse_tpu.federation.attack import make_poison_fn

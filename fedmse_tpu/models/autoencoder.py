@@ -13,6 +13,14 @@ ops/losses.py so the same apply_fn serves training, MSE scoring, verification
 and evaluation. `forward_with_loss` reproduces the reference's
 (latent, output, loss) triple for API parity.
 
+Mixed precision (ops/precision.py): every module carries a `compute_dtype`
+field — flax `Dense(dtype=...)` casts params AND inputs to it at the op, so
+bf16 forwards/backwards run against f32 master params (gradients come back
+f32 through the cast's transpose) and params always INIT in f32
+(`param_dtype` stays the flax f32 default). Loss/score reductions accumulate
+in f32 regardless (ops/losses.py). `compute_dtype=float32` (the default) is
+bit-identical to the pre-policy modules.
+
 TPU note: at D=115/27/7 these matmuls are far below MXU tile size (128x128);
 throughput comes from batching all N clients × batch rows into one fused
 computation (vmap over the stacked client axis), not from per-op size.
@@ -20,13 +28,14 @@ computation (vmap over the stacked client axis), not from per-op size.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Tuple, Union
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 from fedmse_tpu.ops.losses import mse_loss, shrink_loss
+from fedmse_tpu.ops.precision import PrecisionPolicy, get_policy
 
 # torch nn.Linear-style init: U(-1/sqrt(fan_in), 1/sqrt(fan_in)) weights
 # (reference Shrink_Autoencoder.py:47-59), zero bias.
@@ -36,18 +45,24 @@ fan_in_uniform = nn.initializers.variance_scaling(
 
 class Coder(nn.Module):
     """Two-layer MLP: Dense(hidden) -> ReLU -> Dense(out). Used for both the
-    encoder (out=latent_dim) and decoder (out=input_dim)."""
+    encoder (out=latent_dim) and decoder (out=input_dim). `compute_dtype`
+    casts params + inputs at each Dense; params stay f32 masters."""
 
     hidden: int
     out: int
+    compute_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         x = nn.Dense(self.hidden, kernel_init=fan_in_uniform,
-                     bias_init=nn.initializers.zeros)(x)
+                     bias_init=nn.initializers.zeros,
+                     dtype=self.compute_dtype,
+                     param_dtype=jnp.float32)(x)
         x = nn.relu(x)
         return nn.Dense(self.out, kernel_init=fan_in_uniform,
-                        bias_init=nn.initializers.zeros)(x)
+                        bias_init=nn.initializers.zeros,
+                        dtype=self.compute_dtype,
+                        param_dtype=jnp.float32)(x)
 
 
 class ShrinkAutoencoder(nn.Module):
@@ -59,10 +74,13 @@ class ShrinkAutoencoder(nn.Module):
     hidden_neus: int = 27
     latent_dim: int = 7
     shrink_lambda: float = 10.0
+    compute_dtype: Any = jnp.float32
 
     def setup(self):
-        self.encoder = Coder(self.hidden_neus, self.latent_dim)
-        self.decoder = Coder(self.hidden_neus, self.input_dim)
+        self.encoder = Coder(self.hidden_neus, self.latent_dim,
+                             self.compute_dtype)
+        self.decoder = Coder(self.hidden_neus, self.input_dim,
+                             self.compute_dtype)
 
     def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         latent = self.encoder(x)
@@ -80,10 +98,13 @@ class Autoencoder(nn.Module):
     input_dim: int = 115
     hidden_neus: int = 27
     latent_dim: int = 7
+    compute_dtype: Any = jnp.float32
 
     def setup(self):
-        self.encoder = Coder(self.hidden_neus, self.latent_dim)
-        self.decoder = Coder(self.hidden_neus, self.input_dim)
+        self.encoder = Coder(self.hidden_neus, self.latent_dim,
+                             self.compute_dtype)
+        self.decoder = Coder(self.hidden_neus, self.input_dim,
+                             self.compute_dtype)
 
     def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         latent = self.encoder(x)
@@ -95,15 +116,20 @@ class Autoencoder(nn.Module):
 
 
 def make_model(model_type: str, dim_features: int, hidden_neus: int = 27,
-               latent_dim: int = 7, shrink_lambda: float = 10.0):
+               latent_dim: int = 7, shrink_lambda: float = 10.0,
+               precision: Union[str, PrecisionPolicy] = "f32"):
     """Model factory matching the reference's hybrid/autoencoder switch
-    (src/main.py:229-236)."""
+    (src/main.py:229-236). `precision` selects the compute dtype
+    (ops/precision.py: 'f32' — the bit-identical default — or 'bf16');
+    params always live in f32."""
+    cdt = get_policy(precision).compute_dtype
     if model_type == "hybrid":
         return ShrinkAutoencoder(input_dim=dim_features, hidden_neus=hidden_neus,
-                                 latent_dim=latent_dim, shrink_lambda=shrink_lambda)
+                                 latent_dim=latent_dim, shrink_lambda=shrink_lambda,
+                                 compute_dtype=cdt)
     if model_type == "autoencoder":
         return Autoencoder(input_dim=dim_features, hidden_neus=hidden_neus,
-                           latent_dim=latent_dim)
+                           latent_dim=latent_dim, compute_dtype=cdt)
     raise ValueError(f"unknown model_type {model_type!r}")
 
 

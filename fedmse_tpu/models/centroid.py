@@ -33,9 +33,15 @@ class CentroidClassifier:
     abs_threshold: jax.Array  # scalar
 
     def get_density(self, x: jax.Array, scale: bool = True) -> jax.Array:
-        """Distance to the origin of standardized latents (Centroid.py:30-35)."""
+        """Distance to the origin of standardized latents (Centroid.py:30-35).
+
+        The norm accumulates in f32: this is the hybrid model's anomaly
+        SCORE, and the fitted mean/scale are f32 masters — bf16 latents
+        upcast exactly, f32 latents are untouched (ops/precision.py)."""
         if scale:
-            x = (x - self.mean) / self.scale
+            x = (x - self.mean) / self.scale  # f32 stats promote x to f32
+        if x.dtype != jnp.float32:
+            x = x.astype(jnp.float32)
         return jnp.linalg.norm(x, axis=-1)
 
     def predict(self, x: jax.Array) -> jax.Array:

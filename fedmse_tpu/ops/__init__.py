@@ -10,10 +10,13 @@ from fedmse_tpu.ops.metrics import (
     masked_auc,
     roc_auc,
 )
+from fedmse_tpu.ops.precision import PrecisionPolicy, get_policy, tree_cast
 from fedmse_tpu.ops.stats import masked_mean_std, masked_percentile
 
 __all__ = [
+    "PrecisionPolicy",
     "classification_metrics",
+    "get_policy",
     "masked_auc",
     "masked_mean",
     "masked_mean_std",
@@ -23,4 +26,5 @@ __all__ = [
     "prox_term",
     "roc_auc",
     "shrink_loss",
+    "tree_cast",
 ]

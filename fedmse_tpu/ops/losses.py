@@ -11,6 +11,13 @@ unmasked torch formulas bit-for-bit (up to float assoc):
                      (client_trainer.py:374-378; μ multiplied by caller).
   * `per_sample_mse` — per-row mean MSE, the AE anomaly score
                      (evaluator.py:56-62).
+
+Mixed precision (ops/precision.py): every reduction here carries an explicit
+float32 accumulator (`dtype=`/`ACCUM`), so bf16 activations sum in f32 and
+every loss/score comes out f32 — MSE scores drive voting, aggregation
+weighting and Byzantine verification, so accumulation dtype is a correctness
+surface (DESIGN.md §11). On f32 operands the annotations are what XLA already
+did: bit-identical to the unannotated formulas.
 """
 
 from __future__ import annotations
@@ -20,21 +27,28 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+# score/loss accumulation dtype (PrecisionPolicy.accum_dtype is always f32;
+# pinned here so the loss math cannot silently follow a bf16 operand)
+ACCUM = jnp.float32
+
 
 def _safe_div(num: jax.Array, den: jax.Array) -> jax.Array:
     return num / jnp.maximum(den, 1e-38)
 
 
 def masked_mean(values: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
-    """Mean of `values` rows where mask==1 (mask broadcast over row axis)."""
+    """Mean of `values` rows where mask==1 (mask broadcast over row axis);
+    accumulates (and returns) in f32 whatever the operand dtype."""
     if mask is None:
-        return jnp.mean(values)
-    return _safe_div(jnp.sum(values * mask), jnp.sum(mask))
+        return jnp.mean(values, dtype=ACCUM)
+    return _safe_div(jnp.sum(values * mask, dtype=ACCUM),
+                     jnp.sum(mask, dtype=ACCUM))
 
 
 def per_sample_mse(x: jax.Array, recon: jax.Array) -> jax.Array:
-    """Per-row mean squared error: [rows, D] -> [rows]."""
-    return jnp.mean(jnp.square(x - recon), axis=-1)
+    """Per-row mean squared error: [rows, D] -> [rows] (f32 accumulation —
+    this IS the AE anomaly score, so its dtype is a decision surface)."""
+    return jnp.mean(jnp.square(x - recon), axis=-1, dtype=ACCUM)
 
 
 def mse_loss(x: jax.Array, recon: jax.Array,
@@ -55,13 +69,16 @@ def shrink_loss(x: jax.Array, recon: jax.Array, latent: jax.Array,
     whole gradient. Guarding the sqrt argument leaves every nonzero-latent
     row bit-identical and gives padded rows a finite (then masked-out)
     gradient."""
-    sq = jnp.sum(jnp.square(latent), axis=-1)
+    sq = jnp.sum(jnp.square(latent), axis=-1, dtype=ACCUM)
     norms = jnp.sqrt(jnp.where(sq > 0, sq, 1.0)) * (sq > 0)
     return mse_loss(x, recon, mask) + shrink_lambda * masked_mean(norms, mask)
 
 
 def prox_term(params, global_params) -> jax.Array:
-    """Σ over all tensors of Σ(p − p_global)² (client_trainer.py:374-378)."""
+    """Σ over all tensors of Σ(p − p_global)² (client_trainer.py:374-378).
+    f32 accumulation: the proximal term must pull toward the f32 master
+    global, not a bf16-quantized image of it."""
     leaves = jax.tree_util.tree_leaves(
-        jax.tree.map(lambda p, g: jnp.sum(jnp.square(p - g)), params, global_params))
+        jax.tree.map(lambda p, g: jnp.sum(jnp.square(p - g), dtype=ACCUM),
+                     params, global_params))
     return jnp.sum(jnp.stack(leaves))

@@ -25,6 +25,12 @@ The packed output layout (one tile, fully-utilized lanes):
 `fused_forward_stats` is the public entry: it pads, calls the kernel (or an
 identical-math XLA fallback on non-TPU backends), and unpacks
 (latent [R, L], per_row_mse [R], latent_norm [R]).
+
+Mixed precision (ops/precision.py): `compute_dtype=bfloat16` ships bf16
+input/weight tiles — halving the dominant per-grid-step HBM bytes — while
+every dot accumulates f32 on the MXU and the packed output stays f32
+(MSE/latent norm are anomaly scores). The f32 default is bit-identical to
+the pre-policy kernel.
 """
 
 from __future__ import annotations
@@ -57,33 +63,49 @@ def _pad_bias(b: jax.Array, cols: int = LANE) -> jax.Array:
     return jnp.zeros((1, cols), b.dtype).at[0, : b.shape[0]].set(b)
 
 
-def pack_params(params: Dict[str, Any]) -> Tuple[jax.Array, ...]:
-    """Flax AE params -> eight zero-padded [128,128]/[1,128] mats."""
+def pack_params(params: Dict[str, Any],
+                compute_dtype: Any = jnp.float32) -> Tuple[jax.Array, ...]:
+    """Flax AE params -> eight zero-padded [128,128]/[1,128] mats.
+
+    WEIGHT mats take the kernel's tile dtype (ops/precision.py: bf16 halves
+    the per-grid-step HBM weight bytes; f32 — the default — is the
+    pre-policy layout). BIASES stay f32: a [1, 128] bf16 block sits below
+    the bf16 minimum tile (16, 128) for Mosaic lowering, the bytes are
+    negligible, and the dots they add into are f32 accumulators anyway."""
     enc0 = params["encoder"]["Dense_0"]
     enc1 = params["encoder"]["Dense_1"]
     dec0 = params["decoder"]["Dense_0"]
     dec1 = params["decoder"]["Dense_1"]
+    cast = lambda t: t.astype(compute_dtype)  # noqa: E731
+    b32 = lambda t: t.astype(jnp.float32)  # noqa: E731
     return (
-        _pad2(enc0["kernel"]), _pad_bias(enc0["bias"]),
-        _pad2(enc1["kernel"]), _pad_bias(enc1["bias"]),
-        _pad2(dec0["kernel"]), _pad_bias(dec0["bias"]),
-        _pad2(dec1["kernel"]), _pad_bias(dec1["bias"]),
+        _pad2(cast(enc0["kernel"])), _pad_bias(b32(enc0["bias"])),
+        _pad2(cast(enc1["kernel"])), _pad_bias(b32(enc1["bias"])),
+        _pad2(cast(dec0["kernel"])), _pad_bias(b32(dec0["bias"])),
+        _pad2(cast(dec1["kernel"])), _pad_bias(b32(dec1["bias"])),
     )
 
 
 def _kernel(dim, latent_dim, x_ref, w1_ref, b1_ref, w2_ref, b2_ref,
             w3_ref, b3_ref, w4_ref, b4_ref, out_ref):
+    # Tiles arrive in the compute dtype (f32 or bf16); every dot ACCUMULATES
+    # in f32 on the MXU (`preferred_element_type`) and the activation is
+    # cast back to the tile dtype between layers — standard bf16 recipe,
+    # identity when the tiles are f32. The packed output stays f32: MSE and
+    # latent norm are anomaly SCORES (accum surface, ops/precision.py).
     x = x_ref[:]
+    cdt = x.dtype
     h1 = jnp.maximum(
         jnp.dot(x, w1_ref[:], preferred_element_type=jnp.float32) + b1_ref[:],
-        0.0)
+        0.0).astype(cdt)
     z = jnp.dot(h1, w2_ref[:], preferred_element_type=jnp.float32) + b2_ref[:]
     h2 = jnp.maximum(
-        jnp.dot(z, w3_ref[:], preferred_element_type=jnp.float32) + b3_ref[:],
-        0.0)
+        jnp.dot(z.astype(cdt), w3_ref[:],
+                preferred_element_type=jnp.float32) + b3_ref[:],
+        0.0).astype(cdt)
     recon = jnp.dot(h2, w4_ref[:], preferred_element_type=jnp.float32) + b4_ref[:]
 
-    err = jnp.square(x - recon)          # padded cols are 0 - 0
+    err = jnp.square(x.astype(jnp.float32) - recon)  # padded cols are 0 - 0
     mse = jnp.sum(err, axis=1, keepdims=True) / dim
     znorm = jnp.sqrt(jnp.sum(jnp.square(z), axis=1, keepdims=True))
 
@@ -123,13 +145,17 @@ def _fused_pallas(x_pad: jax.Array, mats: Tuple[jax.Array, ...],
 
 def _fused_xla(x_pad: jax.Array, mats: Tuple[jax.Array, ...],
                dim: int, latent_dim: int) -> jax.Array:
-    """Identical math without pallas (non-TPU fallback)."""
+    """Identical math without pallas (non-TPU fallback): same f32 MXU-style
+    accumulation per dot, same inter-layer cast to the tile dtype."""
     w1, b1, w2, b2, w3, b3, w4, b4 = mats
-    h1 = jnp.maximum(x_pad @ w1 + b1, 0.0)
-    z = h1 @ w2 + b2
-    h2 = jnp.maximum(z @ w3 + b3, 0.0)
-    recon = h2 @ w4 + b4
-    mse = jnp.sum(jnp.square(x_pad - recon), axis=1, keepdims=True) / dim
+    cdt = x_pad.dtype
+    dot = lambda a, b: jnp.dot(a, b, preferred_element_type=jnp.float32)
+    h1 = jnp.maximum(dot(x_pad, w1) + b1, 0.0).astype(cdt)
+    z = dot(h1, w2) + b2
+    h2 = jnp.maximum(dot(z.astype(cdt), w3) + b3, 0.0).astype(cdt)
+    recon = dot(h2, w4) + b4
+    mse = jnp.sum(jnp.square(x_pad.astype(jnp.float32) - recon),
+                  axis=1, keepdims=True) / dim
     znorm = jnp.linalg.norm(z, axis=1, keepdims=True)
     col = jax.lax.broadcasted_iota(jnp.int32, z.shape, 1)
     packed = jnp.where(col < latent_dim, z, 0.0)
@@ -140,11 +166,18 @@ def _fused_xla(x_pad: jax.Array, mats: Tuple[jax.Array, ...],
 
 def fused_forward_stats(params: Dict[str, Any], x: jax.Array,
                         latent_dim: int = 7, mode: str = "auto",
-                        block_rows: int = BLOCK_ROWS
+                        block_rows: int = BLOCK_ROWS,
+                        compute_dtype: Any = jnp.float32
                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(latent [R, L], per_row_mse [R], latent_norm [R]) in one fused pass.
 
     mode: 'pallas' | 'xla' | 'interpret' | 'auto' (pallas on TPU, else XLA).
+
+    compute_dtype (ops/precision.py): the input/weight TILE dtype. bf16
+    halves the per-grid-step HBM bytes of the x tile and the replicated
+    weight mats; every dot still accumulates f32 on the MXU and the packed
+    output (latent / mse / znorm — score surfaces) stays f32. float32 is
+    bit-identical to the pre-policy kernel.
 
     The routing is backed by an on-hardware race (v5e, TPU_CHECK.json): the
     original block_rows=512 kernel was 25% slower on-chip than XLA's fusion
@@ -168,9 +201,9 @@ def fused_forward_stats(params: Dict[str, Any], x: jax.Array,
     # static under jit, so this costs nothing; waste is bounded at 511 rows.
     block_rows = min(block_rows, pl.cdiv(rows, 512) * 512)
     rows_pad = pl.cdiv(rows, block_rows) * block_rows
-    x_pad = jnp.zeros((rows_pad, LANE), jnp.float32)
-    x_pad = x_pad.at[:rows, :dim].set(x.astype(jnp.float32))
-    mats = pack_params(params)
+    x_pad = jnp.zeros((rows_pad, LANE), compute_dtype)
+    x_pad = x_pad.at[:rows, :dim].set(x.astype(compute_dtype))
+    mats = pack_params(params, compute_dtype)
 
     if mode == "auto":
         mode = "pallas" if jax.default_backend() == "tpu" else "xla"

@@ -1,0 +1,107 @@
+"""Mixed-precision policy: bf16 compute with f32 masters and f32 accumulation.
+
+PROFILE_r04 shows the fused round body is memory-bound, not compute-bound
+(~1.15 flops/byte, device busy 0.9%): every tensor in the stack was float32,
+so halving the bytes moved — device datasets, activations, matmul operands —
+is the single biggest lever on sec/round and on HBM residency at the
+500-client scale. This module is the one switch that governs it, the
+standard mixed-precision recipe (Micikevicius et al., arXiv 1710.03740)
+applied to the FedMSE workload:
+
+  * **param_dtype (f32 always)** — master weights. Local Adam updates, the
+    aggregated global model, verifier history and checkpoints all live in
+    float32; bf16 is a COMPUTE format here, never a storage format for
+    state that accumulates across rounds.
+  * **compute_dtype (f32 | bf16)** — matmul/activation dtype for every
+    forward and backward (flax `Dense(dtype=...)` casts params + inputs at
+    the op), and the storage dtype of the stacked device datasets
+    (data/stacking.py) — the [N, rows, 115] tensors that dominate the
+    profile's "bytes accessed".
+  * **accum_dtype (f32 always)** — reduction dtype. This is a CORRECTNESS
+    surface, not a quality knob: per-client MSE scores drive aggregator
+    voting, fed_mse_avg aggregation weights and Byzantine verification
+    (PAPER.md §3), so every score-producing reduction — MSE sums, latent
+    norms, centroid distances, Frobenius deltas, the aggregation einsum —
+    accumulates in f32 regardless of the operand dtype
+    (`preferred_element_type` on dots, `dtype=` on reduces). A bf16
+    accumulator would quantize the scores that decide WHO aggregates and
+    WHICH updates are accepted; f32 accumulation keeps those decisions on
+    the same scale as the f32 baseline.
+
+The `f32` preset is the default and is bit-identical to the pre-policy code
+path: every cast degenerates to a no-op and every explicit f32 accumulator
+annotation matches what XLA already did for f32 operands (pinned by the
+existing byte-comparison suites plus tests/test_precision.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Union
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """One experiment-wide dtype contract (hashable: rides in jit/program
+    cache keys and as a flax Module field)."""
+
+    name: str
+    param_dtype: Any    # master weights / optimizer state (always f32 here)
+    compute_dtype: Any  # matmuls, activations, stored device datasets
+    accum_dtype: Any    # score/loss reductions (always f32 here)
+
+    # ---- pytree cast helpers ---------------------------------------- #
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        """Cast every inexact leaf to compute_dtype (identity under f32)."""
+        return tree_cast(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree: Any) -> Any:
+        """Cast every inexact leaf to param_dtype (identity under f32)."""
+        return tree_cast(tree, self.param_dtype)
+
+    def cast_to_accum(self, tree: Any) -> Any:
+        """Cast every inexact leaf to accum_dtype (identity under f32)."""
+        return tree_cast(tree, self.accum_dtype)
+
+
+def tree_cast(tree: Any, dtype: Any) -> Any:
+    """Cast the inexact (floating) leaves of a pytree to `dtype`.
+
+    Integer/bool leaves (row masks' int cousins, rejected counters, PRNG
+    keys) pass through untouched. Leaves already in `dtype` are returned
+    as-is — the f32 policy on f32 state is the identity, same buffers."""
+    def cast(leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.inexact) \
+                and leaf.dtype != dtype:
+            return leaf.astype(dtype)
+        return leaf
+    return jax.tree.map(cast, tree)
+
+
+_POLICIES = {
+    # the pre-policy behavior: everything f32, every cast a no-op
+    "f32": PrecisionPolicy(name="f32", param_dtype=jnp.float32,
+                           compute_dtype=jnp.float32,
+                           accum_dtype=jnp.float32),
+    # bf16 compute + data, f32 masters and reductions — the standard
+    # large-scale training recipe; quality-pinned (AUC within 2e-3 of f32
+    # on the quick run, tests/test_precision.py), not bit-pinned
+    "bf16": PrecisionPolicy(name="bf16", param_dtype=jnp.float32,
+                            compute_dtype=jnp.bfloat16,
+                            accum_dtype=jnp.float32),
+}
+
+
+def get_policy(precision: Union[str, PrecisionPolicy]) -> PrecisionPolicy:
+    """Resolve a preset name (or pass a policy through)."""
+    if isinstance(precision, PrecisionPolicy):
+        return precision
+    policy = _POLICIES.get(precision)
+    if policy is None:
+        raise ValueError(f"unknown precision {precision!r}; expected one of "
+                         f"{sorted(_POLICIES)} or a PrecisionPolicy")
+    return policy

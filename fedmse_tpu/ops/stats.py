@@ -1,4 +1,12 @@
-"""Masked statistics helpers (sklearn/numpy-parity, static shapes)."""
+"""Masked statistics helpers (sklearn/numpy-parity, static shapes).
+
+Mixed precision (ops/precision.py): mean/variance/percentile statistics feed
+the centroid classifier's standardization and the voting path's
+re-standardization — score-deciding quantities — so sums here accumulate in
+f32 and the returned statistics are f32 regardless of the operand dtype
+(bf16 inputs standardize against f32 stats; f32 inputs are bit-identical to
+the unannotated formulas).
+"""
 
 from __future__ import annotations
 
@@ -7,21 +15,26 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+ACCUM = jnp.float32
+
 
 def masked_mean_std(x: jax.Array, mask: Optional[jax.Array] = None,
                     ddof: int = 0, eps: float = 0.0
                     ) -> Tuple[jax.Array, jax.Array]:
-    """Column-wise mean/std over valid rows. ddof=0 matches sklearn
-    StandardScaler; ddof=1 matches torch .std() (client_trainer.py:221-222)."""
+    """Column-wise mean/std over valid rows, f32 accumulation/output.
+    ddof=0 matches sklearn StandardScaler; ddof=1 matches torch .std()
+    (client_trainer.py:221-222)."""
     if mask is None:
-        n = jnp.asarray(x.shape[0], dtype=x.dtype)
-        mean = jnp.mean(x, axis=0)
-        var = jnp.sum(jnp.square(x - mean), axis=0) / jnp.maximum(n - ddof, 1.0)
+        n = jnp.asarray(x.shape[0], dtype=ACCUM)
+        mean = jnp.mean(x, axis=0, dtype=ACCUM)
+        var = jnp.sum(jnp.square(x - mean), axis=0,
+                      dtype=ACCUM) / jnp.maximum(n - ddof, 1.0)
     else:
         m = mask[:, None]
-        n = jnp.sum(mask)
-        mean = jnp.sum(x * m, axis=0) / jnp.maximum(n, 1.0)
-        var = jnp.sum(jnp.square(x - mean) * m, axis=0) / jnp.maximum(n - ddof, 1.0)
+        n = jnp.sum(mask, dtype=ACCUM)
+        mean = jnp.sum(x * m, axis=0, dtype=ACCUM) / jnp.maximum(n, 1.0)
+        var = jnp.sum(jnp.square(x - mean) * m, axis=0,
+                      dtype=ACCUM) / jnp.maximum(n - ddof, 1.0)
     return mean, jnp.sqrt(var) + eps
 
 
@@ -30,8 +43,9 @@ def masked_percentile(values: jax.Array, q: float,
     """np.percentile (linear interpolation) over valid entries, static shape.
 
     Pads are sorted to +inf; the interpolation index uses the dynamic valid
-    count n: idx = q/100 * (n-1).
-    """
+    count n: idx = q/100 * (n-1). Interpolation runs in f32 (the values feed
+    the centroid's decision threshold)."""
+    values = values.astype(ACCUM) if values.dtype != ACCUM else values
     if mask is None:
         return jnp.percentile(values, q)
     s = jnp.sort(jnp.where(mask > 0, values, jnp.inf))

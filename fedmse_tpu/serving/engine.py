@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import collections
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +39,7 @@ import numpy as np
 
 from fedmse_tpu.models.centroid import fit_centroid
 from fedmse_tpu.ops.losses import per_sample_mse
+from fedmse_tpu.ops.precision import PrecisionPolicy, get_policy
 
 
 def fit_gateway_centroids(model, stacked_params, train_x, train_m=None):
@@ -81,6 +82,14 @@ class ServingEngine:
     centroids : CentroidClassifier pytree — required for 'hybrid'; single
         (multi_tenant=False) or leaves stacked [N, ...] (multi_tenant=True).
     max_bucket : largest compiled row bucket; larger requests are chunked.
+    precision : 'f32' (default, bit-identical to the pre-policy engine) or
+        'bf16' (or a PrecisionPolicy, ops/precision.py). Under bf16 the
+        resident params and the dispatched row buffers are bfloat16 —
+        halving model HBM and the per-request H2D/score-path bytes — while
+        centroid statistics stay f32 masters and every score reduction
+        accumulates f32, so the RETURNED scores are float32 and calibration
+        thresholds/AUC remain comparable with the f32 engine (quality-
+        pinned, tests/test_precision.py; not bit-pinned — PARITY.md §7).
 
     Input buffers are fresh numpy arrays per dispatch, so nothing host-side
     retains them past the call. (Buffer DONATION was evaluated and dropped:
@@ -91,7 +100,8 @@ class ServingEngine:
 
     def __init__(self, model, model_type: str, params: Any,
                  centroids: Any = None, *, multi_tenant: bool = True,
-                 max_bucket: int = 1024):
+                 max_bucket: int = 1024,
+                 precision: Union[str, PrecisionPolicy] = "f32"):
         if model_type not in ("autoencoder", "hybrid"):
             raise ValueError(f"unknown model_type {model_type!r}")
         if model_type == "hybrid" and centroids is None:
@@ -99,11 +109,22 @@ class ServingEngine:
                              "(fit_gateway_centroids)")
         if max_bucket < 1:
             raise ValueError(f"max_bucket must be >= 1, got {max_bucket}")
+        self.policy = get_policy(precision)
+        cdt = self.policy.compute_dtype
+        if getattr(model, "compute_dtype", cdt) != cdt:
+            # the flax module must apply in the engine's compute dtype, or
+            # Dense's internal promote would silently undo the bf16 cast
+            model = model.clone(compute_dtype=cdt, parent=None)
         self.model = model
         self.model_type = model_type
         # device-resident once at load time (checkpoint loads arrive as
-        # numpy, which a traced gather could not index)
-        self.params = jax.tree.map(jnp.asarray, params)
+        # numpy, which a traced gather could not index). Under bf16 the
+        # resident copy IS bf16 — the f32 masters live in the checkpoint;
+        # serving is inference-only and never updates params.
+        self.params = jax.tree.map(jnp.asarray,
+                                   self.policy.cast_to_compute(params))
+        # centroid mean/scale/threshold stay f32 masters: they standardize
+        # the latent before the distance — a score-deciding statistic
         self.centroids = (None if centroids is None
                           else jax.tree.map(jnp.asarray, centroids))
         self.multi_tenant = multi_tenant
@@ -184,10 +205,11 @@ class ServingEngine:
         for observability; a warm bucket's entry is its bare dispatch
         cost."""
         fn = self._scorer()
+        cdt = self.policy.compute_dtype
         out: Dict[int, float] = {}
         for b in self.buckets:
             t0 = time.perf_counter()
-            jax.block_until_ready(fn(jnp.zeros((b, self.dim), jnp.float32),
+            jax.block_until_ready(fn(jnp.zeros((b, self.dim), cdt),
                                      jnp.zeros((b,), jnp.int32)))
             out[b] = time.perf_counter() - t0
         return out
@@ -228,8 +250,13 @@ class ServingEngine:
         while start < n:
             take = min(self.max_bucket, n - start)
             b = self.bucket_for(take)
-            # fresh buffers per dispatch — nothing retains them host-side
-            xp = np.zeros((b, self.dim), np.float32)
+            # fresh buffers per dispatch — nothing retains them host-side;
+            # the row buffer is ALLOCATED in the policy's compute dtype
+            # (ml_dtypes bfloat16 is a numpy dtype, so the f32->bf16 cast
+            # happens during the existing row copy — no second full-buffer
+            # conversion pass on the hot path; f32 is unchanged) and ships
+            # at half the H2D bytes under bf16
+            xp = np.zeros((b, self.dim), self.policy.compute_dtype)
             xp[:take] = x[start:start + take]
             gp = np.zeros(b, np.int32)
             gp[:take] = gw[start:start + take]

@@ -64,13 +64,14 @@ def run_serve_smoke(cfg, data, n_real: int, writer, device_names: Sequence[str],
     from fedmse_tpu.models import make_model
 
     model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
-                       cfg.latent_dim, cfg.shrink_lambda)
+                       cfg.latent_dim, cfg.shrink_lambda,
+                       precision=cfg.precision)
     engine = ServingEngine.from_checkpoint(
         writer, model, model_type, update_type, device_names[:n_real],
         run=run,
         train_x=np.asarray(data.train_xb[:n_real]),
         train_m=np.asarray(data.train_mb[:n_real]),
-        max_bucket=max_batch)
+        max_bucket=max_batch, precision=cfg.precision)
     calib = fit_calibration(engine, np.asarray(data.valid_x[:n_real]),
                             np.asarray(data.valid_m[:n_real]),
                             percentile=percentile)

@@ -1,0 +1,393 @@
+"""Mixed-precision policy tests (ops/precision.py; ISSUE 5).
+
+Four contracts, each a failure mode the policy must not have:
+  * f32 IDENTITY — the default policy is the pre-policy code path: casts
+    are no-ops (same buffers), the annotated reductions match the naive
+    formulas bit-for-bit, and a policy-threaded engine's stacked data and
+    params carry exactly the pre-PR dtypes. (The byte-level pin against
+    history is the existing pipeline/chaos/batched-runs comparison suites,
+    which all run under the default policy.)
+  * bf16 QUALITY — quick-run AUC within 2e-3 of f32 on BOTH model types:
+    bf16 is a compute format, not a different model.
+  * ACCUMULATION — the score-deciding reductions (losses, aggregation
+    einsum, Frobenius deltas, centroid stats) accumulate f32 under bf16
+    operands, and bf16 aggregation merges exactly as f32 math would after
+    rounding (the aggregation.py:35 regression).
+  * NO f64 — neither the host data pipeline nor any jitted entry point
+    traces a float64 value (the pre-PR loader kept f64 through prep).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.config import ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+from fedmse_tpu.federation import RoundEngine
+from fedmse_tpu.models import make_model, init_client_params, init_stacked_params
+from fedmse_tpu.ops.precision import get_policy, tree_cast
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+pytestmark = pytest.mark.precision
+
+DIM = 16
+N_CLIENTS = 4
+
+
+def _federation(precision: str):
+    clients = synthetic_clients(n_clients=N_CLIENTS, dim=DIM, seed=0)
+    dev = build_dev_dataset(clients, np.random.default_rng(1234))
+    cfg = ExperimentConfig(network_size=N_CLIENTS, dim_features=DIM,
+                           num_rounds=3, precision=precision)
+    data = stack_clients(clients, dev, cfg.batch_size,
+                         dtype=get_policy(precision).compute_dtype)
+    return cfg, data
+
+
+def _run(precision: str, model_type: str, update_type: str = "mse_avg"):
+    cfg, data = _federation(precision)
+    model = make_model(model_type, DIM, shrink_lambda=cfg.shrink_lambda,
+                       precision=precision)
+    engine = RoundEngine(model, cfg, data, n_real=N_CLIENTS,
+                         rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                         model_type=model_type, update_type=update_type,
+                         fused=True)
+    results = engine.run_rounds(0, cfg.num_rounds)
+    return results, engine
+
+
+# --------------------------- policy object --------------------------- #
+
+def test_policy_presets():
+    f32 = get_policy("f32")
+    bf16 = get_policy("bf16")
+    assert f32.compute_dtype == jnp.float32
+    # masters and accumulators are f32 under EVERY policy
+    for p in (f32, bf16):
+        assert p.param_dtype == jnp.float32
+        assert p.accum_dtype == jnp.float32
+    assert bf16.compute_dtype == jnp.bfloat16
+    assert get_policy(bf16) is bf16  # pass-through
+    with pytest.raises(ValueError, match="unknown precision"):
+        get_policy("fp8")
+
+
+def test_f32_cast_is_identity_same_buffers():
+    tree = {"w": jnp.arange(6.0).reshape(2, 3),
+            "n": jnp.arange(3),            # integer leaf: always untouched
+            "b": jnp.ones(4, jnp.bfloat16)}
+    out = get_policy("f32").cast_to_compute(tree)
+    assert out["w"] is tree["w"]           # no copy, no new buffer
+    assert out["n"] is tree["n"]
+    assert out["b"].dtype == jnp.float32   # off-dtype inexact leaves DO cast
+    back = tree_cast(out, jnp.bfloat16)
+    assert back["n"] is out["n"]
+    assert back["w"].dtype == jnp.bfloat16
+
+
+# ------------------------- (b) f32 identity -------------------------- #
+
+def test_f32_model_apply_matches_naive_matmul_chain():
+    """The policy-threaded module (explicit Dense dtype/param_dtype) must be
+    bit-identical to the raw f32 matmul chain — the pre-policy forward."""
+    model = make_model("hybrid", DIM, shrink_lambda=5.0)  # default = f32
+    params = init_client_params(model, jax.random.key(7))
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(33, DIM)).astype(np.float32))
+    latent, recon = model.apply({"params": params}, x)
+
+    def dense(p, v):
+        return v @ p["kernel"] + p["bias"]
+    enc, dec = params["encoder"], params["decoder"]
+    z = dense(enc["Dense_1"], jax.nn.relu(dense(enc["Dense_0"], x)))
+    r = dense(dec["Dense_1"], jax.nn.relu(dense(dec["Dense_0"], z)))
+    assert latent.dtype == recon.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(latent), np.asarray(z))
+    np.testing.assert_array_equal(np.asarray(recon), np.asarray(r))
+
+
+def test_f32_reductions_match_naive_formulas():
+    """The explicit f32 accumulator annotations must be what XLA already did
+    for f32 operands — bit-equal to the unannotated formulas."""
+    from fedmse_tpu.ops.losses import masked_mean, mse_loss, per_sample_mse
+    from fedmse_tpu.ops.stats import masked_mean_std
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(40, 5)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(40, 5)).astype(np.float32))
+    m = jnp.asarray((np.arange(40) < 29).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(per_sample_mse(x, y)),
+        np.asarray(jnp.mean(jnp.square(x - y), axis=-1)))
+    # mse_loss is mean-of-row-means (the pre-PR structure), not one flat mean
+    assert float(mse_loss(x, y)) == \
+        float(jnp.mean(jnp.mean(jnp.square(x - y), axis=-1)))
+    assert float(masked_mean(x[:, 0], None)) == float(jnp.mean(x[:, 0]))
+    mean, std = masked_mean_std(x, m)
+    naive_mean = jnp.sum(x * m[:, None], axis=0) / jnp.sum(m)
+    np.testing.assert_array_equal(np.asarray(mean), np.asarray(naive_mean))
+    assert mean.dtype == std.dtype == jnp.float32
+
+
+def test_f32_run_dtypes_are_pre_pr():
+    """Under the default policy every stacked tensor, param leaf and metric
+    is float32 — exactly the pre-PR layout (the byte-level history pin is
+    the pipeline/chaos/batched-runs comparison suites)."""
+    results, engine = _run("f32", "hybrid")
+    for leaf in jax.tree.leaves(engine.data):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree.leaves(engine.states.params):
+        assert leaf.dtype == jnp.float32
+    for r in results:
+        assert r.client_metrics.dtype == np.float32
+
+
+# ------------------------ (a) bf16 AUC parity ------------------------ #
+
+@pytest.mark.parametrize("model_type", ["hybrid", "autoencoder"])
+def test_bf16_quick_run_auc_parity(model_type):
+    """bf16 policy: final AUC within 2e-3 of f32 on both model types —
+    the ISSUE 5 quality pin (bf16 is quality-pinned, not bit-pinned)."""
+    res32, eng32 = _run("f32", model_type)
+    resbf, engbf = _run("bf16", model_type)
+    auc32 = float(np.nanmean(res32[-1].client_metrics))
+    aucbf = float(np.nanmean(resbf[-1].client_metrics))
+    assert abs(auc32 - aucbf) < 2e-3, (auc32, aucbf)
+    # masters stay f32, data and activations are bf16
+    for leaf in jax.tree.leaves(engbf.states.params):
+        assert leaf.dtype == jnp.float32
+    assert engbf.data.train_xb.dtype == jnp.bfloat16
+    assert engbf.data.test_x.dtype == jnp.bfloat16
+    # masks/labels stay f32 bookkeeping
+    assert engbf.data.train_mb.dtype == jnp.float32
+    assert engbf.data.test_y.dtype == jnp.float32
+    # metrics/scores come out f32 (accumulation surface)
+    assert resbf[-1].client_metrics.dtype == np.float32
+
+
+def test_bf16_adam_state_is_f32():
+    _, engine = _run("bf16", "hybrid", update_type="fedprox")
+    for leaf in jax.tree.leaves(engine.states.opt_state):
+        if jnp.issubdtype(leaf.dtype, jnp.inexact):
+            assert leaf.dtype == jnp.float32
+
+
+# -------------------- accumulation-dtype contracts -------------------- #
+
+def test_aggregation_bf16_merges_as_f32_math_after_rounding():
+    """Regression for aggregation.py:35: the einsum must accumulate in f32
+    (`preferred_element_type`), never in the leaf dtype. A bf16 merge must
+    equal upcast-to-f32 -> weighted sum -> round-to-bf16 EXACTLY."""
+    from fedmse_tpu.federation.aggregation import weighted_tree_mean
+
+    rng = np.random.default_rng(11)
+    tree = {"k": jnp.asarray(rng.normal(size=(6, 9, 4)).astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(6, 4)).astype(np.float32))}
+    raw = jnp.asarray(rng.random(6).astype(np.float32))
+    weights = raw / jnp.sum(raw)
+
+    tree_bf = tree_cast(tree, jnp.bfloat16)
+    got = weighted_tree_mean(tree_bf, weights)
+    for key in tree:
+        assert got[key].dtype == jnp.bfloat16  # leaf dtype preserved
+        want = jnp.einsum("n,n...->...", weights,
+                          tree_bf[key].astype(jnp.float32)
+                          ).astype(jnp.bfloat16)
+        np.testing.assert_array_equal(np.asarray(got[key], np.float32),
+                                      np.asarray(want, np.float32))
+    # and the f32 path is untouched by the annotation (bit-equal to naive)
+    got32 = weighted_tree_mean(tree, weights)
+    for key in tree:
+        naive = jnp.einsum("n,n...->...", weights, tree[key])
+        np.testing.assert_array_equal(np.asarray(got32[key]),
+                                      np.asarray(naive))
+
+
+def test_bf16_loss_and_score_reductions_accumulate_f32():
+    from fedmse_tpu.ops.losses import (mse_loss, per_sample_mse, prox_term,
+                                       shrink_loss)
+    from fedmse_tpu.models.centroid import fit_centroid
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(64, DIM)).astype(np.float32))
+    xb = x.astype(jnp.bfloat16)
+    y = (x + 0.1).astype(jnp.bfloat16)
+    z = jnp.asarray(rng.normal(size=(64, 7)).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+    assert per_sample_mse(xb, y).dtype == jnp.float32
+    assert mse_loss(xb, y).dtype == jnp.float32
+    assert shrink_loss(xb, y, z, 5.0).dtype == jnp.float32
+    p = {"w": z}
+    assert prox_term(p, jax.tree.map(jnp.zeros_like, p)).dtype == jnp.float32
+    cen = fit_centroid(z)
+    assert cen.mean.dtype == jnp.float32          # f32 master statistics
+    assert cen.abs_threshold.dtype == jnp.float32
+    assert cen.get_density(z).dtype == jnp.float32  # f32 score output
+    # the f32-accumulated bf16 MSE tracks the true f32 value closely (a
+    # bf16 accumulator over 16 features would already drift ~1e-2 here)
+    true = float(jnp.mean(jnp.square(x - (x + 0.1))))
+    assert float(mse_loss(xb, y)) == pytest.approx(true, rel=2e-2)
+
+
+def test_bf16_verification_outputs_are_f32():
+    """Frobenius deltas and perf scores — the Byzantine accept/reject
+    inputs — come out f32 under the bf16 policy."""
+    cfg, data = _federation("bf16")
+    model = make_model("autoencoder", DIM, precision="bf16")
+    engine = RoundEngine(model, cfg, data, n_real=N_CLIENTS,
+                         rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                         model_type="autoencoder", update_type="avg",
+                         fused=True)
+    agg = jax.tree.map(lambda t: t[0], engine.states.params)
+    onehot = jnp.zeros(data.num_clients_padded).at[0].set(1.0)
+    outcome = engine.verify(engine.states, agg, engine._ver_x, engine._ver_m,
+                            onehot, data.client_mask)
+    assert outcome.param_delta.dtype == jnp.float32
+    assert outcome.perf_change.dtype == jnp.float32
+
+
+# ---------------- (c) pallas bf16 kernel / XLA parity ---------------- #
+
+@pytest.mark.parametrize("rows", [1, 2, 16, 100, 512, 513, 1024])
+def test_pallas_bf16_matches_xla_at_every_bucket(rows):
+    """The bf16 kernel (interpret mode on CPU — same kernel program) and
+    the bf16 XLA fallback run the same cast/accumulate schedule, so they
+    must agree to f32-accumulation tolerance at every row bucket."""
+    from fedmse_tpu.ops.pallas_ae import fused_forward_stats
+
+    model = make_model("hybrid", 115, shrink_lambda=5.0)
+    params = init_client_params(model, jax.random.key(3))
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(rows, 115)).astype(np.float32))
+    out_k = fused_forward_stats(params, x, mode="interpret",
+                                compute_dtype=jnp.bfloat16, block_rows=512)
+    out_x = fused_forward_stats(params, x, mode="xla",
+                                compute_dtype=jnp.bfloat16, block_rows=512)
+    for a, b in zip(out_k, out_x):
+        assert a.dtype == jnp.float32  # packed outputs are f32 scores
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    # and against the bf16 flax forward: same matmuls at bf16 resolution
+    mbf = make_model("hybrid", 115, shrink_lambda=5.0, precision="bf16")
+    lat_ref, recon_ref = mbf.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(out_k[0]),
+                               np.asarray(lat_ref, np.float32), atol=0.05)
+
+
+# ------------------------- serving precision ------------------------- #
+
+@pytest.mark.parametrize("model_type", ["autoencoder", "hybrid"])
+def test_serving_bf16_scores_match_f32_at_every_bucket(model_type):
+    """bf16 serving: f32 score outputs within bf16 resolution of the f32
+    engine at every compiled bucket — calibration thresholds stay
+    comparable across policies."""
+    from fedmse_tpu.serving.engine import ServingEngine, fit_gateway_centroids
+
+    rng = np.random.default_rng(2)
+    model = make_model(model_type, DIM, shrink_lambda=5.0)
+    params = init_stacked_params(model, jax.random.key(0), 3)
+    train_x = rng.normal(size=(3, 64, DIM)).astype(np.float32)
+    cen = (fit_gateway_centroids(model, params, train_x)
+           if model_type == "hybrid" else None)
+    e32 = ServingEngine(model, model_type, params, cen, max_bucket=16)
+    ebf = ServingEngine(model, model_type, params, cen, max_bucket=16,
+                        precision="bf16")
+    assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(ebf.params))
+    for b in e32.buckets:
+        rows = rng.normal(size=(b, DIM)).astype(np.float32)
+        gws = rng.integers(0, 3, size=b).astype(np.int32)
+        s32 = e32.score(rows, gws)
+        sbf = ebf.score(rows, gws)
+        assert s32.dtype == sbf.dtype == np.float32
+        np.testing.assert_allclose(sbf, s32, rtol=0.05, atol=1e-3)
+
+
+# --------------------------- (d) no-f64 guard --------------------------- #
+
+def test_host_pipeline_and_stacked_arrays_never_f64(tmp_path):
+    """The loader satellite: CSV shards cast to f32 at the load boundary,
+    the scaler preserves f32 through prep, and no stacked device tensor is
+    float64 — host RAM and H2D bytes halve even on the f32 policy."""
+    import pandas as pd
+    from fedmse_tpu.config import DatasetConfig
+    from fedmse_tpu.data import load_data, prepare_clients
+
+    rng = np.random.default_rng(0)
+    for split, n in (("normal", 80), ("abnormal", 20), ("test_normal", 10)):
+        d = tmp_path / "Client-1" / split
+        d.mkdir(parents=True)
+        pd.DataFrame(rng.normal(size=(n, 6))).to_csv(
+            d / "data.csv", index=False, header=False)
+
+    df = load_data(str(tmp_path / "Client-1" / "normal"))
+    assert all(dt == np.float32 for dt in df.dtypes), df.dtypes
+    # the raw f64 parse stays available for the shard-prep rewrite path
+    df64 = load_data(str(tmp_path / "Client-1" / "normal"), dtype=None)
+    assert all(dt == np.float64 for dt in df64.dtypes)
+    np.testing.assert_array_equal(df.values,
+                                  df64.values.astype(np.float32))
+
+    ds = DatasetConfig.for_client_dirs(str(tmp_path), 1)
+    cfg = ExperimentConfig(dim_features=6, network_size=1)
+    clients = prepare_clients(ds, cfg, np.random.default_rng(1))
+    c = clients[0]
+    for name in ("train_x", "valid_x", "test_x", "test_y"):
+        assert getattr(c, name).dtype == np.float32, name
+    assert all(dt == np.float32 for dt in c.dev_raw.dtypes)
+    assert c.scaler.mean_.dtype == np.float32
+
+    dev = build_dev_dataset(clients, np.random.default_rng(2))
+    data = stack_clients(clients, dev, cfg.batch_size)
+    for leaf in jax.tree.leaves(data):
+        assert leaf.dtype != jnp.float64
+
+
+@pytest.mark.parametrize("precision", ["f32", "bf16"])
+def test_no_f64_tracers_in_jitted_entry_points(precision):
+    """Trace every jitted engine entry point (train / scores / aggregate /
+    verify / evaluate and the fused round body) and assert no float64 aval
+    appears anywhere in the jaxpr — the device-side half of the f64-leak
+    guard (avals print as f64[...], so a string scan over the jaxpr covers
+    eqn intermediates, subjaxprs and literals in one pass)."""
+    cfg, data = _federation(precision)
+    model = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda,
+                       precision=precision)
+    engine = RoundEngine(model, cfg, data, n_real=N_CLIENTS,
+                         rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                         model_type="hybrid", update_type="mse_avg",
+                         fused=True)
+    engine._build_fused()
+    n_pad = data.num_clients_padded
+    sel = [0, 2]
+    sel_idx, sel_mask = engine._selection_arrays(sel)
+    key = jax.random.key(0)
+
+    entry_points = {
+        "round_body": lambda: jax.make_jaxpr(engine._fused_round)(
+            engine.states, data, engine._ver_x, engine._ver_m,
+            jnp.asarray(sel_idx), jnp.asarray(sel_mask),
+            engine._agg_count_padded(), key, jnp.int32(0)),
+        "train_all": lambda: jax.make_jaxpr(
+            lambda s, o: engine.train_all(
+                s, o, s, jnp.asarray(sel_mask), data.train_xb, data.train_mb,
+                data.valid_xb, data.valid_mb))(
+                    engine.states.params, engine.states.opt_state),
+        "scores": lambda: jax.make_jaxpr(engine.scores_fn)(
+            engine.states.params, data.valid_x[0], data.valid_m[0], key),
+        "aggregate": lambda: jax.make_jaxpr(
+            lambda p: engine.aggregate(p, jnp.asarray(sel_mask), data.dev_x))(
+                engine.states.params),
+        "evaluate": lambda: jax.make_jaxpr(engine.evaluate_all)(
+            engine.states.params, data.test_x, data.test_m, data.test_y,
+            data.train_xb, data.train_mb),
+        "verify": lambda: jax.make_jaxpr(
+            lambda s, a: engine.verify(
+                s, a, engine._ver_x, engine._ver_m,
+                jnp.zeros(n_pad).at[0].set(1.0), data.client_mask))(
+                    engine.states,
+                    jax.tree.map(lambda t: t[0], engine.states.params)),
+    }
+    for name, trace in entry_points.items():
+        jaxpr = str(trace())
+        assert "f64[" not in jaxpr, f"float64 tracer in {name}"
