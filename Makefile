@@ -8,7 +8,8 @@ CXXFLAGS ?= -O3 -fPIC -Wall -Wextra
 LIB := fedmse_tpu/native/libfedmse_io.so
 
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
-        serve-bench chaos-sweep pipeline-bench precision-bench tpu-check
+        serve-bench chaos-sweep pipeline-bench precision-bench shard-bench \
+        tpu-check
 
 native: $(LIB)
 
@@ -59,6 +60,14 @@ pipeline-bench:
 precision-bench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		python bench.py --precision-bench --out BENCH_PRECISION_r07_cpu.json
+
+# shard-native client axis (DESIGN.md §12): 10k clients on a virtual
+# 8-device mesh — host-local stacking bytes/RSS, dense vs shard_map vs
+# int8-hierarchical merge rows, a full 10k fused round + the quantized
+# quality pin (writes BENCH_SHARD_r08_cpu.json; bench.py pins hermetic
+# CPU + the 8-device virtual platform itself)
+shard-bench:
+	python bench.py --shard-bench --out BENCH_SHARD_r08_cpu.json
 
 tpu-check:
 	python tpu_check.py

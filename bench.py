@@ -442,6 +442,250 @@ def measure_precision(cfg, timed_rounds: int = 3, serve_bucket: int = 1024,
     return out
 
 
+def _rss_mb() -> float:
+    """Resident set size of THIS process in MB (host-RAM observable for the
+    host-local stacking rows; /proc is always there on the linux boxes this
+    bench runs on)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except OSError:
+        pass
+    return -1.0
+
+
+def _light_clients(n_clients: int, dim: int, rows_train: int = 16,
+                   rows_valid: int = 4, rows_test: int = 10,
+                   seed: int = 0):
+    """n ClientData built straight from bulk numpy draws — at 10k clients the
+    per-client sklearn scaler fits of data.synthetic.synthetic_clients would
+    dominate the bench with overhead that is not under test (the stacking
+    and merge paths are)."""
+    import numpy as np
+    from fedmse_tpu.data.loader import ClientData
+
+    rng = np.random.default_rng(seed)
+    rows = rows_train + rows_valid + 2 * rows_test
+    normal = rng.normal(0, 1.0, size=(n_clients, rows, dim)).astype(np.float32)
+    abnormal = rng.normal(3.0, 1.5, size=(n_clients, rows_test, dim)
+                          ).astype(np.float32)
+    clients = []
+    for i in range(n_clients):
+        r = normal[i]
+        test_x = np.concatenate([r[rows_train + rows_valid:
+                                   rows_train + rows_valid + rows_test],
+                                 abnormal[i]])
+        test_y = np.concatenate([np.zeros(rows_test, np.float32),
+                                 np.ones(rows_test, np.float32)])
+        clients.append(ClientData(
+            name=f"shard-{i}", train_x=r[:rows_train],
+            valid_x=r[rows_train:rows_train + rows_valid],
+            test_x=test_x, test_y=test_y, dev_raw=None, scaler=None))
+    return clients, rng.normal(0, 1.0, size=(256, dim)).astype(np.float32)
+
+
+def measure_shard(cfg, n_clients: int = 10000, stack_hosts: int = 8,
+                  quant_hosts: int = 4):
+    """The shard-native client axis at 10k clients on the virtual 8-device
+    mesh (ISSUE 6 tentpole metric; DESIGN.md §12). Three row families:
+
+      * host-local stacking — per-host stacked bytes (the H2D payload each
+        host donates) and host RSS, replicated vs host-local (host 0 of
+        `stack_hosts`): the host-local path must land at ~1/stack_hosts;
+      * the merge at 10k — sec + parity for dense einsum vs shard_map
+        (bitwise pin) vs hierarchical int8 (error + bound);
+      * a full fused federation round at 10k on the mesh (shard_map and
+        quantized backends), plus the quantized quality pin on the
+        quick-run scale (final-AUC delta vs einsum, bar 2e-3 — the same
+        bar as the bf16 policy).
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from fedmse_tpu.config import CompatConfig
+    from fedmse_tpu.data import synthetic_clients
+    from fedmse_tpu.data.stacking import stack_clients, stack_dims
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.federation.aggregation import make_aggregate_fn
+    from fedmse_tpu.models import make_model, init_stacked_params
+    from fedmse_tpu.parallel import (client_mesh, make_hierarchical_aggregate,
+                                     make_shardmap_aggregate, pad_to_multiple,
+                                     shard_clients, shard_federation)
+    from fedmse_tpu.parallel.quantize import quantization_error_bound
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    mesh = client_mesh()
+    assert mesh.devices.size >= 8, (
+        "shard bench needs the 8-virtual-device mesh "
+        "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    dim = cfg.dim_features
+    out = {"n_clients": n_clients, "mesh_devices": int(mesh.devices.size),
+           "stack_hosts": stack_hosts, "quant_hosts": quant_hosts,
+           "quant_block_size": cfg.quant_block_size}
+
+    t0 = time.time()
+    clients, dev_x = _light_clients(n_clients, dim)
+    out["clients_build_sec"] = round(time.time() - t0, 2)
+    n_pad = pad_to_multiple(n_clients, mesh.devices.size)
+    dims = stack_dims(clients, cfg.batch_size, pad_clients_to=n_pad)
+
+    def stacked_bytes(data):
+        return int(sum(l.nbytes for l in jax.tree.leaves(data)))
+
+    # --- host-local stacking: replicated vs host 0's 1/stack_hosts slice ---
+    rss0 = _rss_mb()
+    t0 = time.time()
+    full = stack_clients(clients, dev_x, cfg.batch_size, pad_clients_to=n_pad,
+                         dims=dims)
+    out["stack_replicated"] = {
+        "sec": round(time.time() - t0, 2),
+        "stacked_bytes_per_host": stacked_bytes(full),
+        "host_rss_before_mb": rss0, "host_rss_after_mb": _rss_mb(),
+    }
+    per_host = n_pad // stack_hosts
+    rss0 = _rss_mb()
+    t0 = time.time()
+    local = stack_clients(clients, dev_x, cfg.batch_size,
+                          client_range=(0, per_host), dims=dims)
+    out["stack_host_local"] = {
+        "sec": round(time.time() - t0, 2),
+        "stacked_bytes_per_host": stacked_bytes(local),
+        "host_rss_before_mb": rss0, "host_rss_after_mb": _rss_mb(),
+        "rows": f"host 0 of {stack_hosts}: clients [0, {per_host})",
+    }
+    del local
+    out["h2d_bytes_ratio_replicated_over_local"] = round(
+        out["stack_replicated"]["stacked_bytes_per_host"]
+        / out["stack_host_local"]["stacked_bytes_per_host"], 2)
+
+    # --- the merge at n_pad clients: dense vs shard_map vs quantized ---
+    model = make_model("hybrid", dim, shrink_lambda=cfg.shrink_lambda)
+    params = shard_clients(
+        init_stacked_params(model, jax.random.key(0), n_pad), mesh)
+    sel = np.zeros(n_pad, np.float32)
+    sel[np.random.default_rng(0).choice(n_clients, n_clients // 2,
+                                        replace=False)] = 1.0
+    sel = shard_clients(jnp.asarray(sel), mesh)
+    dev = jnp.asarray(dev_x)
+    merges = {
+        "einsum": make_aggregate_fn(model, "avg"),
+        "shard_map": make_shardmap_aggregate(model, "avg", mesh),
+        "quantized": make_hierarchical_aggregate(
+            model, "avg", mesh, num_groups=quant_hosts,
+            block_size=cfg.quant_block_size),
+    }
+    merge_rows, results = {}, {}
+    for name, fn in merges.items():
+        results[name] = jax.block_until_ready(fn(params, sel, dev))  # warm
+
+        def timed_once(fn=fn):
+            t0 = time.time()
+            r = jax.block_until_ready(fn(params, sel, dev))
+            return time.time() - t0, r
+
+        sec, _ = _min_over_reps(timed_once)
+        merge_rows[name] = {"sec": round(sec, 5)}
+    agg_e = jax.device_get(results["einsum"][0])
+    agg_m = jax.device_get(results["shard_map"][0])
+    agg_q = jax.device_get(results["quantized"][0])
+    bitwise = all(np.array_equal(a, b) for a, b in
+                  zip(jax.tree.leaves(agg_e), jax.tree.leaves(agg_m)))
+    merge_rows["shard_map"]["bitwise_vs_einsum"] = bool(bitwise)
+    # per-leaf bound from the ACTUAL per-host partial sums (one quantized
+    # hop per host group: Σ_h max|partial_h|_block/254 — quantize.py; the
+    # final aggregate's maxima would understate it when host partials
+    # cancel), exactly what tests/test_shard_native.py asserts
+    w_host = np.asarray(jax.device_get(results["einsum"][1]))
+    params_host = jax.device_get(params)
+    rows_per_group = n_pad // quant_hosts
+    max_err = bound = 0.0
+    within = True
+    for leaf_e, leaf_q, leaf_p in zip(jax.tree.leaves(agg_e),
+                                      jax.tree.leaves(agg_q),
+                                      jax.tree.leaves(params_host)):
+        leaf_bound = 0.0
+        for g in range(quant_hosts):
+            rows = slice(g * rows_per_group, (g + 1) * rows_per_group)
+            part = np.einsum("n,n...->...", w_host[rows], leaf_p[rows])
+            leaf_bound += quantization_error_bound(part, cfg.quant_block_size)
+        leaf_err = float(np.abs(leaf_e - leaf_q).max())
+        within = within and leaf_err <= leaf_bound + 1e-7
+        max_err = max(max_err, leaf_err)
+        bound = max(bound, leaf_bound)
+    merge_rows["quantized"].update(
+        max_abs_error_vs_einsum=float(max_err),
+        max_per_leaf_error_bound=float(bound), within_bound=bool(within))
+    out["merge_10k"] = merge_rows
+
+    # --- full fused round at n_clients on the mesh ---
+    round_cfg = cfg.replace(network_size=n_clients, epochs=1, num_rounds=1,
+                            compat=CompatConfig(vote_tie_break=False))
+    round_rows = {}
+    for backend in ("shard_map", "quantized"):
+        bcfg = round_cfg.replace(aggregation_backend=backend,
+                                 quant_hosts=quant_hosts)
+        engine = RoundEngine(model, bcfg, full, n_real=n_clients,
+                             rngs=ExperimentRngs(run=0), model_type="hybrid",
+                             update_type="mse_avg", fused=True, mesh=mesh)
+        engine.data, engine.states = shard_federation(full, engine.states,
+                                                      mesh)
+        engine._ver_x, engine._ver_m = engine._verification_tensors()
+        t0 = time.time()
+        res = engine.run_round(0)  # cold: includes the 10k-program compile
+        compile_sec = time.time() - t0
+        engine.reset_federation()
+        t0 = time.time()
+        res = engine.run_round(0)
+        sec = time.time() - t0
+        round_rows[backend] = {
+            "sec_per_round_warm": round(sec, 3),
+            "first_round_incl_compile_sec": round(compile_sec, 2),
+            "mean_metric": round(float(np.nanmean(res.client_metrics)), 5),
+            "finite_metrics": bool(np.all(np.isfinite(res.client_metrics))),
+            "aggregator": res.aggregator,
+        }
+        del engine
+    out["round_10k"] = round_rows
+    del full, params, results
+
+    # --- quantized quality pin at the quick-run scale ---
+    small_clients = synthetic_clients(n_clients=10, dim=dim, n_normal=240,
+                                      n_abnormal=120)
+    small_dev = dev_x[:64]
+    small = stack_clients(small_clients, small_dev, cfg.batch_size,
+                          pad_clients_to=pad_to_multiple(
+                              10, mesh.devices.size))
+    aucs = {}
+    for backend in ("einsum", "quantized"):
+        bcfg = cfg.replace(network_size=10, num_rounds=3,
+                           aggregation_backend=backend,
+                           quant_hosts=quant_hosts)
+        engine = RoundEngine(make_model("hybrid", dim,
+                                        shrink_lambda=cfg.shrink_lambda),
+                             bcfg, small, n_real=10,
+                             rngs=ExperimentRngs(run=0), model_type="hybrid",
+                             update_type="mse_avg", fused=True, mesh=mesh)
+        engine.data, engine.states = shard_federation(small, engine.states,
+                                                      mesh)
+        engine._ver_x, engine._ver_m = engine._verification_tensors()
+        results = []
+        for r in range(3):
+            results.append(engine.run_round(r))
+        aucs[backend] = float(np.nanmean(results[-1].client_metrics))
+    delta = abs(aucs["einsum"] - aucs["quantized"])
+    out["quality_pin"] = {
+        "final_auc_einsum": round(aucs["einsum"], 5),
+        "final_auc_quantized": round(aucs["quantized"], 5),
+        "auc_delta": round(delta, 5),
+        "bar": 2e-3, "met": bool(delta <= 2e-3),
+        "protocol": "10-client quick run, 3 rounds, hybrid + mse_avg, "
+                    "sharded over the same mesh",
+    }
+    return out
+
+
 def build_data(cfg, n_clients: int = 10, dataset=None):
     """Stacked federation tensors for a benchmark scenario.
 
@@ -477,7 +721,21 @@ def build_data(cfg, n_clients: int = 10, dataset=None):
 
 
 def main():
-    _ensure_live_backend()
+    shard_bench = "--shard-bench" in sys.argv
+    if shard_bench:
+        # hermetic CPU + 8 virtual devices, pinned BEFORE any jax import
+        # (like the tests and serve-bench): the shard bench is a mesh
+        # correctness/scale measurement, never a TPU-tunnel one
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        from fedmse_tpu.utils.platform import force_cpu_platform
+        force_cpu_platform()
+    else:
+        _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
                                            enable_compilation_cache)
     enable_compilation_cache()  # persistent XLA cache across bench runs
@@ -556,6 +814,34 @@ def main():
     if paper:
         from fedmse_tpu.config import paper_scale
         cfg = paper_scale(cfg)
+
+    if shard_bench:
+        # shard-native client axis at 10k on the virtual 8-device mesh
+        # (ISSUE 6): host-local stacking bytes/RSS, merge backend rows
+        # (dense vs shard_map vs quantized), a full 10k fused round, and
+        # the quantized quality pin. One JSON line, written to
+        # BENCH_SHARD_r08_<platform>.json (or --out).
+        n_shard = _int_flag("--shard-clients", 10000)
+        device = jax.devices()[0]
+        out = {
+            "metric": f"10k-client shard-native federation round (virtual "
+                      f"8-device mesh, host-local stacking + hierarchical "
+                      f"int8 merge)",
+            "value": None,  # filled from the warm shard_map round below
+            "unit": "s/round",
+            "device": str(device),
+            "platform": device.platform,
+            "mode": "shard-native client axis (DESIGN.md §12)",
+        }
+        out.update(measure_shard(cfg, n_clients=n_shard))
+        out["value"] = out["round_10k"]["shard_map"]["sec_per_round_warm"]
+        out.update(capture_provenance())
+        line = json.dumps(out)
+        print(line)
+        dest = _flag("--out", f"BENCH_SHARD_r08_{device.platform}.json")
+        with open(dest, "w") as f:
+            f.write(line + "\n")
+        return
 
     if precision_bench:
         # f32-vs-bf16 sweep (ISSUE 5): sec/round + AUC + program bytes on

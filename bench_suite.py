@@ -13,6 +13,11 @@ repo-grown axes):
      telemetry
   9. precision sweep f32 vs bf16 (ops/precision.py): sec/round, program
      bytes and AUC deltas on both model types + the serving score path
+ 10. shard-native client axis (parallel/collectives.py, DESIGN.md §12):
+     10k clients on a virtual 8-device CPU mesh — host-local stacking
+     bytes, dense vs shard_map vs int8-hierarchical merge, full fused
+     round + quantized quality pin (runs in a subprocess so the virtual
+     platform never disturbs the suite's own backend)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -173,6 +178,42 @@ def scen_precision(cfg, dataset):
                         "hybrid + autoencoder, 3 rounds", **row}
 
 
+def scen_shard():
+    """Scenario 10: the shard-native client axis (ISSUE 6). Shelled out to
+    `bench.py --shard-bench` because the 8-virtual-device CPU platform must
+    be pinned before jax initializes — the suite process may already hold a
+    different backend. The subprocess writes its row to a temp file the
+    suite embeds verbatim (same row as the committed
+    BENCH_SHARD_r08_cpu.json)."""
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        tmp = f.name
+    try:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+                 "--shard-bench", "--out", tmp],
+                capture_output=True, text=True, timeout=1800)
+        except subprocess.TimeoutExpired:
+            # a hung shard bench must cost one error row, not the whole
+            # suite's aggregate JSON (written only at the end)
+            return {"scenario": "shard-native 10k-client axis",
+                    "error": "bench.py --shard-bench exceeded 1800 s"}
+        if proc.returncode != 0:
+            return {"scenario": "shard-native 10k-client axis",
+                    "error": proc.stdout[-500:] + proc.stderr[-500:]}
+        with open(tmp) as f:
+            row = json.load(f)
+    finally:
+        os.unlink(tmp)
+    row.pop("metric", None)
+    return {"scenario": "shard-native client axis: 10k clients, virtual "
+                        "8-device mesh, host-local stacking + hierarchical "
+                        "int8 merge", **row}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -195,9 +236,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-9")
-        if not 1 <= only <= 9:
-            sys.exit(f"--only expects a scenario number 1-9, got {only}")
+            sys.exit("--only expects a scenario number 1-10")
+        if not 1 <= only <= 10:
+            sys.exit(f"--only expects a scenario number 1-10, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -273,6 +314,9 @@ def main():
 
     if only in (None, 9):
         emit(scen_precision(ExperimentConfig(), nbaiot10))
+
+    if only in (None, 10):
+        emit(scen_shard())
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

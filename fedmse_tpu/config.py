@@ -219,14 +219,43 @@ class ExperimentConfig:
     # TPU-specific knobs (no reference equivalent)
     mesh_shape: Optional[Tuple[int, ...]] = None  # None => all local devices
     client_axis_name: str = "clients"
+    # Client-axis aggregation backend (parallel/collectives.py, DESIGN.md
+    # §12): how the weighted merge (and, under chaos, the divergence
+    # reduction) executes when the client axis is sharded over a mesh.
+    #   'einsum'    — jit auto-partitioning of the dense einsum (XLA lowers
+    #                 it to partial-sum + all-reduce; the default).
+    #   'shard_map' — explicit per-device f32 partial sums + lax.psum;
+    #                 pinned BIT-IDENTICAL to 'einsum' on the same mesh and
+    #                 the exact-f32 escape hatch for 'quantized'.
+    #   'quantized' — two-level hierarchical merge: intra-host psum in
+    #                 exact f32 (ICI), inter-host exchange blockwise-int8
+    #                 with per-block f32 scales, dequantize-then-accumulate
+    #                 in f32 (EQuARX-style; quality pin: quick-run AUC
+    #                 delta <= 2e-3, same bar as the bf16 policy).
+    # Off-mesh (client axis unsharded) every backend degenerates to
+    # 'einsum' — the explicit collectives need a mesh to be written against.
+    aggregation_backend: str = "einsum"
+    # blockwise int8 granularity of the 'quantized' backend: elements per
+    # f32 scale on the flattened leaf (error/element <= blockmax/254 per
+    # quantized hop — parallel/quantize.py)
+    quant_block_size: int = 256
+    # host-group count for the hierarchical merge: 0 = the real process
+    # topology (one group per process; the int8 DCN stage engages only
+    # where traffic actually crosses hosts — on a single host 'quantized'
+    # degenerates to the exact shard_map merge), N > 0 = N contiguous
+    # device groups play hosts (virtual-mesh testing/benching of the DCN
+    # stage on one machine)
+    quant_hosts: int = 0
     # compact-cohort training: gather the selected clients' state + data,
     # train only those S clients, scatter back — compute scales with the
     # participation ratio instead of the full client axis (identical math;
     # see local_training.make_local_train_all). False = dense: every stacked
-    # client trains and unselected results are masked away. The engine
-    # auto-falls back to dense when the client axis is sharded across
-    # devices (compact gathers would cross shards — RoundEngine.compact).
-    compact_cohort: bool = True
+    # client trains and unselected results are masked away. None (default) =
+    # auto: compact off-mesh, dense when the client axis is sharded across
+    # devices (compact gathers would cross shards — RoundEngine.compact
+    # logs the fallback at DEBUG). True = explicitly requested: same
+    # fallback, but logged at INFO since the user asked for compact mode.
+    compact_cohort: Optional[bool] = None
     # fused single-kernel forward for evaluation: 'off' | 'auto' | 'pallas' |
     # 'xla' ('auto' = pallas on TPU, XLA-fused elsewhere; ops/pallas_ae.py)
     fused_eval: str = "off"
@@ -322,7 +351,10 @@ def add_cli_overrides(parser) -> None:
             continue
         ftype = f.type if isinstance(f.type, type) else None
         name = "--" + f.name.replace("_", "-")
-        if ftype is bool or isinstance(f.default, bool):
+        if ftype is bool or isinstance(f.default, bool) or \
+                (f.default is None and "bool" in str(f.type)):
+            # Optional[bool] tri-state fields (compact_cohort: None = auto)
+            # still get a --flag that sets True/False explicitly
             parser.add_argument(name, type=_parse_bool, default=None)
         elif isinstance(f.default, (int, float, str)):
             parser.add_argument(name, type=type(f.default), default=None)

@@ -12,6 +12,17 @@ Batch-major layout: train/valid data is reshaped to [N, num_batches, B, D] so
 the per-epoch minibatch loop is a `lax.scan` over the batch axis — the exact
 sequential-batch semantics of the reference's unshuffled DataLoader
 (src/main.py:180-195 creates DataLoaders without shuffle=True).
+
+Host-local stacking (DESIGN.md §12): on a multi-host mesh every process used
+to stack and place the FULL client axis ("identical, fully-loaded-everywhere"
+— parallel/mesh.py). `stack_clients(..., client_range=(start, stop))` instead
+materializes only the rows [start, stop) of the global client axis — the rows
+this process's devices own (`parallel.mesh.process_client_rows`) — cutting
+host RAM and H2D bytes by 1/process_count. The batch/padding DIMENSIONS are
+computed from the full client list (`stack_dims`), so every host's local
+slice tiles the identical global tensor; `parallel.mesh.shard_federation
+(host_local=True)` donates the slices via
+`jax.make_array_from_process_local_data`.
 """
 
 from __future__ import annotations
@@ -48,6 +59,9 @@ class FederatedData:
     """All federation data as stacked device arrays (a pytree).
 
     N = padded client count; B = batch size. Row masks are float32 {0,1}.
+    Under host-local stacking a process's instance holds only ITS slice of
+    the global client axis (the global arrays exist only as sharded
+    jax.Arrays after placement).
     """
 
     # Training minibatches: [N, NB, B, D] / [N, NB, B]
@@ -77,12 +91,53 @@ class FederatedData:
         return self.train_xb.shape[-1]
 
 
+@dataclasses.dataclass(frozen=True)
+class StackDims:
+    """Global stacked-tensor dimensions, identical on every host.
+
+    A host-local stack must tile the SAME global tensor every other host
+    tiles, so the batch counts / row paddings derive from the full client
+    list even when a process materializes only its slice."""
+
+    n_real: int   # real clients
+    n_pad: int    # padded client-axis length (>= n_real)
+    nb: int       # training minibatches per client
+    nvb: int      # validation minibatches per client
+    v_max: int    # flat valid rows per client
+    t_max: int    # test rows per client
+    dim: int      # feature dimension
+
+
+def stack_dims(clients: Sequence[ClientData], batch_size: int,
+               pad_clients_to: Optional[int] = None) -> StackDims:
+    """The global dimensions `stack_clients` tiles — computable from client
+    row counts alone (every host holds the full client LIST; host-local
+    stacking only skips materializing other hosts' rows)."""
+    n_real = len(clients)
+    n_pad = pad_clients_to or n_real
+    assert n_pad >= n_real
+
+    def ceil_div(a: int, b: int) -> int:
+        return -(-a // b)
+
+    return StackDims(
+        n_real=n_real, n_pad=n_pad,
+        nb=max(ceil_div(len(c.train_x), batch_size) for c in clients),
+        nvb=max(ceil_div(len(c.valid_x), batch_size) for c in clients),
+        v_max=max(len(c.valid_x) for c in clients),
+        t_max=max(len(c.test_x) for c in clients),
+        dim=clients[0].train_x.shape[1],
+    )
+
+
 def stack_clients(
     clients: Sequence[ClientData],
     dev_x: np.ndarray,
     batch_size: int,
     pad_clients_to: Optional[int] = None,
     dtype: Optional[jnp.dtype] = None,
+    client_range: Optional[Tuple[int, int]] = None,
+    dims: Optional[StackDims] = None,
 ) -> FederatedData:
     """Build the stacked FederatedData pytree from per-client arrays.
 
@@ -91,42 +146,46 @@ def stack_clients(
     the [N, rows, 115] bulk that dominates H2D transfer and resident HBM
     (PROFILE_r04 "bytes accessed"). Row masks, client masks and labels stay
     float32: they are {0,1} bookkeeping, feed f32 reductions directly, and
-    cost nothing next to the feature bytes."""
-    n_real = len(clients)
-    n_pad = pad_clients_to or n_real
-    assert n_pad >= n_real
+    cost nothing next to the feature bytes.
 
-    def ceil_div(a: int, b: int) -> int:
-        return -(-a // b)
-
-    nb = max(ceil_div(len(c.train_x), batch_size) for c in clients)
-    nvb = max(ceil_div(len(c.valid_x), batch_size) for c in clients)
-    v_max = max(len(c.valid_x) for c in clients)
-    t_max = max(len(c.test_x) for c in clients)
-    d = clients[0].train_x.shape[1]
+    `client_range=(start, stop)` materializes only that slice of the GLOBAL
+    padded client axis (host-local stacking — see module docstring): the
+    returned leaves have leading axis stop-start and are bit-identical to
+    rows [start, stop) of the full stack. Dimensions still come from the
+    full client list (or an explicit `dims`), so slices from different
+    processes tile one consistent global tensor. Default (None) is the full
+    axis — the pre-host-local behavior, bit-identical."""
+    d = dims or stack_dims(clients, batch_size, pad_clients_to)
+    n_real, n_pad = d.n_real, d.n_pad
+    start, stop = client_range or (0, n_pad)
+    assert 0 <= start <= stop <= n_pad, (start, stop, n_pad)
 
     def zeros_client() -> ClientData:
         z = lambda *s: np.zeros(s, dtype=np.float32)
-        return ClientData(name="<pad>", train_x=z(1, d), valid_x=z(1, d),
-                          test_x=z(1, d), test_y=z(1), dev_raw=None, scaler=None)
-
-    padded: List[ClientData] = list(clients) + [zeros_client() for _ in range(n_pad - n_real)]
+        return ClientData(name="<pad>", train_x=z(1, d.dim), valid_x=z(1, d.dim),
+                          test_x=z(1, d.dim), test_y=z(1), dev_raw=None, scaler=None)
 
     train_xb, train_mb, valid_xb, valid_mb = [], [], [], []
     valid_x, valid_m, test_x, test_m, test_y = [], [], [], [], []
-    for i, c in enumerate(padded):
+    pad_client = None
+    for i in range(start, stop):
         is_real = i < n_real
-        xb, mb = _to_batches(c.train_x, len(c.train_x) if is_real else 0, batch_size, nb)
+        if is_real:
+            c = clients[i]
+        else:
+            pad_client = pad_client or zeros_client()
+            c = pad_client
+        xb, mb = _to_batches(c.train_x, len(c.train_x) if is_real else 0, batch_size, d.nb)
         train_xb.append(xb); train_mb.append(mb)
-        xb, mb = _to_batches(c.valid_x, len(c.valid_x) if is_real else 0, batch_size, nvb)
+        xb, mb = _to_batches(c.valid_x, len(c.valid_x) if is_real else 0, batch_size, d.nvb)
         valid_xb.append(xb); valid_mb.append(mb)
-        valid_x.append(_pad_rows(c.valid_x, v_max))
-        valid_m.append((np.arange(v_max) < (len(c.valid_x) if is_real else 0)).astype(np.float32))
-        test_x.append(_pad_rows(c.test_x, t_max))
-        test_m.append((np.arange(t_max) < (len(c.test_x) if is_real else 0)).astype(np.float32))
-        test_y.append(_pad_rows(c.test_y, t_max))
+        valid_x.append(_pad_rows(c.valid_x, d.v_max))
+        valid_m.append((np.arange(d.v_max) < (len(c.valid_x) if is_real else 0)).astype(np.float32))
+        test_x.append(_pad_rows(c.test_x, d.t_max))
+        test_m.append((np.arange(d.t_max) < (len(c.test_x) if is_real else 0)).astype(np.float32))
+        test_y.append(_pad_rows(c.test_y, d.t_max))
 
-    client_mask = (np.arange(n_pad) < n_real).astype(np.float32)
+    client_mask = (np.arange(start, stop) < n_real).astype(np.float32)
     stack = lambda xs: jnp.asarray(np.stack(xs, axis=0))
     # feature tensors take the policy's storage dtype; a None/float32 dtype
     # leaves the f32 arrays untouched (bit-identical default)
@@ -141,3 +200,26 @@ def stack_clients(
         test_x=feat(test_x), test_m=stack(test_m), test_y=stack(test_y),
         dev_x=dev, client_mask=jnp.asarray(client_mask),
     )
+
+
+def pad_federated_data(data: FederatedData, n_pad: int) -> FederatedData:
+    """Grow an already-stacked federation's client axis to `n_pad` by
+    appending zero clients (client_mask 0, all row masks 0 — excluded from
+    selection, aggregation, and evaluation exactly like stack-time padding).
+    The driver uses this to auto-pad to a mesh-size multiple
+    (main.py:run_combination) instead of erroring in `shard_federation`."""
+    n_old = data.num_clients_padded
+    if n_pad == n_old:
+        return data
+    if n_pad < n_old:
+        raise ValueError(f"cannot shrink the client axis {n_old} -> {n_pad}")
+
+    def grow(leaf):
+        pad = jnp.zeros((n_pad - n_old,) + leaf.shape[1:], leaf.dtype)
+        return jnp.concatenate([leaf, pad], axis=0)
+
+    return FederatedData(**{
+        f.name: (getattr(data, f.name) if f.name == "dev_x"
+                 else grow(getattr(data, f.name)))
+        for f in dataclasses.fields(FederatedData)
+    })

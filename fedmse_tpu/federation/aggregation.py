@@ -15,6 +15,12 @@ TPU-first: a masked weighted sum over the stacked client axis. When the client
 axis is sharded over a device mesh, XLA lowers `jnp.einsum('n,n...->...')`
 to a weighted all-reduce over ICI — the collective form of the reference's
 shared-memory state_dict averaging (SURVEY.md §5.8).
+
+`make_aggregate_for` is the config-selected dispatch over the three merge
+backends (cfg.aggregation_backend, DESIGN.md §12): the einsum lowering
+here, or the explicit shard_map / hierarchical-int8 collectives from
+parallel/collectives.py — all with the same call signature, so the fused
+round body is backend-agnostic.
 """
 
 from __future__ import annotations
@@ -87,3 +93,28 @@ def make_aggregate_fn(model, update_type: str) -> Callable:
         return weighted_tree_mean(stacked_params, weights), weights
 
     return aggregate
+
+
+def make_aggregate_for(model, update_type: str, backend: str, mesh=None,
+                       axis_name: str = "clients", quant_hosts: int = 0,
+                       quant_block_size: int = 256) -> Callable:
+    """Config-selected aggregation backend (cfg.aggregation_backend;
+    DESIGN.md §12). `backend` must already be EFFECTIVE — the engine
+    degrades explicit backends to 'einsum' off-mesh
+    (RoundEngine.agg_backend) before calling here, so a mesh is required
+    for the explicit collectives."""
+    if backend == "einsum":
+        return make_aggregate_fn(model, update_type)
+    if mesh is None:
+        raise ValueError(f"aggregation_backend={backend!r} needs a mesh "
+                         "(the client axis must be sharded)")
+    from fedmse_tpu.parallel.collectives import (make_hierarchical_aggregate,
+                                                 make_shardmap_aggregate)
+    if backend == "shard_map":
+        return make_shardmap_aggregate(model, update_type, mesh, axis_name)
+    if backend == "quantized":
+        return make_hierarchical_aggregate(
+            model, update_type, mesh, axis_name, num_groups=quant_hosts,
+            block_size=quant_block_size)
+    raise ValueError(f"unknown aggregation_backend {backend!r} "
+                     "(einsum | shard_map | quantized)")

@@ -139,8 +139,9 @@ class BatchedRunEngine:
     def compact(self) -> bool:
         """Same policy as RoundEngine.compact: compact-cohort gathers stay on
         unless the client axis is sharded (batched mode is single-mesh only,
-        so in practice this is just the config switch)."""
-        if not self.cfg.compact_cohort:
+        so in practice this is just the config switch; None = auto =
+        compact on, like the sequential engine off-mesh)."""
+        if self.cfg.compact_cohort is False:
             return False
         return not _client_axis_is_sharded(self.data.train_xb)
 

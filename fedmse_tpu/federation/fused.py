@@ -115,7 +115,8 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                     evaluate_all: Callable, max_threshold: int,
                     compact_cohort: bool = False,
                     poison_fn: Optional[Callable] = None,
-                    chaos: bool = False) -> Callable:
+                    chaos: bool = False,
+                    divergence_fn: Optional[Callable] = None) -> Callable:
     """Build the traceable round body (jit-wrapped by make_fused_round,
     scanned directly by make_fused_rounds_scan):
 
@@ -150,6 +151,12 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
     All-clear masks make every chaos op the identity (multiply by 1.0,
     where on an all-true predicate), so a zero-probability ChaosSpec is
     bit-identical to the chaos-free program (tests/test_chaos.py).
+
+    `divergence_fn(params, client_mask) -> [N]`, when given, replaces the
+    default dense `tree_client_divergence` for the chaos-only divergence
+    observable — the engine passes the explicit shard_map + psum reduction
+    (parallel/collectives.py::make_shardmap_divergence) when a non-einsum
+    aggregation backend is selected on a sharded mesh (DESIGN.md §12).
     """
 
     def round_body(states: ClientStates, data, ver_x, ver_m, sel_indices,
@@ -263,7 +270,8 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
 
         # resilience observable: post-merge per-client parameter divergence
         # (chaos runs only — the clean program does not pay for it)
-        divergence = tree_client_divergence(states.params, data.client_mask) \
+        div_fn = divergence_fn or tree_client_divergence
+        divergence = div_fn(states.params, data.client_mask) \
             if chaos else jnp.zeros(n_pad, jnp.float32)
 
         out = FusedRoundOut(aggregator=aggregator, metrics=metrics,
@@ -276,14 +284,19 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
     return round_body
 
 
-def make_fused_round(*args, chaos: bool = False) -> Callable:
+def make_fused_round(*args, chaos: bool = False,
+                     divergence_fn: Optional[Callable] = None) -> Callable:
     """The single-dispatch round: jitted round body with the incoming states
     buffers donated (they are consumed and replaced every round). With
     `chaos=True` the call takes a trailing single-round ChaosMasks slice."""
-    return jax.jit(make_round_body(*args, chaos=chaos), donate_argnums=(0,))
+    return jax.jit(make_round_body(*args, chaos=chaos,
+                                   divergence_fn=divergence_fn),
+                   donate_argnums=(0,))
 
 
-def make_fused_rounds_scan(*args, chaos: bool = False) -> Callable:
+def make_fused_rounds_scan(*args, chaos: bool = False,
+                           divergence_fn: Optional[Callable] = None
+                           ) -> Callable:
     """Build the whole-schedule runner: `lax.scan` of the raw round body over
     a precomputed selection schedule.
 
@@ -303,7 +316,8 @@ def make_fused_rounds_scan(*args, chaos: bool = False) -> Callable:
     xs exactly like the selection schedule: failure is an INPUT to the
     program, not control flow around it (DESIGN.md §9).
     """
-    round_body = make_round_body(*args, chaos=chaos)
+    round_body = make_round_body(*args, chaos=chaos,
+                                 divergence_fn=divergence_fn)
 
     @partial(jax.jit, donate_argnums=(0,))
     def run_all(states: ClientStates, data, ver_x, ver_m, sel_schedule,

@@ -238,7 +238,8 @@ class RoundEngine:
     def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
                  n_real: int, rngs: ExperimentRngs, model_type: str,
                  update_type: str, profile: bool = False,
-                 fused: bool = False, poison_fn=None, chaos=None):
+                 fused: bool = False, poison_fn=None, chaos=None,
+                 mesh=None):
         self.model = model
         self.cfg = cfg
         self.data = data
@@ -247,6 +248,12 @@ class RoundEngine:
         self.rngs = rngs
         self.model_type = model_type
         self.update_type = update_type
+        # client-axis mesh (optional): when given, client states are BORN
+        # sharded with the canonical layout (state.init_client_states
+        # out_shardings — per-client Adam moments live only on the shard
+        # that trains that client) and the explicit-collective aggregation
+        # backends have their mesh without waiting for a data swap
+        self.mesh = mesh
 
         if cfg.metric == "time" and fused:
             # latency is a host-side wall-clock measurement; it cannot run
@@ -265,7 +272,8 @@ class RoundEngine:
         self.evaluate_all = programs["evaluate_all"]
 
         self.states: ClientStates = init_client_states(
-            model, self.tx, rngs.next_jax(), self.n_pad)
+            model, self.tx, rngs.next_jax(), self.n_pad, mesh=mesh,
+            axis_name=cfg.client_axis_name)
         self.host = HostState.create(n_real)
         self._ver_x, self._ver_m = self._verification_tensors()
         from fedmse_tpu.utils.profiling import PhaseTimer
@@ -273,6 +281,7 @@ class RoundEngine:
 
         self.fused = fused
         self._warned_compact_off = False  # log the compact fallback once
+        self._warned_backend_off = False  # log the einsum fallback once
         self.poison_fn = poison_fn  # attack simulation (federation/attack.py)
         # chaos fault injection (fedmse_tpu/chaos/): a ChaosSpec compiled
         # into the fused program as per-round mask tensors. The per-phase
@@ -292,6 +301,7 @@ class RoundEngine:
         self._fused_round = None
         self._fused_scan = None
         self._fused_compact = None  # compact value baked into the programs
+        self._fused_backend = None  # aggregation backend baked into them
         if fused and profile:
             logger.warning("profile=True forces the per-phase (unfused) round "
                            "path; fused dispatch is not phase-attributable")
@@ -305,22 +315,96 @@ class RoundEngine:
                 "into the fused round/scan programs")
         # data / verification tensors are passed at CALL time (sharded
         # global arrays must be jit arguments, not closure constants)
-        self._fused_compact = self.compact  # value baked into the programs
-        args = (self.train_all, self.scores_fn, self.aggregate, self.verify,
+        self._fused_compact = self.compact  # values baked into the programs
+        self._fused_backend = self.agg_backend
+        aggregate = self._aggregate_for(self._fused_backend)
+        divergence_fn = self._divergence_for(self._fused_backend)
+        args = (self.train_all, self.scores_fn, aggregate, self.verify,
                 self.evaluate_all, self.cfg.max_aggregation_threshold,
                 self._fused_compact, self.poison_fn)
         with_chaos = self.chaos is not None  # program depends on the BOOL
         # same sharing rationale as _engine_programs; the builders are keyed
         # by the already-cached phase callables, so identity works — except
         # with an attack poison_fn (arbitrary callable, not cache-keyable)
-        key = ("fused",) + args[:-1] + (with_chaos,)
+        key = ("fused",) + args[:-1] + (with_chaos, divergence_fn)
         if self.poison_fn is None and key in _PROGRAM_CACHE:
             self._fused_round, self._fused_scan = _PROGRAM_CACHE[key]
             return
-        self._fused_round = make_fused_round(*args, chaos=with_chaos)
-        self._fused_scan = make_fused_rounds_scan(*args, chaos=with_chaos)
+        self._fused_round = make_fused_round(*args, chaos=with_chaos,
+                                             divergence_fn=divergence_fn)
+        self._fused_scan = make_fused_rounds_scan(
+            *args, chaos=with_chaos, divergence_fn=divergence_fn)
         if self.poison_fn is None:
             _cache_put(key, (self._fused_round, self._fused_scan))
+
+    def _data_mesh(self):
+        """The mesh the client axis is currently sharded over: the explicit
+        constructor mesh when given, else the one recovered from the data's
+        sharding (callers may swap in sharded arrays post-construction)."""
+        if self.mesh is not None:
+            return self.mesh
+        sharding = getattr(self.data.train_xb, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        if mesh is not None and getattr(mesh, "empty", False):
+            return None
+        return mesh
+
+    @property
+    def agg_backend(self) -> str:
+        """Effective aggregation backend, evaluated at USE time (the same
+        pattern — and for the same post-construction-resharding reason —
+        as `compact` below): the explicit collectives are written against a
+        mesh, so off-mesh every backend degenerates to 'einsum'."""
+        backend = self.cfg.aggregation_backend
+        if backend == "einsum":
+            return "einsum"
+        if backend not in ("shard_map", "quantized"):
+            raise ValueError(f"unknown aggregation_backend {backend!r} "
+                             "(einsum | shard_map | quantized)")
+        if not _client_axis_is_sharded(self.data.train_xb):
+            if not self._warned_backend_off:
+                self._warned_backend_off = True
+                logger.debug("aggregation_backend=%s inert: client axis is "
+                             "not sharded across devices; using the dense "
+                             "einsum reduction", backend)
+            return "einsum"
+        return backend
+
+    def _aggregate_for(self, backend: str):
+        """The aggregation callable for an effective backend (explicit
+        collectives built lazily per mesh and cached — the mesh can only
+        appear after a post-construction data swap)."""
+        if backend == "einsum":
+            return self.aggregate
+        from fedmse_tpu.federation.aggregation import make_aggregate_for
+        mesh = self._data_mesh()
+        axis = self.cfg.client_axis_name
+        key = (backend, self.model, self.update_type, mesh, axis,
+               self.cfg.quant_hosts, self.cfg.quant_block_size)
+        fn = _PROGRAM_CACHE.get(key)
+        if fn is None:
+            fn = make_aggregate_for(
+                self.model, self.update_type, backend, mesh, axis,
+                quant_hosts=self.cfg.quant_hosts,
+                quant_block_size=self.cfg.quant_block_size)
+            _cache_put(key, fn)
+        return fn
+
+    def _divergence_for(self, backend: str):
+        """Divergence reduction matching the backend: None (the dense
+        default inside the round body) for einsum; the explicit shard_map +
+        psum reduction for the mesh backends. Only the chaos program
+        evaluates it."""
+        if backend == "einsum" or self.chaos is None:
+            return None
+        from fedmse_tpu.parallel.collectives import make_shardmap_divergence
+        mesh = self._data_mesh()
+        key = ("shardmap_divergence", mesh, self.cfg.client_axis_name)
+        fn = _PROGRAM_CACHE.get(key)
+        if fn is None:
+            fn = make_shardmap_divergence(mesh, self.cfg.client_axis_name)
+            _cache_put(key, fn)
+        return fn
 
     @property
     def compact(self) -> bool:
@@ -331,15 +415,20 @@ class RoundEngine:
         global client index) cross shards when the client axis is split
         over devices — exactly the cross-device traffic the dense path
         avoids (ADVICE r3) — so fall back to dense there; compact stays
-        the default off-mesh."""
-        if not self.cfg.compact_cohort:
+        the default off-mesh. The fallback log is INFO only when the config
+        explicitly requested compact mode (compact_cohort=True); the None
+        default means auto, where the fallback is expected behavior and
+        logs at DEBUG."""
+        requested = self.cfg.compact_cohort
+        if requested is False:
             return False
         if _client_axis_is_sharded(self.data.train_xb):
             if not self._warned_compact_off:
                 self._warned_compact_off = True
-                logger.info("compact_cohort disabled: client axis is "
-                            "sharded across devices; dense masked training "
-                            "avoids cross-shard gathers")
+                log = logger.info if requested else logger.debug
+                log("compact_cohort disabled: client axis is "
+                    "sharded across devices; dense masked training "
+                    "avoids cross-shard gathers")
             return False
         return True
 
@@ -384,7 +473,9 @@ class RoundEngine:
                                    data_seed=self.rngs.data_seed,
                                    run_seed_stride=self.rngs.run_seed_stride)
         self.states = init_client_states(self.model, self.tx,
-                                         self.rngs.next_jax(), self.n_pad)
+                                         self.rngs.next_jax(), self.n_pad,
+                                         mesh=self.mesh,
+                                         axis_name=self.cfg.client_axis_name)
         self.host = HostState.create(self.n_real)
         if self.chaos is not None:
             self._chaos_key = self.rngs.chaos_key()
@@ -419,8 +510,11 @@ class RoundEngine:
         """ONE dispatch for one round. `selected`/`key` override the host
         streams — used by the driver to REPLAY a scanned chunk's prefix with
         the exact same selections and PRNG keys (main.py:run_combination)."""
-        if self._fused_round is None or self._fused_compact != self.compact:
+        if self._fused_round is None or self._fused_compact != self.compact \
+                or self._fused_backend != self.agg_backend:
             self._build_fused()  # rebuild when a data swap flipped compact
+            # or the effective aggregation backend (both are USE-time
+            # properties of the current data sharding)
         if selected is None:
             selected = self.select_clients()
         if key is None:
@@ -456,8 +550,10 @@ class RoundEngine:
 
         Selections and keys are drawn from the same host streams, in the
         same order, as n_rounds successive `run_round_fused` calls."""
-        if self._fused_scan is None or self._fused_compact != self.compact:
+        if self._fused_scan is None or self._fused_compact != self.compact \
+                or self._fused_backend != self.agg_backend:
             self._build_fused()  # rebuild when a data swap flipped compact
+            # or the effective aggregation backend
         snap = (jax.tree.map(jnp.copy, self.states) if snapshot else None)
         schedule = [self.select_clients() for _ in range(n_rounds)]
         # one dispatch for all R round keys (vs R fold_in round-trips; the
@@ -557,9 +653,10 @@ class RoundEngine:
         if aggregator is not None and \
                 self.host.aggregation_count[aggregator] < cfg.max_aggregation_threshold:
             with self.timer.phase("aggregate"):
-                agg_params, weights = self.aggregate(self.states.params,
-                                                     sel_mask, data.dev_x,
-                                                     sel_idx=sel_idx)
+                agg_fn = self._aggregate_for(self.agg_backend)
+                agg_params, weights = agg_fn(self.states.params,
+                                             sel_mask, data.dev_x,
+                                             sel_idx=sel_idx)
                 if self.poison_fn is not None:  # attack simulation
                     agg_params = self.poison_fn(
                         agg_params, jnp.asarray(round_index, jnp.int32),

@@ -74,25 +74,70 @@ class HostState:
         )
 
 
+def client_states_sharding(states_shape, mesh, axis_name: str = "clients"):
+    """The mesh layout of the federation's client state, derived from a
+    ClientStates shape tree: EVERY leaf — params, the f32 Adam moments in
+    opt_state, prev_global, verifier history, counters — is
+    `P('clients', ...)` on its leading axis. This function (with
+    `shard_client_states` / the `mesh=` path of `init_client_states`) is
+    the single place the Adam-moment layout is mesh-aware (ROADMAP item 2):
+    at 10k+ clients the optimizer tree dominates memory, and per-client f32
+    moments must live only on the shard that trains that client."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def spec(leaf):
+        ndim = len(leaf.shape)
+        return NamedSharding(mesh, P(axis_name, *([None] * (ndim - 1))))
+
+    return jax.tree.map(spec, states_shape)
+
+
+def shard_client_states(states: "ClientStates", mesh,
+                        axis_name: str = "clients") -> "ClientStates":
+    """Place already-materialized (host or single-device) client states onto
+    the mesh with the canonical layout above. Callers that can, should
+    prefer `init_client_states(mesh=...)`, which never materializes the
+    unsharded tree at all."""
+    from fedmse_tpu.parallel.mesh import shard_clients
+
+    return jax.tree.map(
+        lambda leaf: shard_clients(leaf, mesh, axis_name), states,
+        is_leaf=lambda x: x is None)
+
+
 def init_client_states(model, tx: optax.GradientTransformation,
-                       rng: jax.Array, n_clients: int) -> ClientStates:
-    """Initialize N independent clients (analog of src/main.py:225-257)."""
+                       rng: jax.Array, n_clients: int,
+                       mesh=None, axis_name: str = "clients") -> ClientStates:
+    """Initialize N independent clients (analog of src/main.py:225-257).
+
+    With `mesh`, the whole state tree is BORN sharded: the init runs as one
+    jitted program with `out_shardings` from `client_states_sharding`, so
+    each process/device materializes only its own clients' params and Adam
+    moments — no host-side full tree, no post-hoc re-placement. The draws
+    are identical to the unsharded init (same keys, same order), so the
+    global value is bitwise the same."""
     from fedmse_tpu.models.autoencoder import init_stacked_params
 
-    params = init_stacked_params(model, rng, n_clients)
-    opt_state = jax.vmap(tx.init)(params)
-    zeros_like_params = jax.tree.map(jnp.zeros_like, params)
-    return ClientStates(
-        params=params,
-        opt_state=opt_state,
-        # previous_global_model starts as a copy of the init model
-        # (client_trainer.py:63)
-        prev_global=jax.tree.map(lambda t: t.copy(), params),
-        hist_params=zeros_like_params,
-        hist_perf=jnp.zeros((n_clients,), dtype=jnp.float32),
-        hist_seen=jnp.zeros((n_clients,), dtype=bool),
-        rejected=jnp.zeros((n_clients,), dtype=jnp.int32),
-    )
+    def build() -> ClientStates:
+        params = init_stacked_params(model, rng, n_clients)
+        opt_state = jax.vmap(tx.init)(params)
+        zeros_like_params = jax.tree.map(jnp.zeros_like, params)
+        return ClientStates(
+            params=params,
+            opt_state=opt_state,
+            # previous_global_model starts as a copy of the init model
+            # (client_trainer.py:63)
+            prev_global=jax.tree.map(lambda t: t.copy(), params),
+            hist_params=zeros_like_params,
+            hist_perf=jnp.zeros((n_clients,), dtype=jnp.float32),
+            hist_seen=jnp.zeros((n_clients,), dtype=bool),
+            rejected=jnp.zeros((n_clients,), dtype=jnp.int32),
+        )
+
+    if mesh is None:
+        return build()
+    shardings = client_states_sharding(jax.eval_shape(build), mesh, axis_name)
+    return jax.jit(build, out_shardings=shardings)()
 
 
 def init_batched_client_states(model, tx: optax.GradientTransformation,
@@ -135,6 +180,33 @@ def tree_select_clients(accept: jax.Array, a, b):
     return jax.tree.map(sel, a, b)
 
 
+def client_mean_weights(client_mask: jax.Array,
+                        total: jax.Array) -> jax.Array:
+    """Normalized mean weights with the empty-mask clamp — ONE home for the
+    divergence observable's weighting, shared by the dense reduction below
+    and the shard_map one (parallel/collectives.py), so the clamp cannot
+    silently desynchronize between them. `total` is sum(client_mask),
+    however the caller reduces it (local sum, or psum over the mesh)."""
+    return client_mask / jnp.maximum(total, 1.0)
+
+
+def divergence_from_weighted_mean(params: Any, w: jax.Array,
+                                  mean_reduce) -> jax.Array:
+    """Per-client L2 distance [N] of each stacked-params row from the
+    w-weighted mean model, with `mean_reduce(w, leaf)` supplying the
+    mean-model reduction (dense einsum, or partial-einsum + psum on a
+    mesh). f32 accumulation whatever the leaf dtype (ops/precision.py):
+    the mean-model reduction and the squared-distance sum are score math —
+    the shared core of the two divergence observables."""
+    sq = None
+    for leaf in jax.tree.leaves(params):
+        mean = mean_reduce(w, leaf)
+        d = (leaf - mean).reshape(leaf.shape[0], -1)
+        s = jnp.sum(d * d, axis=1, dtype=jnp.float32)
+        sq = s if sq is None else sq + s
+    return jnp.sqrt(sq)
+
+
 def tree_client_divergence(params: Any, client_mask: jax.Array) -> jax.Array:
     """Per-client parameter divergence [N]: the L2 distance of each client's
     stacked params from the client_mask-weighted mean model.
@@ -144,14 +216,8 @@ def tree_client_divergence(params: Any, client_mask: jax.Array) -> jax.Array:
     on stale models, and this spread is the drift the verifier has to absorb
     on the next accepted round. Padded clients carry zero weight in the mean
     but still report a distance (the caller slices to n_real)."""
-    w = client_mask / jnp.maximum(jnp.sum(client_mask), 1.0)
-    sq = None
-    for leaf in jax.tree.leaves(params):
-        # f32 accumulation whatever the leaf dtype (ops/precision.py): the
-        # mean-model reduction and the squared-distance sum are score math
-        mean = jnp.einsum("n,n...->...", w, leaf,
-                          preferred_element_type=jnp.float32)
-        d = (leaf - mean).reshape(leaf.shape[0], -1)
-        s = jnp.sum(d * d, axis=1, dtype=jnp.float32)
-        sq = s if sq is None else sq + s
-    return jnp.sqrt(sq)
+    w = client_mean_weights(client_mask, jnp.sum(client_mask))
+    return divergence_from_weighted_mean(
+        params, w,
+        lambda w, leaf: jnp.einsum("n,n...->...", w, leaf,
+                                   preferred_element_type=jnp.float32))

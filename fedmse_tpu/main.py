@@ -39,6 +39,7 @@ from fedmse_tpu.checkpointing import (CheckpointManager, ResultsWriter,
                                       save_client_models,
                                       save_training_tracking)
 from fedmse_tpu.data import build_dev_dataset, prepare_clients, stack_clients
+from fedmse_tpu.data.stacking import pad_federated_data
 from fedmse_tpu.federation import RoundEngine
 from fedmse_tpu.federation.rounds import split_metric_columns
 from fedmse_tpu.models import make_model
@@ -143,11 +144,24 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     if attack is not None:
         from fedmse_tpu.federation.attack import make_poison_fn
         poison_fn = make_poison_fn(attack)
+    if mesh is not None and data.num_clients_padded % mesh.devices.size != 0:
+        # auto-pad instead of erroring in shard_federation: zero-mask pad
+        # clients are excluded from selection/aggregation/evaluation, so
+        # padding is free correctness-wise (data/stacking.py)
+        n_new = pad_to_multiple(data.num_clients_padded, mesh.devices.size)
+        logger.info(
+            "padding client axis %d -> %d (+%d zero-weight pad clients) to "
+            "tile the %d-device mesh", data.num_clients_padded, n_new,
+            n_new - data.num_clients_padded, mesh.devices.size)
+        data = pad_federated_data(data, n_new)
     engine = RoundEngine(model, cfg, data, n_real=n_real, rngs=rngs,
                          model_type=model_type, update_type=update_type,
                          fused=cfg.fused_rounds, poison_fn=poison_fn,
-                         chaos=chaos)
+                         chaos=chaos, mesh=mesh)
     if mesh is not None:
+        # states were born sharded (state.init_client_states out_shardings);
+        # shard_federation re-places them with the same canonical layout
+        # (a no-op) and shards the data
         engine.data, engine.states = shard_federation(data, engine.states, mesh)
         engine._ver_x, engine._ver_m = engine._verification_tensors()
 

@@ -3,11 +3,18 @@ from fedmse_tpu.parallel.mesh import (
     host_fetch,
     host_fetch_async,
     pad_to_multiple,
+    process_client_rows,
     replicate,
     shard_clients,
+    shard_clients_local,
     shard_federation,
 )
-from fedmse_tpu.parallel.collectives import make_shardmap_aggregate
+from fedmse_tpu.parallel.collectives import (
+    host_groups,
+    make_hierarchical_aggregate,
+    make_shardmap_aggregate,
+    make_shardmap_divergence,
+)
 from fedmse_tpu.parallel.multihost import initialize as initialize_multihost
 from fedmse_tpu.parallel.multihost import uniform_decision
 
@@ -15,11 +22,16 @@ __all__ = [
     "client_mesh",
     "host_fetch",
     "host_fetch_async",
+    "host_groups",
     "initialize_multihost",
     "uniform_decision",
+    "make_hierarchical_aggregate",
     "make_shardmap_aggregate",
+    "make_shardmap_divergence",
     "pad_to_multiple",
+    "process_client_rows",
     "replicate",
     "shard_clients",
+    "shard_clients_local",
     "shard_federation",
 ]

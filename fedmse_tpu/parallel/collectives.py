@@ -1,13 +1,35 @@
-"""Explicit-collective aggregation via shard_map (the ICI-visible path).
+"""Explicit-collective aggregation via shard_map (the ICI/DCN-visible path).
 
 `federation.aggregation.make_aggregate_fn` relies on jit auto-partitioning to
 lower the weighted tree-reduction to collectives. This module provides the
 same aggregation with the communication written out explicitly in per-device
-code: each device computes the weighted partial sum of ITS client shard, then
-a single `jax.lax.psum` over the 'clients' mesh axis produces the replicated
-aggregated model — one all-reduce over ICI per round, which is the entire
-communication volume of a federated round (the reference's equivalent is N
-python-object state_dict copies, client_trainer.py:305-315).
+code, in two flavors:
+
+  * `make_shardmap_aggregate` — each device computes the weighted partial
+    sum of ITS client shard in f32, then a single `jax.lax.psum` over the
+    'clients' mesh axis produces the replicated aggregated model — one
+    all-reduce over ICI per round, which is the entire communication volume
+    of a federated round (the reference's equivalent is N python-object
+    state_dict copies, client_trainer.py:305-315). Pinned BIT-IDENTICAL to
+    the einsum path on the same sharded mesh (XLA lowers the auto-partitioned
+    einsum to exactly this partial-sum + all-reduce;
+    tests/test_shard_native.py) — it is the exact-f32 escape hatch for the
+    quantized hierarchy below.
+
+  * `make_hierarchical_aggregate` — the EQuARX-style two-level merge
+    (PAPERS.md, arxiv 2506.17615; DESIGN.md §12): the per-device partial
+    sums first all-reduce in exact f32 WITHIN each host group (the ICI
+    stage), then the per-host partials cross the host boundary (the DCN
+    stage) as blockwise-int8 payloads with per-block f32 scales
+    (parallel/quantize.py), dequantized and accumulated in f32 on every
+    device. Wire bytes of the cross-host stage drop ~4x; the error is
+    bounded by Σ_hosts max|partial|_block/254 per element and the intra-host
+    math is untouched. With one host group the DCN stage vanishes and the
+    function degenerates to `make_shardmap_aggregate` exactly.
+
+`make_shardmap_divergence` is the same treatment for the chaos axis's
+per-client divergence reduction (federation/state.py::tree_client_divergence)
+— the mean-model reduction runs as explicit partial sums + psum.
 
 Useful both as documentation of the communication pattern and as a fallback
 when auto-partitioning chooses a worse layout.
@@ -16,7 +38,7 @@ when auto-partitioning chooses a worse layout.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +46,40 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 from fedmse_tpu.ops.losses import mse_loss
+from fedmse_tpu.parallel.quantize import dequantize_sum, quantize_blockwise
+
+
+def _raw_weights(model, update_type: str, axis_name: str):
+    """Per-device unnormalized weight computation shared by both explicit
+    backends (semantics of federation.aggregation.make_aggregate_fn:
+    fed_avg / fedprox = masked mean, fed_mse_avg = 1/MSE(dev) — reference
+    client_trainer.py:107-134). Each device scores its OWN client shard
+    (already embarrassingly parallel); the normalizer is one scalar psum."""
+
+    def dev_mse(params, dev_x):
+        _, recon = model.apply({"params": params}, dev_x)
+        return mse_loss(dev_x, recon)
+
+    def weights(params_shard, sel_shard, dev_x):
+        if update_type == "mse_avg":
+            mses = jax.vmap(dev_mse, in_axes=(0, None))(params_shard, dev_x)
+            raw = sel_shard / mses
+        else:
+            raw = sel_shard
+        total = jax.lax.psum(jnp.sum(raw), axis_name)
+        return raw / total
+
+    return weights
+
+
+def _partial_merge(params_shard, w):
+    """f32 weighted partial sum of the local client shard — the PR 5
+    accumulation contract (weights stay f32, `preferred_element_type`
+    pins the einsum accumulator; see aggregation.weighted_tree_mean)."""
+    return jax.tree.map(
+        lambda t: jnp.einsum("n,n...->...", w, t,
+                             preferred_element_type=jnp.float32),
+        params_shard)
 
 
 def make_shardmap_aggregate(model, update_type: str, mesh: Mesh,
@@ -31,34 +87,22 @@ def make_shardmap_aggregate(model, update_type: str, mesh: Mesh,
     """Build fn(stacked_params, sel_mask, dev_x, sel_idx=None) ->
     (agg_params, weights[N]).
 
-    Semantics identical to federation.aggregation.make_aggregate_fn (fed_avg /
-    fedprox = masked mean, fed_mse_avg = 1/MSE(dev) weights — reference
-    client_trainer.py:107-134); execution is explicit SPMD. `sel_idx` is
-    accepted for drop-in signature parity with make_aggregate_fn but
-    ignored: this form scores each shard's clients locally (already
-    embarrassingly parallel), whereas a compact gather by global indices
-    would cross shards and turn zero-communication scoring into an
-    all-to-all. Weights are identical either way.
+    Semantics identical to federation.aggregation.make_aggregate_fn;
+    execution is explicit SPMD and — on the same sharded mesh — the merge is
+    bit-identical to the einsum path (tests/test_shard_native.py pins it).
+    `sel_idx` is accepted for drop-in signature parity with
+    make_aggregate_fn but ignored: this form scores each shard's clients
+    locally (already embarrassingly parallel), whereas a compact gather by
+    global indices would cross shards and turn zero-communication scoring
+    into an all-to-all. Weights are identical either way.
     """
-
-    def dev_mse(params, dev_x):
-        _, recon = model.apply({"params": params}, dev_x)
-        return mse_loss(dev_x, recon)
+    weights_fn = _raw_weights(model, update_type, axis_name)
 
     def per_device(params_shard, sel_shard, dev_x):
-        # local weights for this device's clients
-        if update_type == "mse_avg":
-            mses = jax.vmap(dev_mse, in_axes=(0, None))(params_shard, dev_x)
-            raw = sel_shard / mses
-        else:
-            raw = sel_shard
-        total = jax.lax.psum(jnp.sum(raw), axis_name)
-        w = raw / total
+        w = weights_fn(params_shard, sel_shard, dev_x)
         # weighted partial sum of the local shard, then one all-reduce
-        partial_sum = jax.tree.map(
-            lambda t: jnp.einsum("n,n...->...", w.astype(t.dtype), t),
-            params_shard)
-        agg = jax.lax.psum(partial_sum, axis_name)
+        agg = jax.lax.psum(_partial_merge(params_shard, w), axis_name)
+        agg = jax.tree.map(lambda t, a: a.astype(t.dtype), params_shard, agg)
         return agg, w
 
     spec_clients = P(axis_name)
@@ -78,3 +122,132 @@ def make_shardmap_aggregate(model, update_type: str, mesh: Mesh,
         return fn(stacked_params, sel_mask, dev_x)
 
     return aggregate
+
+
+def host_groups(mesh: Mesh, num_groups: int = 0) -> List[List[int]]:
+    """Partition the 1-D mesh's device indices into host groups.
+
+    `num_groups` 0 = the REAL process topology (one group per process —
+    the DCN stage engages only where traffic actually crosses hosts);
+    > 0 = that many contiguous equal groups (virtual-mesh testing: groups
+    play hosts, so the int8 DCN stage is exercised on one host). Groups
+    must tile the mesh evenly."""
+    devices = list(mesh.devices.flat)
+    n = len(devices)
+    if num_groups <= 0:
+        by_process: dict = {}
+        for i, d in enumerate(devices):
+            by_process.setdefault(d.process_index, []).append(i)
+        groups = [sorted(v) for _, v in sorted(by_process.items())]
+    else:
+        if n % num_groups != 0:
+            raise ValueError(
+                f"num_groups {num_groups} must divide the mesh size {n}")
+        per = n // num_groups
+        groups = [list(range(g * per, (g + 1) * per))
+                  for g in range(num_groups)]
+    sizes = {len(g) for g in groups}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"host groups must be equal-sized, got sizes {sorted(sizes)} "
+            f"(mesh devices are unevenly spread across processes)")
+    return groups
+
+
+def make_hierarchical_aggregate(model, update_type: str, mesh: Mesh,
+                                axis_name: str = "clients",
+                                num_groups: int = 0,
+                                block_size: int = 256) -> Callable:
+    """The two-level quantized merge: intra-group exact-f32 psum (ICI),
+    inter-group blockwise-int8 exchange (DCN), dequantize-then-accumulate
+    in f32. Same signature/semantics as `make_shardmap_aggregate`; weights
+    are computed identically (exact f32 scalar psum — only the BULK param
+    payload is quantized, and only on the cross-host wire).
+
+    With one group (single-process real topology) there is no cross-host
+    wire and the program is exactly `make_shardmap_aggregate`'s — the
+    quantizer never runs. See DESIGN.md §12 for when the hierarchy engages
+    and the error-bound derivation."""
+    intra = host_groups(mesh, num_groups)
+    n_groups = len(intra)
+    per = len(intra[0])
+    # lane l of every group exchanges with lane l of every other group:
+    # the gather that carries the int8 payloads across the host boundary
+    inter = [[g[lane] for g in intra] for lane in range(per)]
+    weights_fn = _raw_weights(model, update_type, axis_name)
+
+    def quantized_allreduce(leaf):
+        """f32 per-host partial -> f32 global sum via int8 DCN exchange."""
+        q, scales = quantize_blockwise(leaf, block_size)
+        q_stack = jax.lax.all_gather(q, axis_name, axis_index_groups=inter)
+        s_stack = jax.lax.all_gather(scales, axis_name,
+                                     axis_index_groups=inter)
+        return dequantize_sum(q_stack, s_stack, leaf.shape)
+
+    def per_device(params_shard, sel_shard, dev_x):
+        w = weights_fn(params_shard, sel_shard, dev_x)
+        part = _partial_merge(params_shard, w)
+        # level 1 — ICI: exact f32 all-reduce within each host group
+        host_sum = jax.lax.psum(part, axis_name, axis_index_groups=intra)
+        # level 2 — DCN: int8 payloads cross the host boundary
+        if n_groups > 1:
+            agg = jax.tree.map(quantized_allreduce, host_sum)
+        else:
+            agg = host_sum
+        agg = jax.tree.map(lambda t, a: a.astype(t.dtype), params_shard, agg)
+        return agg, w
+
+    spec_clients = P(axis_name)
+
+    def in_specs_for(tree):
+        return jax.tree.map(lambda _: P(axis_name), tree)
+
+    @jax.jit
+    def aggregate(stacked_params, sel_mask, dev_x,
+                  sel_idx=None) -> Tuple[Any, jax.Array]:
+        del sel_idx  # per-shard scoring is already local (see above)
+        fn = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(in_specs_for(stacked_params), spec_clients, P()),
+            out_specs=(jax.tree.map(lambda _: P(), stacked_params),
+                       spec_clients),
+            # grouped collectives (axis_index_groups) produce values the
+            # static replication checker cannot certify; correctness is
+            # pinned against the dense merge in tests/test_shard_native.py
+            check_rep=False,
+        )
+        return fn(stacked_params, sel_mask, dev_x)
+
+    return aggregate
+
+
+def make_shardmap_divergence(mesh: Mesh, axis_name: str = "clients"
+                             ) -> Callable:
+    """Explicit-collective twin of state.tree_client_divergence:
+    fn(params, client_mask) -> [N] per-client L2 distance to the
+    client_mask-weighted mean model. The mean-model reduction runs as
+    per-device f32 partial sums + one psum; the per-client distances are
+    local to each shard (zero extra communication)."""
+
+    def mean_reduce(w, leaf):
+        part = jnp.einsum("n,n...->...", w, leaf,
+                          preferred_element_type=jnp.float32)
+        return jax.lax.psum(part, axis_name)
+
+    def per_device(params_shard, mask_shard):
+        from fedmse_tpu.federation.state import (client_mean_weights,
+                                                 divergence_from_weighted_mean)
+        total = jax.lax.psum(jnp.sum(mask_shard), axis_name)
+        w = client_mean_weights(mask_shard, total)
+        return divergence_from_weighted_mean(params_shard, w, mean_reduce)
+
+    def divergence(params, client_mask):
+        fn = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P(axis_name), params),
+                      P(axis_name)),
+            out_specs=P(axis_name),
+        )
+        return fn(params, client_mask)
+
+    return divergence

@@ -22,7 +22,7 @@ collectives stay correct (see data/stacking.py).
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -53,12 +53,73 @@ def _place(leaf, sharding: NamedSharding):
     (identical, fully-loaded-everywhere) host array. Passing global_shape ==
     the host array's shape tells JAX the local data IS the full target array
     (each process donates the rows its devices own) — without it the global
-    client axis would be inflated process_count-fold."""
+    client axis would be inflated process_count-fold.
+
+    This is the FULLY-REPLICATED host path: every process pays host RAM and
+    H2D bytes for the whole client axis. `shard_clients_local` below is the
+    host-local alternative (each process stacks and donates only its own
+    rows — data/stacking.py client_range)."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        # already a pod-global array (e.g. states born sharded by
+        # state.init_client_states out_shardings): it cannot be pulled to
+        # host, and with the target sharding it needs no re-placement
+        if leaf.sharding.is_equivalent_to(sharding, leaf.ndim):
+            return leaf
+        raise ValueError(
+            f"cannot re-place a non-addressable global array from "
+            f"{leaf.sharding} to {sharding}; reshard inside jit instead")
     if jax.process_count() == 1:
         return jax.device_put(jnp.asarray(leaf), sharding)
     leaf = np.asarray(leaf)
     return jax.make_array_from_process_local_data(sharding, leaf,
                                                   global_shape=leaf.shape)
+
+
+def process_client_rows(n_pad: int, mesh: Mesh) -> Tuple[int, int]:
+    """[start, stop) of the global client axis owned by THIS process's
+    devices on the 1-D mesh — the slice a host-local stack materializes
+    (data/stacking.py stack_clients(client_range=...)). The 1-D mesh lays
+    clients out contiguously per device in device order, so a process's
+    rows are contiguous as long as its devices are (the standard pod
+    topology; validated here because a gap would silently interleave
+    hosts' data)."""
+    devices = list(mesh.devices.flat)
+    if n_pad % len(devices) != 0:
+        raise ValueError(f"padded client count {n_pad} must be a multiple "
+                         f"of the mesh size {len(devices)}")
+    per = n_pad // len(devices)
+    mine = [i for i, d in enumerate(devices)
+            if d.process_index == jax.process_index()]
+    if not mine:
+        return 0, 0
+    if mine != list(range(mine[0], mine[-1] + 1)):
+        raise ValueError(
+            f"this process's devices are not contiguous on the mesh "
+            f"({mine}); host-local stacking needs a contiguous slice")
+    return mine[0] * per, (mine[-1] + 1) * per
+
+
+def shard_clients_local(tree: Any, mesh: Mesh, global_clients: int,
+                        axis_name: str = "clients") -> Any:
+    """Place a HOST-LOCAL stacked pytree (leading axis = only this process's
+    client rows) as a global array sharded over the (possibly multi-host)
+    mesh with global client axis `global_clients`.
+
+    The host-RAM/H2D win of the shard-native client axis (DESIGN.md §12):
+    `_place` ships the full axis from every process; here each process
+    donates exactly the 1/process_count slice its devices own — local
+    leaf rows must equal `process_client_rows(global_clients, mesh)`.
+    Single-process this degenerates to the full axis and produces the
+    identical sharded array."""
+
+    def place(leaf):
+        leaf = np.asarray(leaf)
+        spec = P(axis_name, *([None] * (leaf.ndim - 1)))
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), leaf,
+            global_shape=(global_clients,) + leaf.shape[1:])
+
+    return jax.tree.map(place, tree)
 
 
 def shard_clients(tree: Any, mesh: Mesh, axis_name: str = "clients") -> Any:
@@ -129,31 +190,46 @@ def host_fetch_async(tree: Any):
     return lambda: host_fetch(tree)
 
 
-def shard_federation(data, states, mesh: Mesh, axis_name: str = "clients"):
+def shard_federation(data, states, mesh: Mesh, axis_name: str = "clients",
+                     host_local: bool = False,
+                     global_clients: Optional[int] = None):
     """Shard a FederatedData + ClientStates pair onto the mesh.
 
     Per-client leaves (leading axis = padded client count) go
     `P('clients')`; the shared dev set is replicated. jit then propagates
     these shardings through the whole round computation.
+
+    `host_local=True` marks `data` as a host-local stack (its leading axis
+    holds only THIS process's client rows — data/stacking.py
+    stack_clients(client_range=...)); `global_clients` is then the global
+    padded client-axis length (defaults to the local length, which is only
+    correct single-process). Each process donates its slice instead of the
+    full axis (`shard_clients_local`). States are sharded by
+    `federation.state.shard_client_states` — the single home of the
+    mesh-aware client-state (Adam-moment) layout.
     """
     import dataclasses
 
     from fedmse_tpu.data.stacking import FederatedData
+    from fedmse_tpu.federation.state import shard_client_states
 
-    n = data.num_clients_padded
+    n = global_clients if host_local and global_clients is not None \
+        else data.num_clients_padded
     if n % mesh.devices.size != 0:
         raise ValueError(
             f"padded client count {n} must be a multiple of the mesh size "
             f"{mesh.devices.size}; stack with pad_clients_to="
             f"pad_to_multiple(n_real, mesh_size)")
 
+    place_clients = (
+        (lambda leaf: shard_clients_local(leaf, mesh, n, axis_name))
+        if host_local
+        else (lambda leaf: shard_clients(leaf, mesh, axis_name)))
     sharded_data = FederatedData(**{
         f.name: (replicate(getattr(data, f.name), mesh)
                  if f.name == "dev_x"
-                 else shard_clients(getattr(data, f.name), mesh, axis_name))
+                 else place_clients(getattr(data, f.name)))
         for f in dataclasses.fields(FederatedData)
     })
-    sharded_states = jax.tree.map(
-        lambda leaf: shard_clients(leaf, mesh, axis_name), states,
-        is_leaf=lambda x: x is None)
+    sharded_states = shard_client_states(states, mesh, axis_name)
     return sharded_data, sharded_states

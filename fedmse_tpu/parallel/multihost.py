@@ -75,6 +75,31 @@ def _launcher_hinted() -> bool:
     return False
 
 
+def _enable_cpu_collectives() -> None:
+    """Give a CPU-platform multi-controller run a working cross-process
+    collective backend (gloo over TCP).
+
+    Without it every computation spanning processes dies with
+    "Multiprocess computations aren't implemented on the CPU backend" —
+    the failure mode of the 2-process mesh tests before this hook
+    (tests/test_parallel.py). Must run BEFORE the backend initializes
+    (the collectives implementation is read at CPU client creation);
+    called only on explicitly-configured multi-process launches, so
+    single-process runs never construct a gloo client. No-op on TPU
+    platforms and on jax builds without the knob."""
+    platforms = (jax.config.jax_platforms
+                 or os.environ.get("JAX_PLATFORMS", ""))
+    if "cpu" not in str(platforms):
+        return  # TPU/GPU pods bring their own collective fabric
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        logger.info("multihost: CPU platform — gloo cross-process "
+                    "collectives enabled")
+    except Exception as e:  # older jax: keep the old (degraded) behavior
+        logger.warning("multihost: could not enable CPU gloo collectives "
+                       "(%s); cross-process CPU computations will fail", e)
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None) -> None:
@@ -91,6 +116,8 @@ def initialize(coordinator_address: Optional[str] = None,
         return
     explicit = (coordinator_address is not None or num_processes is not None
                 or _launcher_configured())
+    if explicit:
+        _enable_cpu_collectives()
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,

@@ -1,0 +1,98 @@
+"""Blockwise int8 quantization for the inter-host (DCN) merge exchange.
+
+EQuARX (PAPERS.md, arxiv 2506.17615) shows that the all-reduce carrying a
+model merge can move int8 payloads instead of f32 at negligible quality
+cost, provided the *math* stays f32: quantize only the bytes on the wire,
+dequantize before accumulating. This module is the codec half of that
+design — `parallel/collectives.py::make_hierarchical_aggregate` is the
+collective that uses it for the cross-host stage of the two-level merge.
+
+Scheme (symmetric, per-block scales):
+
+  * the leaf is flattened and split into blocks of `block_size` elements;
+  * each block b gets one f32 scale s_b = max|x_b| / 127 (an all-zero
+    block gets s_b = 1 so the 0/0 never happens; its payload is all-zero
+    int8 either way);
+  * payload q = round(x / s_b) clipped to [-127, 127] as int8 — 4.06x
+    fewer wire bytes than f32 (int8 payload + one f32 scale per block);
+  * dequantize = q * s_b in f32, so downstream accumulation obeys the
+    PR 5 f32-math-then-round contract (ops/precision.py): the rounding
+    happened ONCE at the wire, not per accumulation step.
+
+Error bound (DESIGN.md §12 derives the composition): rounding to the
+nearest int8 step gives |x - q·s_b| ≤ s_b/2 = max|x_b|/254 per element
+per quantized transfer. A hierarchical merge that quantizes H host
+partial-sums therefore accumulates at most Σ_h max|x_b^(h)|/254 absolute
+error per element — linear in the host count, never in the client count
+(the intra-host stage is exact f32).
+
+All functions are pure jnp and trace cleanly inside shard_map/jit; the
+(q, scale) pair is what actually crosses the DCN link.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+INT8_MAX = 127.0
+# one quantization step is scale = amax/127; worst-case rounding error is
+# half a step: amax / 254 per element
+ERROR_DENOM = 2.0 * INT8_MAX
+
+
+def quantize_blockwise(x: jax.Array, block_size: int = 256
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """x (any shape, float) -> (q int8 [n_blocks, block_size],
+    scales f32 [n_blocks]). The flattened tail is zero-padded to a whole
+    block; `dequantize_blockwise` slices it back off."""
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % block_size
+    flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, block_size)
+    amax = jnp.max(jnp.abs(blocks), axis=1)
+    scales = jnp.where(amax > 0, amax / INT8_MAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(blocks / scales[:, None]),
+                 -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scales
+
+
+def dequantize_blockwise(q: jax.Array, scales: jax.Array,
+                         shape: Tuple[int, ...]) -> jax.Array:
+    """(q, scales) -> f32 array of `shape` (the inverse of
+    `quantize_blockwise`, up to the ≤ scale/2 rounding)."""
+    flat = q.astype(jnp.float32) * scales[:, None]
+    size = 1
+    for d in shape:
+        size *= d
+    return flat.reshape(-1)[:size].reshape(shape)
+
+
+def dequantize_sum(q_stack: jax.Array, scale_stack: jax.Array,
+                   shape: Tuple[int, ...]) -> jax.Array:
+    """Accumulate H gathered quantized payloads ([H, n_blocks, block] int8 +
+    [H, n_blocks] f32 scales) into one f32 array of `shape`.
+
+    Dequantize-THEN-accumulate, all in f32: the only rounding is the one
+    each payload already paid at the wire (the PR 5 accumulation contract —
+    an int8 or bf16 accumulator here would quantize the merge itself)."""
+    deq = q_stack.astype(jnp.float32) * scale_stack[..., None]
+    total = jnp.sum(deq, axis=0)  # f32 accumulation over the host axis
+    size = 1
+    for d in shape:
+        size *= d
+    return total.reshape(-1)[:size].reshape(shape)
+
+
+def quantization_error_bound(x, block_size: int = 256) -> float:
+    """Worst-case absolute elementwise error of ONE quantize/dequantize pass
+    over `x` (host-side helper for tests/benches): max_b max|x_b| / 254."""
+    import numpy as np
+
+    flat = np.asarray(x, dtype=np.float32).reshape(-1)
+    pad = (-flat.size) % block_size
+    flat = np.pad(flat, (0, pad))
+    amax = np.abs(flat.reshape(-1, block_size)).max(axis=1)
+    return float(amax.max() / ERROR_DENOM) if amax.size else 0.0

@@ -42,3 +42,22 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """The 8-virtual-device CPU `clients` mesh, session-shared.
+
+    This conftest already forces `--xla_force_host_platform_device_count=8`
+    before any backend initializes (top of file), so sharding tests should
+    take this fixture instead of re-deriving the mesh or hand-rolling a
+    skipif — it skips cleanly on the rare box where the virtual platform
+    could not be realized."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual CPU devices "
+                    "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    from fedmse_tpu.parallel import client_mesh
+
+    return client_mesh(8)

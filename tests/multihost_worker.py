@@ -160,6 +160,77 @@ def run_round(pid: int) -> None:
     print(f"MULTIHOST_OK pid={pid} agg={result.aggregator} "
           f"mean={float(np.nanmean(metrics)):.6f}", flush=True)
 
+    run_hostlocal(pid, cfg, clients, dev_x, mesh, n_real, result)
+
+
+def run_hostlocal(pid: int, cfg, clients, dev_x, mesh, n_real: int,
+                  replicated_result) -> None:
+    """The shard-native data path under a REAL 2-process runtime: each
+    process stacks ONLY the client rows its devices own (half the host
+    bytes), donates them via `make_array_from_process_local_data` local
+    slices, and the federated round must reproduce the fully-replicated
+    placement bit-for-bit. Also pins the hierarchical int8 merge across the
+    REAL process boundary (num_groups=0 -> one group per process, so the
+    quantized payload crosses the actual DCN/gloo link)."""
+    import numpy as np
+    import jax
+
+    from fedmse_tpu.data.stacking import stack_clients, stack_dims
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.parallel import (make_hierarchical_aggregate,
+                                     make_shardmap_aggregate,
+                                     process_client_rows, shard_federation)
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    n_pad = 8
+    dims = stack_dims(clients, cfg.batch_size, pad_clients_to=n_pad)
+    start, stop = process_client_rows(n_pad, mesh)
+    local = stack_clients(clients, dev_x, cfg.batch_size,
+                          client_range=(start, stop), dims=dims)
+    full_rows = n_pad
+    local_rows = stop - start
+    assert local_rows * jax.process_count() == full_rows, (start, stop)
+    local_bytes = sum(l.nbytes for l in jax.tree.leaves(local))
+    gdata, _ = shard_federation(local, None, mesh, host_local=True,
+                                global_clients=n_pad)
+    assert gdata.num_clients_padded == n_pad
+
+    model = make_model("hybrid", cfg.dim_features,
+                       shrink_lambda=cfg.shrink_lambda)
+    engine = RoundEngine(model, cfg, gdata, n_real=n_real,
+                         rngs=ExperimentRngs(run=0), model_type="hybrid",
+                         update_type="mse_avg", fused=True, mesh=mesh)
+    result = engine.run_round(0)
+    # host-local placement must be invisible to the math: identical global
+    # arrays -> identical program -> identical round
+    assert result.aggregator == replicated_result.aggregator
+    np.testing.assert_array_equal(result.client_metrics,
+                                  replicated_result.client_metrics)
+
+    # hierarchical quantized merge across the REAL host boundary: intra-
+    # process psum exact, int8 payloads over the gloo link, vs exact f32
+    exact = make_shardmap_aggregate(model, "avg", mesh)
+    quant = make_hierarchical_aggregate(model, "avg", mesh, num_groups=0)
+    sel = gdata.client_mask
+    agg_e, w_e = exact(engine.states.params, sel, gdata.dev_x)
+    agg_q, w_q = quant(engine.states.params, sel, gdata.dev_x)
+    from fedmse_tpu.parallel.mesh import host_fetch
+    w_err = np.abs(np.asarray(host_fetch(w_e))
+                   - np.asarray(host_fetch(w_q))).max()
+    assert w_err == 0.0, w_err  # weights are never quantized
+    max_err = max(
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(jax.tree.leaves(host_fetch(agg_e)),
+                        jax.tree.leaves(host_fetch(agg_q))))
+    scale = max(float(np.abs(np.asarray(a)).max())
+                for a in jax.tree.leaves(host_fetch(agg_e)))
+    # 2 hosts x blockmax/254 per element; blockmax <= global leaf max
+    assert max_err <= 2 * scale / 254 + 1e-7, (max_err, scale)
+    print(f"MULTIHOST_LOCAL_OK pid={pid} local_rows={local_rows} "
+          f"global_rows={full_rows} local_bytes={local_bytes} "
+          f"quant_err={max_err:.2e}", flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -113,10 +113,11 @@ def test_fifty_clients_on_eight_device_mesh():
                       model_type="hybrid", update_type="mse_avg", fused=True)
     eng.data, eng.states = shard_federation(data, eng.states, mesh)
     eng._ver_x, eng._ver_m = eng._verification_tensors()
-    # compact_cohort defaults True but must auto-fall back to dense once the
-    # client axis is sharded (compact gathers cross shards — ADVICE r3);
-    # the property reads CURRENT data, so post-construction sharding counts
-    assert cfg.compact_cohort and not eng.compact
+    # compact_cohort defaults to auto (None -> compact on) but must fall
+    # back to dense once the client axis is sharded (compact gathers cross
+    # shards — ADVICE r3); the property reads CURRENT data, so
+    # post-construction sharding counts
+    assert cfg.compact_cohort is None and not eng.compact
     res = eng.run_round(0)
     assert res.client_metrics.shape == (50,)
     assert np.all(np.isfinite(res.client_metrics))
@@ -255,3 +256,21 @@ def test_two_process_midchunk_early_stop(two_process_outputs):
                           r"MIDSTOP_OK pid=\d+ (rounds=\d+ mean=[\d.]+)")
     # the rewound+replayed schedule state agrees across processes
     assert results[0].group(1) == results[1].group(1)
+
+
+def test_two_process_hostlocal_and_quantized(two_process_outputs):
+    """Host-local stacking + the hierarchical int8 merge across a REAL
+    process boundary (DESIGN.md §12): each worker stacks only ITS half of
+    the client axis (local_rows == global/2), places it via
+    make_array_from_process_local_data local slices, and the round is
+    bit-identical to the fully-replicated placement; the quantized DCN
+    exchange (num_groups=0 -> one group per process) stays inside its
+    documented error bound. Both assertions run inside the worker —
+    this test checks they fired on both processes and agreed."""
+    results = _match_both(
+        two_process_outputs,
+        r"MULTIHOST_LOCAL_OK pid=\d+ (local_rows=(\d+) global_rows=(\d+) "
+        r"local_bytes=\d+ quant_err=[\d.e+-]+)")
+    assert results[0].group(1) == results[1].group(1)
+    local, total = int(results[0].group(2)), int(results[0].group(3))
+    assert local * 2 == total  # each host stacked exactly half the axis

@@ -1,0 +1,359 @@
+"""Shard-native client axis (DESIGN.md §12): host-local stacking, the
+explicit shard_map + psum merge (pinned BIT-IDENTICAL to the einsum path on
+the same mesh), the hierarchical int8 quantized merge (pinned within its
+documented error bound), the mesh-aware client-state layout, and the
+driver's auto-padding. All tests run on the session-shared 8-virtual-device
+CPU mesh (tests/conftest.py::mesh8)."""
+
+import dataclasses
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.config import CompatConfig, ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+from fedmse_tpu.data.stacking import (FederatedData, pad_federated_data,
+                                      stack_dims)
+from fedmse_tpu.federation import RoundEngine
+from fedmse_tpu.federation.aggregation import make_aggregate_fn
+from fedmse_tpu.federation.state import (init_client_states,
+                                         tree_client_divergence)
+from fedmse_tpu.models import make_model, init_stacked_params
+from fedmse_tpu.parallel import (host_groups, make_hierarchical_aggregate,
+                                 make_shardmap_aggregate,
+                                 make_shardmap_divergence,
+                                 process_client_rows, shard_clients,
+                                 shard_federation)
+from fedmse_tpu.parallel.quantize import (dequantize_blockwise,
+                                          quantization_error_bound,
+                                          quantize_blockwise)
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+DIM = 10
+
+
+class _LogCapture(logging.Handler):
+    """The package logger is propagate=False with its own stderr handler
+    (utils/logging.py), so pytest's caplog never sees it; attach directly."""
+
+    def __init__(self):
+        super().__init__(logging.DEBUG)
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+    def clear(self):
+        self.records.clear()
+
+
+@pytest.fixture
+def pkg_log():
+    root = logging.getLogger("fedmse_tpu")
+    handler = _LogCapture()
+    old_level = root.level
+    root.addHandler(handler)
+    root.setLevel(logging.DEBUG)
+    yield handler
+    root.setLevel(old_level)
+    root.removeHandler(handler)
+
+
+@pytest.fixture(scope="module")
+def federation():
+    clients = synthetic_clients(n_clients=6, dim=DIM, n_normal=96,
+                                n_abnormal=40)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(run=0).data_rng)
+    data = stack_clients(clients, dev_x, 8, pad_clients_to=8)
+    return clients, dev_x, data
+
+
+@pytest.fixture(scope="module")
+def model():
+    return make_model("hybrid", DIM, shrink_lambda=3.0)
+
+
+def sharded_inputs(model, mesh8, n=8):
+    params = init_stacked_params(model, jax.random.key(0), n)
+    sel = jnp.asarray([1, 0, 1, 1, 0, 1, 0, 1], jnp.float32)
+    dev = jnp.asarray(np.random.default_rng(0).normal(
+        size=(32, DIM)).astype(np.float32))
+    return shard_clients(params, mesh8), shard_clients(sel, mesh8), dev
+
+
+# ------------------------- quantization codec ------------------------- #
+
+def test_quantize_roundtrip_error_bound(rng):
+    for shape, block in (((1000,), 256), ((13, 37), 64), ((5,), 8)):
+        x = rng.normal(size=shape).astype(np.float32) * 3.0
+        q, s = quantize_blockwise(jnp.asarray(x), block)
+        assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+        back = np.asarray(dequantize_blockwise(q, s, shape))
+        bound = quantization_error_bound(x, block)
+        assert np.abs(back - x).max() <= bound + 1e-7
+        # the bound is tight-ish: half an int8 step of the largest block
+        assert bound <= np.abs(x).max() / 254 + 1e-7
+
+
+def test_quantize_zero_block_is_exact():
+    x = jnp.zeros((64,), jnp.float32)
+    q, s = quantize_blockwise(x, 16)
+    np.testing.assert_array_equal(np.asarray(q), 0)
+    np.testing.assert_array_equal(np.asarray(s), 1.0)  # no 0/0 scale
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_blockwise(q, s, (64,))), 0.0)
+
+
+def test_host_groups_topologies(mesh8):
+    # real topology on one process: one group, whole mesh
+    assert host_groups(mesh8, 0) == [list(range(8))]
+    # emulated 4-host split: contiguous pairs
+    assert host_groups(mesh8, 4) == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    with pytest.raises(ValueError):
+        host_groups(mesh8, 3)  # must tile evenly
+
+
+# ------------------- explicit-collective aggregation ------------------- #
+
+@pytest.mark.parametrize("update_type", ["avg", "mse_avg"])
+def test_shardmap_merge_bitwise_einsum(mesh8, model, update_type):
+    """THE f32 parity pin: on the same sharded mesh, the explicit shard_map
+    + psum merge is bit-identical to the jit-auto-partitioned einsum (XLA
+    lowers the sharded einsum to exactly this partial-sum + all-reduce), so
+    'shard_map' is a zero-cost exact escape hatch for the quantized path."""
+    params_s, sel_s, dev = sharded_inputs(model, mesh8)
+    agg_e, w_e = make_aggregate_fn(model, update_type)(params_s, sel_s, dev)
+    agg_m, w_m = make_shardmap_aggregate(model, update_type, mesh8)(
+        params_s, sel_s, dev)
+    np.testing.assert_array_equal(np.asarray(w_e), np.asarray(w_m))
+    for a, b in zip(jax.tree.leaves(agg_e), jax.tree.leaves(agg_m)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("update_type", ["avg", "mse_avg"])
+def test_quantized_merge_within_bound(mesh8, model, update_type):
+    """The hierarchical int8 merge (4 emulated hosts on the 8-device mesh)
+    must stay within its derived bound vs the exact f32 merge: per element,
+    at most Σ_hosts max|host partial|_block / 254 — computed here from the
+    actual per-host partial sums. Weights are NEVER quantized (exact f32
+    scalar psum), so they stay bitwise equal."""
+    block = 64
+    params_s, sel_s, dev = sharded_inputs(model, mesh8)
+    agg_e, w_e = make_shardmap_aggregate(model, update_type, mesh8)(
+        params_s, sel_s, dev)
+    agg_q, w_q = make_hierarchical_aggregate(
+        model, update_type, mesh8, num_groups=4, block_size=block)(
+        params_s, sel_s, dev)
+    np.testing.assert_array_equal(np.asarray(w_e), np.asarray(w_q))
+
+    # per-leaf bound from the actual host partial sums (2 clients/group)
+    params_h = jax.device_get(params_s)
+    w_h = np.asarray(w_e)
+    for leaf_e, leaf_q, leaf_p in zip(jax.tree.leaves(agg_e),
+                                      jax.tree.leaves(agg_q),
+                                      jax.tree.leaves(params_h)):
+        bound = 0.0
+        for g in range(4):
+            part = np.einsum("n,n...->...", w_h[2 * g:2 * g + 2],
+                             leaf_p[2 * g:2 * g + 2])
+            bound += quantization_error_bound(part, block)
+        err = np.abs(np.asarray(leaf_e) - np.asarray(leaf_q)).max()
+        assert err <= bound + 1e-7, (err, bound)
+
+
+def test_quantized_single_group_is_exact_shardmap(mesh8, model):
+    """num_groups covering the whole mesh (single-host real topology): no
+    DCN stage exists, the quantizer never runs, and the merge is bitwise
+    the shard_map merge — 'when the hierarchy engages' (DESIGN.md §12)."""
+    params_s, sel_s, dev = sharded_inputs(model, mesh8)
+    agg_m, _ = make_shardmap_aggregate(model, "avg", mesh8)(
+        params_s, sel_s, dev)
+    agg_q, _ = make_hierarchical_aggregate(model, "avg", mesh8,
+                                           num_groups=1)(params_s, sel_s, dev)
+    for a, b in zip(jax.tree.leaves(agg_m), jax.tree.leaves(agg_q)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_shardmap_divergence_matches_dense(mesh8, model):
+    params = init_stacked_params(model, jax.random.key(3), 8)
+    mask = jnp.asarray([1, 1, 1, 1, 1, 1, 0, 0], jnp.float32)
+    dense = np.asarray(tree_client_divergence(params, mask))
+    sharded = np.asarray(make_shardmap_divergence(mesh8)(
+        shard_clients(params, mesh8), shard_clients(mask, mesh8)))
+    np.testing.assert_allclose(dense, sharded, rtol=1e-6, atol=1e-7)
+
+
+# ----------------------- host-local data stacking ---------------------- #
+
+def test_hostlocal_slices_tile_full_stack(federation):
+    """Slices stacked per-range (what each host materializes) concatenate
+    bitwise into the full stack, at 1/n_slices of the host bytes each."""
+    clients, dev_x, full = federation
+    dims = stack_dims(clients, 8, pad_clients_to=8)
+    parts = [stack_clients(clients, dev_x, 8, client_range=(i, i + 2),
+                           dims=dims) for i in range(0, 8, 2)]
+    full_bytes = local_bytes = 0
+    for f in dataclasses.fields(FederatedData):
+        if f.name == "dev_x":
+            continue
+        cat = np.concatenate(
+            [np.asarray(getattr(p, f.name)) for p in parts], axis=0)
+        ref = np.asarray(getattr(full, f.name))
+        np.testing.assert_array_equal(cat, ref)
+        full_bytes += ref.nbytes
+        local_bytes += np.asarray(getattr(parts[0], f.name)).nbytes
+    assert local_bytes * 4 == full_bytes  # each slice is 1/4 of the axis
+
+
+def test_process_client_rows_single_process(mesh8):
+    # single process owns every device -> the full axis
+    assert process_client_rows(16, mesh8) == (0, 16)
+    with pytest.raises(ValueError):
+        process_client_rows(15, mesh8)  # not a multiple of the mesh
+
+
+def test_shard_federation_host_local_single_process(federation, mesh8):
+    """host_local placement degenerates correctly single-process: the local
+    slice IS the full axis and the sharded arrays are identical to the
+    replicated-placement path."""
+    clients, dev_x, full = federation
+    a, _ = shard_federation(full, None, mesh8)
+    b, _ = shard_federation(full, None, mesh8, host_local=True,
+                            global_clients=8)
+    for f in dataclasses.fields(FederatedData):
+        ga, gb = getattr(a, f.name), getattr(b, f.name)
+        np.testing.assert_array_equal(np.asarray(ga), np.asarray(gb))
+        if f.name != "dev_x":
+            assert gb.sharding.is_equivalent_to(ga.sharding, gb.ndim)
+
+
+def test_pad_federated_data(federation):
+    _, _, full = federation
+    padded = pad_federated_data(full, 16)
+    assert padded.num_clients_padded == 16
+    assert float(np.asarray(padded.client_mask).sum()) == 6.0
+    np.testing.assert_array_equal(np.asarray(padded.train_xb)[:8],
+                                  np.asarray(full.train_xb))
+    np.testing.assert_array_equal(np.asarray(padded.test_m)[8:], 0.0)
+    with pytest.raises(ValueError):
+        pad_federated_data(full, 4)
+
+
+# --------------------- mesh-aware client-state layout ------------------ #
+
+def test_init_client_states_mesh_layout(mesh8, model):
+    """state.init_client_states(mesh=...) births the whole tree sharded
+    P('clients') — params AND Adam moments (ROADMAP item 2's single home) —
+    with values bitwise identical to the unsharded init."""
+    import optax
+
+    tx = optax.adam(1e-3)
+    plain = init_client_states(model, tx, jax.random.key(7), 8)
+    sharded = init_client_states(model, tx, jax.random.key(7), 8, mesh=mesh8)
+    for a, b in zip(jax.tree.leaves(plain), jax.tree.leaves(sharded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for leaf in jax.tree.leaves((sharded.params, sharded.opt_state,
+                                 sharded.prev_global)):
+        # every per-client leaf is split 8 ways on its leading axis
+        assert leaf.sharding.shard_shape(leaf.shape)[0] == leaf.shape[0] // 8
+
+
+# ------------------ engine wiring: backends + compact ------------------ #
+
+def build_engine(data, cfg, model, fused=True, mesh=None):
+    return RoundEngine(model, cfg, data, n_real=6,
+                       rngs=ExperimentRngs(run=0), model_type="hybrid",
+                       update_type="mse_avg", fused=fused, mesh=mesh)
+
+
+def test_full_round_per_backend_quality(federation, mesh8, model):
+    """A fused round per aggregation backend on the sharded mesh: shard_map
+    must match einsum to float tolerance at the round level (the merge
+    itself is bitwise; surrounding phases are identical programs), and the
+    quantized backend must land within the bf16-policy quality bar."""
+    _, _, full = federation
+    base = ExperimentConfig(dim_features=DIM, network_size=6, epochs=1,
+                            batch_size=8,
+                            compat=CompatConfig(vote_tie_break=False))
+    results = {}
+    for backend in ("einsum", "shard_map", "quantized"):
+        cfg = base.replace(aggregation_backend=backend, quant_hosts=4)
+        eng = build_engine(full, cfg, model, mesh=mesh8)
+        eng.data, eng.states = shard_federation(full, eng.states, mesh8)
+        eng._ver_x, eng._ver_m = eng._verification_tensors()
+        assert eng.agg_backend == backend
+        results[backend] = eng.run_round(0)
+    for backend, res in results.items():
+        assert np.all(np.isfinite(res.client_metrics)), backend
+        assert res.aggregator == results["einsum"].aggregator
+    np.testing.assert_array_equal(results["einsum"].client_metrics,
+                                  results["shard_map"].client_metrics)
+    np.testing.assert_allclose(results["einsum"].client_metrics,
+                               results["quantized"].client_metrics,
+                               atol=2e-3)
+
+
+def test_backend_inert_off_mesh(federation, model, pkg_log):
+    """An explicit backend without a sharded client axis degenerates to
+    einsum (the explicit collectives are written against a mesh)."""
+    _, _, full = federation
+    cfg = ExperimentConfig(dim_features=DIM, network_size=6, epochs=1,
+                           batch_size=8, aggregation_backend="shard_map")
+    eng = build_engine(full, cfg, model)
+    assert eng.agg_backend == "einsum"
+    assert any("inert" in r.getMessage() for r in pkg_log.records)
+
+
+def test_unknown_backend_raises(federation, model):
+    _, _, full = federation
+    cfg = ExperimentConfig(dim_features=DIM, network_size=6, epochs=1,
+                           batch_size=8, aggregation_backend="int4")
+    eng = build_engine(full, cfg, model)
+    with pytest.raises(ValueError, match="aggregation_backend"):
+        eng.agg_backend
+
+
+def test_compact_reevaluated_after_resharding(federation, mesh8, model,
+                                              pkg_log):
+    """engine.compact is a USE-time property: True (auto) before a
+    post-construction reshard, False after — and the fallback log level
+    tracks whether compact mode was explicitly requested (INFO) or just
+    the auto default (DEBUG)."""
+    _, _, full = federation
+    for requested, level in ((None, logging.DEBUG), (True, logging.INFO)):
+        cfg = ExperimentConfig(dim_features=DIM, network_size=6, epochs=1,
+                               batch_size=8, compact_cohort=requested)
+        eng = build_engine(full, cfg, model)
+        assert eng.compact is True  # off-mesh: compact on (auto or explicit)
+        eng.data, eng.states = shard_federation(full, eng.states, mesh8)
+        pkg_log.clear()
+        assert eng.compact is False  # re-evaluated on the swapped data
+        records = [r for r in pkg_log.records
+                   if "compact_cohort disabled" in r.getMessage()]
+        assert len(records) == 1 and records[0].levelno == level
+        # the warning is once-per-engine, not once-per-access
+        pkg_log.clear()
+        assert eng.compact is False
+        assert not pkg_log.records
+
+
+def test_auto_pad_in_run_combination(federation, mesh8, pkg_log):
+    """The driver auto-pads a non-mesh-multiple client axis (6 -> 8) instead
+    of erroring in shard_federation, and logs the padding it chose."""
+    from fedmse_tpu.main import run_combination
+
+    clients, dev_x, _ = federation
+    data6 = stack_clients(clients, dev_x, 8)  # no pad: 6 clients
+    cfg = ExperimentConfig(dim_features=DIM, network_size=6, epochs=1,
+                           num_rounds=1, batch_size=8,
+                           compat=CompatConfig(vote_tie_break=False))
+    out = run_combination(cfg, data6, 6, "hybrid", "mse_avg", run=0,
+                          mesh=mesh8)
+    assert any("padding client axis 6 -> 8" in r.getMessage()
+               for r in pkg_log.records)
+    assert out["final_metrics"].shape == (6,)
+    assert np.all(np.isfinite(out["final_metrics"]))
