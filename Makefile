@@ -9,7 +9,7 @@ LIB := fedmse_tpu/native/libfedmse_io.so
 
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
         serve-bench chaos-sweep pipeline-bench precision-bench shard-bench \
-        tpu-check
+        knn-bench tpu-check
 
 native: $(LIB)
 
@@ -68,6 +68,16 @@ precision-bench:
 # CPU + the 8-device virtual platform itself)
 shard-bench:
 	python bench.py --shard-bench --out BENCH_SHARD_r08_cpu.json
+
+# kNN scorer sweep (fedmse_tpu/knn/, DESIGN.md §13): AUC vs bank size on
+# the 500-client thin-shard multimodal grid (exact + approx top-k vs the
+# MSE/centroid baselines) + serving bank-lookup rows/s at batch 1024 vs
+# the MSE scorer (writes BENCH_KNN_r09_cpu.json; hermetic CPU like the
+# tests — the FLOP/s win targets the matrix unit, the AUC axis is
+# backend-independent)
+knn-bench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python bench.py --knn-bench --out BENCH_KNN_r09_cpu.json
 
 tpu-check:
 	python tpu_check.py

@@ -686,6 +686,190 @@ def measure_shard(cfg, n_clients: int = 10000, stack_hosts: int = 8,
     return out
 
 
+def measure_knn(cfg, quality_clients: int = 500,
+                bank_sizes=(128, 256, 512, 1024, 2048, 4096),
+                serve_bucket: int = 1024, quality_rounds: int = 2,
+                quality_epochs: int = 2):
+    """kNN scorer sweep (ISSUE 7 tentpole metric; fedmse_tpu/knn/):
+
+      * **quality**: AUC vs bank size on the `quality_clients`-client
+        thin-shard multimodal grid (data/synthetic.py
+        synthetic_multimodal_clients — several device behaviors behind
+        each gateway, anomalies BETWEEN the modes: the regime where the
+        single-prototype centroid/MSE scores degrade and ROADMAP 4's
+        multi-prototype scorer is supposed to win). A short hybrid+mse_avg
+        federation trains the latent space, then every score kind
+        evaluates the same test grid through make_evaluate_all — exact
+        AND approximate top-k per bank size, vs the MSE and centroid
+        baselines. Thin shards cap each gateway's VALID bank rows at its
+        train-row count (`effective_bank` reports the cap); capacities
+        above it measure the padded-distance-tile cost honestly.
+      * **serving**: multi-tenant rows/s at batch `serve_bucket` through
+        the bucketed ServingEngine — the kNN bank-lookup path (exact +
+        approx, per bank size, banks FULL at every size) vs the MSE
+        scorer on the same params. The acceptance bar: kNN within 3x of
+        MSE at batch 1024 (`serve.within_3x_of_mse`).
+    """
+    import numpy as np
+    import jax
+    from fedmse_tpu.data import (build_dev_dataset, stack_clients,
+                                 synthetic_multimodal_clients)
+    from fedmse_tpu.evaluation import make_evaluate_all
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.models import init_stacked_params, make_model
+    from fedmse_tpu.serving.engine import ServingEngine
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    out = {"bank_sizes": list(bank_sizes), "knn_k": cfg.knn_k}
+
+    # ---- quality: AUC vs bank size, thin-shard multimodal grid ---- #
+    # 1280 normal rows/client -> 512 train rows: thin relative to the
+    # bank-capacity axis (capacities above 512 are capped), rich enough
+    # that the AUC-vs-B curve has room to move
+    qcfg = cfg.replace(network_size=quality_clients,
+                       num_rounds=quality_rounds, epochs=quality_epochs,
+                       num_participants=0.2)
+    clients = synthetic_multimodal_clients(
+        n_clients=quality_clients, dim=qcfg.dim_features, n_normal=1280,
+        n_abnormal=128, modes=3, seed=7)
+    rngs = ExperimentRngs(run=0, data_seed=qcfg.data_seed)
+    dev_x = build_dev_dataset(clients, rngs.data_rng)
+    data = stack_clients(clients, dev_x, qcfg.batch_size)
+    train_rows = int(np.asarray(data.train_mb[0]).sum())
+    model = make_model("hybrid", qcfg.dim_features,
+                       shrink_lambda=qcfg.shrink_lambda)
+    engine = RoundEngine(model, qcfg, data, n_real=quality_clients,
+                         rngs=rngs, model_type="hybrid",
+                         update_type="mse_avg", fused=True)
+    t0 = time.time()
+    engine.run_rounds(0, quality_rounds)
+    train_sec = time.time() - t0
+    args = (engine.states.params, data.test_x, data.test_m, data.test_y,
+            data.train_xb, data.train_mb)
+    test_rows = int(np.asarray(data.test_m).sum())
+
+    def timed_eval(**kw):
+        fn = make_evaluate_all(model, "hybrid", **kw)
+        jax.block_until_ready(fn(*args))  # compile + warm
+        sec, aucs = _min_over_reps(lambda: _timed_once(fn, args))
+        return round(float(np.nanmean(np.asarray(aucs))), 5), sec
+
+    def _timed_once(fn, args):
+        t0 = time.time()
+        r = fn(*args)
+        jax.block_until_ready(r)
+        return time.time() - t0, r
+
+    quality = {"clients": quality_clients, "train_rows_per_client": train_rows,
+               "test_rows_total": test_rows, "train_sec": round(train_sec, 1),
+               "rounds": quality_rounds}
+    for kind in ("mse", "centroid"):
+        auc, sec = timed_eval(score_kind=kind)
+        quality[kind] = {"auc": auc, "score_sec": round(sec, 4),
+                         "rows_per_sec": round(test_rows / sec)}
+    quality["knn"] = {}
+    for b in bank_sizes:
+        row = {"effective_bank": min(b, train_rows)}
+        for topk in ("exact", "approx"):
+            auc, sec = timed_eval(score_kind="knn", knn_bank_size=b,
+                                  knn_k=cfg.knn_k, knn_topk=topk)
+            row[topk] = {"auc": auc, "score_sec": round(sec, 4),
+                         "rows_per_sec": round(test_rows / sec)}
+        quality["knn"][str(b)] = row
+    best_b, best_auc = max(
+        ((b, quality["knn"][str(b)]["exact"]["auc"]) for b in bank_sizes),
+        key=lambda kv: kv[1])
+    quality["best_bank"] = best_b
+    quality["best_knn_auc"] = best_auc
+    # the beats-baseline verdicts read ONE deployable configuration — the
+    # config-default bank when swept — not the max over the sweep (a
+    # best-of-6 max can clear a single-config baseline on evaluation
+    # noise alone; the full per-bank AUC rows stay in the artifact)
+    vb = str(cfg.knn_bank_size if cfg.knn_bank_size in bank_sizes
+             else max(bank_sizes))
+    v_auc = quality["knn"][vb]["exact"]["auc"]
+    quality["verdict_bank"] = int(vb)
+    quality["knn_beats_centroid"] = bool(v_auc >= quality["centroid"]["auc"])
+    quality["knn_beats_mse"] = bool(v_auc >= quality["mse"]["auc"])
+    out["quality_thin_shard"] = quality
+
+    # ---- serving: bank lookup inside the bucketed scorer ---- #
+    # rich shards so every bank size is FULL (the cost axis is B, not the
+    # thin-shard cap); 10 gateways, mixed-gateway batches of serve_bucket
+    n_srv = 10
+    srv_clients = synthetic_multimodal_clients(
+        n_clients=n_srv, dim=cfg.dim_features,
+        n_normal=int(max(bank_sizes) / 0.4) + 8, n_abnormal=64, modes=3,
+        seed=11)
+    srv_dev = build_dev_dataset(srv_clients, np.random.default_rng(0))
+    sdata = stack_clients(srv_clients, srv_dev, cfg.batch_size)
+    smodel = make_model("hybrid", cfg.dim_features,
+                        shrink_lambda=cfg.shrink_lambda)
+    sparams = init_stacked_params(smodel, jax.random.key(2), n_srv)
+    rng = np.random.default_rng(3)
+    batch = np.asarray(sdata.test_x[:, :serve_bucket // n_srv + 1]).reshape(
+        -1, cfg.dim_features)[:serve_bucket].astype(np.float32)
+    gws = rng.integers(0, n_srv, size=serve_bucket).astype(np.int32)
+
+    def serve_floor_sec(eng, reps: int = 9):
+        """min over `reps` warm dispatches — the steady-state floor."""
+        eng.warmup()
+        def once():
+            t0 = time.time()
+            eng.score(batch, gws)
+            return time.time() - t0
+        once()  # shake off post-warmup cache effects before sampling
+        return min(once() for _ in range(reps))
+
+    serve = {"gateways": n_srv, "batch": serve_bucket}
+    mse_eng = ServingEngine(smodel, "autoencoder", sparams,
+                            max_bucket=serve_bucket)
+    mse_sec = serve_floor_sec(mse_eng)  # reported after the paired passes
+    serve["knn"] = {}
+    for b in bank_sizes:
+        row = {}
+        for topk in ("exact", "approx"):
+            eng = ServingEngine.from_federation(
+                smodel, "autoencoder", sparams, train_x=sdata.train_xb,
+                train_m=sdata.train_mb, score_kind="knn", knn_bank_size=b,
+                knn_k=cfg.knn_k, knn_topk=topk, max_bucket=serve_bucket)
+            # the 3x verdict is a RATIO of two microbenchmarks on a
+            # shared box: floors measured minutes apart see different
+            # machine states (the mse floor alone swung ~30% between
+            # whole-bench runs, flipping the verdict on jitter). Each
+            # row's slowdown therefore uses a PAIRED mse floor measured
+            # adjacent to that row's knn floor — both sides sample the
+            # same noise window, and the ratio stops riding it.
+            paired_mse = serve_floor_sec(mse_eng, reps=5)
+            mse_sec = min(mse_sec, paired_mse)  # best-known, for headline
+            sec = serve_floor_sec(eng)
+            row[topk] = {"rows_per_sec": round(serve_bucket / sec),
+                         "slowdown_vs_mse": round(sec / paired_mse, 2),
+                         "bank_count_full": bool(int(np.asarray(
+                             eng.banks.count).min()) >= b)}
+        serve["knn"][str(b)] = row
+    serve["mse_rows_per_sec"] = round(serve_bucket / mse_sec)
+    # the acceptance bar (ISSUE 7: kNN throughput within 3x of MSE at
+    # BATCH 1024) reads at the CONFIG-DEFAULT bank size when swept, else
+    # the largest swept bank (the reduced suite grid). It reads on the
+    # APPROX mode — the config-default knn_topk, i.e. the TPU-KNN
+    # partial-reduce serving configuration, quality-pinned within ~1e-3
+    # AUC of exact in this same artifact — with the exact-mode verdict
+    # reported alongside, not hidden.
+    key_b = str(cfg.knn_bank_size if cfg.knn_bank_size in bank_sizes
+                else max(bank_sizes))
+    serve["within_3x_of_mse"] = bool(
+        serve["knn"][key_b]["approx"]["slowdown_vs_mse"] <= 3.0)
+    serve["exact_within_3x_of_mse"] = bool(
+        serve["knn"][key_b]["exact"]["slowdown_vs_mse"] <= 3.0)
+    serve["acceptance_note"] = (
+        "within_3x_of_mse reads the config-default serving configuration "
+        f"(knn_topk=approx, bank {key_b}); exact-mode verdict in "
+        "exact_within_3x_of_mse")
+    out["serve"] = serve
+    return out
+
+
 def build_data(cfg, n_clients: int = 10, dataset=None):
     """Stacked federation tensors for a benchmark scenario.
 
@@ -787,6 +971,7 @@ def main():
     sweep_runs = _int_flag("--sweep-runs", None)
     pipeline_bench = "--pipeline-bench" in sys.argv
     precision_bench = "--precision-bench" in sys.argv
+    knn_bench = "--knn-bench" in sys.argv
     if sweep_runs is not None and sweep_runs < 1:
         sys.exit(f"--sweep-runs expects a positive integer, got {sweep_runs}")
     chunk = _int_flag("--chunk", None)
@@ -839,6 +1024,41 @@ def main():
         line = json.dumps(out)
         print(line)
         dest = _flag("--out", f"BENCH_SHARD_r08_{device.platform}.json")
+        with open(dest, "w") as f:
+            f.write(line + "\n")
+        return
+
+    if knn_bench:
+        # kNN scorer sweep (ISSUE 7): AUC vs bank size on the thin-shard
+        # multimodal grid (exact + approx top-k vs the MSE/centroid
+        # baselines) + serving bank-lookup rows/s at batch 1024 vs the MSE
+        # scorer. One JSON line, written to BENCH_KNN_r09_<platform>.json
+        # (or --out).
+        q_clients = _int_flag("--quality-clients", 500)
+        device = jax.devices()[0]
+        out = {
+            "metric": f"kNN scorer: AUC vs bank size ({q_clients}-client "
+                      f"thin-shard multimodal grid) + serving bank-lookup "
+                      f"rows/s at batch 1024 vs the MSE scorer",
+            "value": None,  # filled from the best exact-knn AUC below
+            "unit": "best exact-kNN mean AUC (thin-shard grid)",
+            "device": str(device),
+            "platform": device.platform,
+            "mode": "latent-space kNN scoring (fedmse_tpu/knn/, "
+                    "DESIGN.md §13)",
+            "data_seed": cfg.data_seed,
+            "data_source": "synthetic-multimodal (data/synthetic.py; the "
+                           "single-prototype-degrading regime, ROADMAP 4)",
+        }
+        out.update(measure_knn(cfg, quality_clients=q_clients))
+        out["value"] = out["quality_thin_shard"]["best_knn_auc"]
+        reason = os.environ.get("FEDMSE_BENCH_CPU_FALLBACK")
+        if reason and reason != "1":
+            out["tpu_fallback_reason"] = reason
+        out.update(capture_provenance())
+        line = json.dumps(out)
+        print(line)
+        dest = _flag("--out", f"BENCH_KNN_r09_{device.platform}.json")
         with open(dest, "w") as f:
             f.write(line + "\n")
         return

@@ -18,6 +18,11 @@ repo-grown axes):
      bytes, dense vs shard_map vs int8-hierarchical merge, full fused
      round + quantized quality pin (runs in a subprocess so the virtual
      platform never disturbs the suite's own backend)
+ 11. latent-space kNN scorer (fedmse_tpu/knn/, DESIGN.md §13): AUC vs
+     bank size on a thin-shard multimodal grid (exact + approx top-k vs
+     the MSE/centroid baselines) + serving bank-lookup rows/s vs the MSE
+     scorer (suite runs a 100-client reduced grid; the committed
+     standalone artifact is BENCH_KNN_r09_cpu.json at 500 clients)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -214,6 +219,20 @@ def scen_shard():
                         "int8 merge", **row}
 
 
+def scen_knn(cfg):
+    """Scenario 11: the kNN scorer (ISSUE 7) — a reduced 100-client grid
+    with two bank sizes keeps the suite's cost bounded; the committed
+    standalone artifact (make knn-bench -> BENCH_KNN_r09_cpu.json) runs
+    the full 500-client sweep. Same row shape as bench.measure_knn."""
+    from bench import measure_knn
+
+    row = measure_knn(cfg, quality_clients=100, bank_sizes=(128, 512))
+    return {"scenario": "latent-space kNN scorer: 100-client thin-shard "
+                        "multimodal grid, banks {128, 512}, exact + approx "
+                        "top-k vs MSE/centroid; serving bank lookup vs MSE "
+                        "scorer", **row}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -236,9 +255,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-10")
-        if not 1 <= only <= 10:
-            sys.exit(f"--only expects a scenario number 1-10, got {only}")
+            sys.exit("--only expects a scenario number 1-11")
+        if not 1 <= only <= 11:
+            sys.exit(f"--only expects a scenario number 1-11, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -317,6 +336,9 @@ def main():
 
     if only in (None, 10):
         emit(scen_shard())
+
+    if only in (None, 11):
+        emit(scen_knn(ExperimentConfig()))
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

@@ -6,7 +6,8 @@ from fedmse_tpu.data.loader import (
     prepare_clients,
 )
 from fedmse_tpu.data.stacking import FederatedData, stack_clients
-from fedmse_tpu.data.synthetic import synthetic_clients
+from fedmse_tpu.data.synthetic import (synthetic_clients,
+                                       synthetic_multimodal_clients)
 
 __all__ = [
     "ClientData",
@@ -17,4 +18,5 @@ __all__ = [
     "prepare_clients",
     "stack_clients",
     "synthetic_clients",
+    "synthetic_multimodal_clients",
 ]

@@ -55,3 +55,58 @@ def synthetic_clients(
             scaler=proc,
         ))
     return clients
+
+
+def synthetic_multimodal_clients(
+    n_clients: int = 4,
+    dim: int = 16,
+    n_normal: int = 240,
+    n_abnormal: int = 120,
+    modes: int = 3,
+    seed: int = 0,
+) -> List[ClientData]:
+    """Multi-MODAL per-client normal traffic — the regime single-prototype
+    scores degrade in (ROADMAP 4; DESIGN.md §13).
+
+    Each client's normal traffic is a mixture of `modes` well-separated
+    Gaussian clusters (several distinct device behaviors behind one
+    gateway); its abnormal traffic sits BETWEEN the clusters, near their
+    common mean. A centroid score (distance from the standardized origin ≈
+    the mixture mean) assigns those anomalies LOW scores — they are close
+    to the mean while being far from every actual normal point — whereas a
+    kNN score against a bank of real normal latents stays high. Same
+    40/10/40/10 split discipline as `synthetic_clients`."""
+    rng = np.random.default_rng(seed)
+    clients = []
+    for i in range(n_clients):
+        centers = rng.normal(0, 4.0, size=(modes, dim))
+        assign = rng.integers(0, modes, size=n_normal)
+        normal = centers[assign] + rng.normal(0, 0.5, size=(n_normal, dim))
+        # anomalies: tight around the mixture mean — between the modes,
+        # close to the centroid, far from every cluster
+        abnormal = centers.mean(axis=0) + rng.normal(
+            0, 0.5, size=(n_abnormal, dim))
+
+        n_train = int(0.4 * n_normal)
+        n_valid = int(0.1 * n_normal)
+        n_dev = int(0.4 * n_normal)
+        train, valid = normal[:n_train], normal[n_train:n_train + n_valid]
+        dev = normal[n_train + n_valid:n_train + n_valid + n_dev]
+        test = normal[n_train + n_valid + n_dev:]
+
+        proc = IoTDataProcessor(scaler="standard")
+        train_x, _ = proc.fit_transform(train)
+        valid_x, _ = proc.transform(valid)
+        test_x, test_y = proc.transform(test)
+        ab_x, ab_y = proc.transform(abnormal, type="abnormal")
+
+        clients.append(ClientData(
+            name=f"multimodal-{i + 1}",
+            train_x=train_x.astype(np.float32),
+            valid_x=valid_x.astype(np.float32),
+            test_x=np.concatenate([test_x, ab_x]).astype(np.float32),
+            test_y=np.concatenate([test_y, ab_y]).astype(np.float32),
+            dev_raw=pd.DataFrame(dev),
+            scaler=proc,
+        ))
+    return clients

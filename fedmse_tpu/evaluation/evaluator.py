@@ -35,8 +35,25 @@ def _flatten_batches(xb: jax.Array, mb: jax.Array) -> Tuple[jax.Array, jax.Array
     return xb.reshape(-1, xb.shape[-1]), mb.reshape(-1)
 
 
+def resolve_score_kind(model_type: str, score_kind: str) -> str:
+    """The ONE home of the score_kind resolution rule (shared with
+    serving/engine.py): 'auto' keeps the reference pairing — AE-MSE under
+    'autoencoder', centroid density under 'hybrid' (exactly the pre-knn
+    behavior every committed artifact was produced under); 'mse' /
+    'centroid' / 'knn' force that score under either model."""
+    if score_kind not in ("auto", "mse", "centroid", "knn"):
+        raise ValueError(f"unknown score_kind {score_kind!r}; expected "
+                         "'auto' | 'mse' | 'centroid' | 'knn'")
+    if score_kind == "auto":
+        return "mse" if model_type == "autoencoder" else "centroid"
+    return score_kind
+
+
 def make_evaluate_all(model, model_type: str, metric: str = "AUC",
-                      fused: str = "off", latency_reps: int = 5) -> Callable:
+                      fused: str = "off", latency_reps: int = 5,
+                      score_kind: str = "auto", knn_bank_size: int = 1024,
+                      knn_k: int = 8, knn_topk: str = "exact",
+                      knn_seed: int = 0) -> Callable:
     """Build fn(stacked_params, test_x, test_m, test_y, train_xb, train_mb)
     -> metrics [N] for AUC, or [N, 3] (f1, precision, recall) for
     'classification' — the reference's calculate_classification_metric
@@ -48,34 +65,66 @@ def make_evaluate_all(model, model_type: str, metric: str = "AUC",
     nan_to_num'd per-row anomaly scores [N, T] — the serving subsystem's
     parity oracle (fedmse_tpu/serving/engine.py).
 
+    score_kind selects the anomaly score ORTHOGONALLY to model_type:
+    'auto' (default) keeps the reference pairing — AE-MSE for
+    'autoencoder', centroid density for 'hybrid' — while 'mse' /
+    'centroid' / 'knn' force that score under either model.
+    'knn' (fedmse_tpu/knn/, DESIGN.md §13) scores each test row by its
+    distance to the knn_k-th nearest neighbor in a per-client bank of
+    knn_bank_size normal train latents, built IN-PROGRAM from the same
+    train tensors the hybrid fit already consumes (bank keys fold the
+    client's absolute index into key(knn_seed), so a persisted
+    knn.build_banks bank from the same inputs is identical — the serving
+    parity contract). knn_topk: 'exact' or 'approx' (knn/score.py).
+
     fused: 'off' uses the flax apply; 'auto'/'pallas'/'xla' route the forward
     through the single-kernel fused path (ops/pallas_ae.py) — same math, one
     VMEM-resident pass per row block on TPU."""
+    kind = resolve_score_kind(model_type, score_kind)
 
-    def anomaly_scores_one(params, test_x, train_xf, train_mf):
+    def knn_scores(test_latent, train_latent, train_mf, key):
+        from fedmse_tpu.knn import downsample_latents, knn_kth_distance
+        bank, count = downsample_latents(train_latent, train_mf,
+                                         knn_bank_size, key)
+        return knn_kth_distance(test_latent, bank, count, knn_k,
+                                topk=knn_topk)
+
+    def anomaly_scores_one(params, test_x, train_xf, train_mf, key):
         if fused != "off":
             from fedmse_tpu.ops.pallas_ae import fused_forward_stats
             cdt = getattr(model, "compute_dtype", jnp.float32)
             test_latent, test_mse, _ = fused_forward_stats(
                 params, test_x, latent_dim=model.latent_dim, mode=fused,
                 compute_dtype=cdt)
-            if model_type == "autoencoder":
+            if kind == "mse":
                 return test_mse
             train_latent, _, _ = fused_forward_stats(
                 params, train_xf, latent_dim=model.latent_dim, mode=fused,
                 compute_dtype=cdt)
+            if kind == "knn":
+                return knn_scores(test_latent, train_latent, train_mf, key)
             cen = fit_centroid(train_latent, train_mf)
             return cen.get_density(test_latent)
         test_latent, recon = model.apply({"params": params}, test_x)
-        if model_type == "autoencoder":
+        if kind == "mse":
             return per_sample_mse(test_x, recon)
-        # hybrid: centroid density over latents (evaluator.py:76-112)
         train_latent, _ = model.apply({"params": params}, train_xf)
+        if kind == "knn":
+            return knn_scores(test_latent, train_latent, train_mf, key)
+        # centroid density over latents (evaluator.py:76-112)
         cen = fit_centroid(train_latent, train_mf)
         return cen.get_density(test_latent)
 
-    def eval_one(params, test_x, test_m, test_y, train_xf, train_mf):
-        scores = anomaly_scores_one(params, test_x, train_xf, train_mf)
+    def client_keys(n):
+        # per-client downsample keys folded on the ABSOLUTE index
+        # (utils/seeding.fold_in_keys — the padding-invariance rule;
+        # knn.build_banks derives the SAME keys, which is the
+        # persisted-vs-in-program bank parity contract)
+        from fedmse_tpu.utils.seeding import fold_in_keys
+        return fold_in_keys(jax.random.key(knn_seed), n)
+
+    def eval_one(params, test_x, test_m, test_y, train_xf, train_mf, key):
+        scores = anomaly_scores_one(params, test_x, train_xf, train_mf, key)
         scores = jnp.nan_to_num(scores)  # evaluator.py:24-25 nan_to_num guard
         if metric == "scores":
             # raw per-row anomaly scores [T] — the oracle the serving
@@ -100,15 +149,18 @@ def make_evaluate_all(model, model_type: str, metric: str = "AUC",
             train_xf = train_xb.reshape(train_xb.shape[0], -1,
                                         train_xb.shape[-1])
             train_mf = train_mb.reshape(train_mb.shape[0], -1)
+            keys = client_keys(test_x.shape[0])
             take = lambda i: jax.tree.map(lambda t: t[i], stacked_params)
             jax.block_until_ready(
-                scores_one(take(0), test_x[0], train_xf[0], train_mf[0]))
+                scores_one(take(0), test_x[0], train_xf[0], train_mf[0],
+                           keys[0]))
             lat = np.zeros(test_x.shape[0])
             for i in range(test_x.shape[0]):
                 p = take(i)
                 t0 = time.perf_counter()
                 for _ in range(latency_reps):
-                    out = scores_one(p, test_x[i], train_xf[i], train_mf[i])
+                    out = scores_one(p, test_x[i], train_xf[i], train_mf[i],
+                                     keys[i])
                 jax.block_until_ready(out)
                 lat[i] = (time.perf_counter() - t0) / latency_reps
             return lat
@@ -120,7 +172,8 @@ def make_evaluate_all(model, model_type: str, metric: str = "AUC",
         train_xf = train_xb.reshape(train_xb.shape[0], -1, train_xb.shape[-1])
         train_mf = train_mb.reshape(train_mb.shape[0], -1)
         return jax.vmap(eval_one)(stacked_params, test_x, test_m, test_y,
-                                  train_xf, train_mf)
+                                  train_xf, train_mf,
+                                  client_keys(test_x.shape[0]))
 
     return evaluate_all
 

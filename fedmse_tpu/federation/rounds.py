@@ -112,7 +112,8 @@ def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
            cfg.compat.restandardize_vote_data, cfg.compat.vote_tie_break,
            cfg.verification_threshold, cfg.performance_threshold,
            cfg.hardened_verification, cfg.flatten_optimizer,
-           model_type, cfg.metric, cfg.fused_eval)
+           model_type, cfg.metric, cfg.fused_eval, cfg.score_kind,
+           cfg.knn_bank_size, cfg.knn_k, cfg.knn_topk)
     hit = _PROGRAM_CACHE.get(key)
     if hit is not None:
         return hit
@@ -135,7 +136,11 @@ def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
                                  cfg.performance_threshold,
                                  hardened=cfg.hardened_verification),
         "evaluate_all": make_evaluate_all(model, model_type, cfg.metric,
-                                          fused=cfg.fused_eval),
+                                          fused=cfg.fused_eval,
+                                          score_kind=cfg.score_kind,
+                                          knn_bank_size=cfg.knn_bank_size,
+                                          knn_k=cfg.knn_k,
+                                          knn_topk=cfg.knn_topk),
     }
     _cache_put(key, programs)
     return programs

@@ -65,8 +65,14 @@ def make_mse_scores_fn(model, restandardize: bool = True,
 
     @jax.jit
     def scores_all(stacked_params, val_x, val_m, rng):
+        from fedmse_tpu.utils.seeding import fold_in_keys
         n = jax.tree.leaves(stacked_params)[0].shape[0]
-        rngs = jax.random.split(rng, n)
+        # per-client tie-break keys fold the ABSOLUTE client index
+        # (utils/seeding.fold_in_keys): split over the padded axis would
+        # give every real client a different tie-break factor whenever the
+        # padding changed — the same mesh-size-leaks-into-results bug class
+        # the init keys had (PARITY.md §8)
+        rngs = fold_in_keys(rng, n)
         return jax.vmap(score_one, in_axes=(0, None, None, 0))(
             stacked_params, val_x, val_m, rngs)
 

@@ -1,0 +1,45 @@
+"""Latent-space k-nearest-neighbor anomaly scoring at matrix-unit FLOP/s.
+
+The third scorer family after AE-MSE and centroid density — and the first
+MULTI-prototype one: instead of one reconstruction error or one centroid,
+each gateway scores traffic against a reference bank of its own normal
+latents, score = distance to the k-th nearest neighbor. Multi-modal normal
+traffic (several device behaviors behind one gateway) is exactly where a
+single-prototype score degrades and kNN does not (ROADMAP 4; the thin-
+shard 500-client regime of BENCH_C500).
+
+  bank.py   fixed-capacity per-gateway banks of normal latents, stacked
+            [N, B, L] so all gateways score in one program; reservoir-
+            equivalent downsample; persisted beside checkpoints
+  score.py  blocked matmul distance tiles (TPU-KNN, arxiv 2206.14286) with
+            f32 accumulation, exact (per-block partial top-k + merge) and
+            approximate (per-bin minimum) top-k, optional Pallas tile
+            kernel mirroring ops/pallas_ae.py
+
+Wired end-to-end: `make_evaluate_all(..., score_kind="knn")` scores every
+gateway's test set in one vmapped program (model_type-orthogonal — both AE
+variants have encoders); `ServingEngine(score_kind="knn")` serves bank
+lookups inside the bucketed multi-tenant scorer with per-gateway
+calibration of kth-distance thresholds; `--score-kind knn
+--knn-bank-size B` through config/driver. Design rationale: DESIGN.md §13.
+"""
+
+from fedmse_tpu.knn.bank import (ReferenceBank, bank_path, build_banks,
+                                 downsample_latents, load_bank,
+                                 pow2_bank_size, save_bank)
+from fedmse_tpu.knn.score import (dist_tiles, knn_kth_distance,
+                                  knn_smallest_k, routed_kth_distance)
+
+__all__ = [
+    "ReferenceBank",
+    "bank_path",
+    "build_banks",
+    "dist_tiles",
+    "downsample_latents",
+    "knn_kth_distance",
+    "knn_smallest_k",
+    "load_bank",
+    "pow2_bank_size",
+    "routed_kth_distance",
+    "save_bank",
+]

@@ -1,0 +1,160 @@
+"""Per-gateway reference banks of normal latents — the kNN scorer's state.
+
+A gateway's bank is a fixed-capacity sample of the latents its OWN model
+assigns to its own (normal) training traffic. All N gateways' banks stack
+into one `[N, B, L]` pytree so the whole federation's kNN scoring is a
+single device program (the same stacked-pytree discipline as params,
+centroids, and the training data — DESIGN.md §1).
+
+Static shapes vs ragged reality: gateways hold different train-row counts
+(the thin-shard regime is the whole point — ROADMAP 4), so the bank is a
+power-of-two capacity `B` plus a per-gateway valid `count`:
+
+  * count >= B: a uniform random subset of B valid latents (reservoir-
+    equivalent: every valid row is kept with equal probability). Drawn by
+    the priority trick — one uniform priority per row, invalid rows
+    pinned to +inf, keep the B smallest — which is a single top_k, jit-
+    and vmap-friendly, no host loop.
+  * count < B: every valid latent, padded; the scorer masks slots past
+    `count` to +inf distance so padding can never be a neighbor.
+
+Downsample keys fold the gateway's ABSOLUTE index into a base seed
+(`fold_in`, not `split` — the same padding-invariance rule as
+init_stacked_params), so gateway i's bank is independent of the padded
+axis length and of every other gateway.
+
+Persistence rides beside the checkpoint tree (`ResultsWriter.serving_dir`,
+like the calibration JSON): `save_bank`/`load_bank` round-trip the exact
+arrays, so a serving process can reload banks with no training-side state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pow2_bank_size(bank_size: int) -> int:
+    """Round a requested capacity up to a power of two (the distance tiles
+    and top-k merges want lane-friendly static shapes)."""
+    if bank_size < 1:
+        raise ValueError(f"bank_size must be >= 1, got {bank_size}")
+    return 1 << (bank_size - 1).bit_length()
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ReferenceBank:
+    """Stacked per-gateway banks (a pytree: jit/vmap/gather-friendly)."""
+
+    latents: jax.Array  # [N, B, L] f32 — slots past count[g] are padding
+    count: jax.Array    # [N] int32 — valid slots per gateway (<= B)
+
+    @property
+    def num_gateways(self) -> int:
+        return self.latents.shape[0]
+
+    @property
+    def bank_size(self) -> int:
+        return self.latents.shape[1]
+
+    @property
+    def latent_dim(self) -> int:
+        return self.latents.shape[2]
+
+
+def downsample_latents(latent: jax.Array, mask: Optional[jax.Array],
+                       bank_size: int, key: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """(bank [B, L] f32, count int32) — a uniform sample of the valid rows.
+
+    Priority trick: each valid row draws a uniform priority, invalid rows
+    get +inf, and the B smallest priorities win. top_k returns them in
+    ascending-priority order, so the first `count` slots are always valid
+    rows and padding (if any) sits at the tail. One top_k, fully
+    vmappable over the gateway axis."""
+    bank_size = pow2_bank_size(bank_size)
+    rows = latent.shape[0]
+    valid = (jnp.ones(rows) if mask is None else mask.reshape(rows)) > 0
+    pri = jnp.where(valid, jax.random.uniform(key, (rows,)), jnp.inf)
+    if rows <= bank_size:
+        # capacity covers every row: keep all, pad to B (sorted by priority
+        # so valid rows lead, same invariant as the top_k branch)
+        order = jnp.argsort(pri)
+        idx = jnp.concatenate(
+            [order, jnp.zeros(bank_size - rows, jnp.int32)])
+    else:
+        _, idx = jax.lax.top_k(-pri, bank_size)
+    bank = latent[idx].astype(jnp.float32)
+    count = jnp.minimum(jnp.sum(valid, dtype=jnp.int32), bank_size)
+    # zero out padding slots: their content must not leak stale latents
+    # into persisted artifacts (the scorer masks them anyway)
+    slot = jnp.arange(bank_size)
+    return jnp.where((slot < count)[:, None], bank, 0.0), count
+
+
+def build_banks(model, stacked_params: Any, train_x, train_m=None,
+                bank_size: int = 1024, seed: int = 0) -> ReferenceBank:
+    """Encode each gateway's train rows with ITS OWN params and downsample
+    to a stacked ReferenceBank — the exact encode path the evaluator's
+    hybrid fit uses (serving/engine.fit_gateway_centroids's twin).
+
+    Accepts batch-major [N, NB, B, D] (the FederatedData layout) or flat
+    [N, S, D] train rows. `seed` keys the downsample draw; the per-gateway
+    key is fold_in(key(seed), gateway_index) — the SAME scheme
+    evaluation/evaluator.py uses in-program, so a persisted bank and an
+    in-program bank built from the same inputs are identical."""
+    train_x = jnp.asarray(train_x)
+    if train_x.ndim == 4:
+        train_x = train_x.reshape(train_x.shape[0], -1, train_x.shape[-1])
+    if train_m is not None:
+        train_m = jnp.asarray(train_m).reshape(train_m.shape[0], -1)
+    n = train_x.shape[0]
+    bank_size = pow2_bank_size(bank_size)
+
+    @jax.jit
+    def build(params, xf, mf):
+        from fedmse_tpu.utils.seeding import fold_in_keys
+        keys = fold_in_keys(jax.random.key(seed), n)
+
+        def one(p, x, m, k):
+            latent, _ = model.apply({"params": p}, x)
+            return downsample_latents(latent, m, bank_size, k)
+
+        if mf is None:
+            lat, cnt = jax.vmap(
+                lambda p, x, k: one(p, x, None, k))(params, xf, keys)
+        else:
+            lat, cnt = jax.vmap(one)(params, xf, mf, keys)
+        return ReferenceBank(latents=lat, count=cnt)
+
+    return build(stacked_params, train_x, train_m)
+
+
+# ------------------------------ persistence ------------------------------ #
+
+def save_bank(path: str, bank: ReferenceBank) -> str:
+    """Persist a bank as npz beside the checkpoint tree (f32 exact)."""
+    np.savez(path,
+             latents=np.asarray(bank.latents, np.float32),
+             count=np.asarray(bank.count, np.int32))
+    return path
+
+
+def load_bank(path: str) -> ReferenceBank:
+    with np.load(path) as z:
+        return ReferenceBank(latents=jnp.asarray(z["latents"]),
+                             count=jnp.asarray(z["count"]))
+
+
+def bank_path(writer, run: int, model_type: str, update_type: str) -> str:
+    """Canonical bank location: the run's Serving tree, next to the
+    calibration JSON (checkpointing/io.py ResultsWriter.serving_dir)."""
+    d = writer.serving_dir(run)
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"{model_type}_{update_type}_knn_bank.npz")

@@ -1,0 +1,268 @@
+"""kNN anomaly scoring as blocked matmul distance tiles + partial top-k.
+
+Score = distance to the k-th nearest neighbor in the gateway's reference
+bank of normal latents (fedmse_tpu/knn/bank.py). The whole computation is
+shaped for the matrix unit, per the TPU-KNN recipe (arxiv 2206.14286):
+
+  * **distance tiles**: ‖q − b‖² expanded to ‖q‖² − 2 q·bᵀ + ‖b‖²
+    (ops/distance.pairwise_sq_dists) — the cross term is one [T, L] x
+    [L, B] matmul with `preferred_element_type=f32` (the PR 5 accumulation
+    contract: distances are anomaly SCORES), instead of a broadcasted
+    subtract that materializes [T, B, L]. An optional Pallas kernel
+    (mirroring ops/pallas_ae.py) computes the tile grid VMEM-resident;
+    the XLA path is identical math and the non-TPU default.
+  * **exact top-k**: per-block partial top-k then merge — split the bank
+    axis into blocks, keep each block's k smallest distances, then top-k
+    over the (num_blocks · k) candidates. Exact by construction (the true
+    k nearest all survive their own block's cut) and it replaces one
+    O(B log B) sort with cheap per-block partial reductions.
+  * **approximate top-k** (TPU-KNN's partial-reduce): keep only each
+    BIN's single minimum, then top-k over the bin minima. The bank order
+    is already a uniform random permutation (bank.downsample_latents's
+    priority draw), so the true neighbors land in uniformly random bins;
+    with `bins ≈ 32·k` the expected recall is ~1 − (k−1)/(2·bins) ≈ 0.99
+    (the paper's recall/cost dial). The approximate k-th distance is
+    always an UPPER bound on the exact one (its candidate set is a
+    subset) — pinned by tests/test_knn.py.
+
+Slots past a gateway's valid `count` are masked to +inf before any
+reduction, so bank padding can never become a neighbor.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fedmse_tpu.knn.bank import pow2_bank_size as pow2_ceil
+from fedmse_tpu.ops.distance import pairwise_sq_dists, sq_norms
+
+LANE = 128
+
+
+# --------------------------- distance tiles ---------------------------- #
+
+def _dist_kernel(x_ref, b_ref, out_ref):
+    """One [block_q, block_b] squared-distance tile, VMEM-resident:
+    row/bank norms recomputed per tile on the VPU (zero-padded lanes
+    contribute exactly 0), cross term on the MXU with f32 accumulation."""
+    x = x_ref[:]
+    b = b_ref[:]
+    qn = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=1, keepdims=True)
+    bn = jnp.sum(jnp.square(b.astype(jnp.float32)), axis=1, keepdims=True)
+    cross = jax.lax.dot_general(
+        x, b, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    out_ref[:] = jnp.maximum(qn - 2.0 * cross + bn.T, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_b",
+                                             "interpret"))
+def _dist_pallas(x_pad: jax.Array, b_pad: jax.Array, block_q: int,
+                 block_b: int, interpret: bool) -> jax.Array:
+    rows, banks = x_pad.shape[0], b_pad.shape[0]
+    grid = (pl.cdiv(rows, block_q), pl.cdiv(banks, block_b))
+    return pl.pallas_call(
+        _dist_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, LANE), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, LANE), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_q, block_b), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, banks), jnp.float32),
+        interpret=interpret,
+    )(x_pad, b_pad)
+
+
+def dist_tiles(q: jax.Array, bank: jax.Array, mode: str = "auto",
+               block_q: int = 1024, block_b: int = 512) -> jax.Array:
+    """All-pairs squared distances [T, L] x [B, L] -> [T, B] f32.
+
+    mode: 'pallas' | 'xla' | 'interpret' | 'auto' (pallas on TPU when the
+    bank tiles cleanly, else XLA — identical math either way; same routing
+    contract as ops/pallas_ae.fused_forward_stats). Operands may be bf16
+    (the policy compute dtype); distances always accumulate and return
+    f32 (ops/distance.py)."""
+    rows, dim = q.shape
+    banks = bank.shape[0]
+    if mode == "auto":
+        # the kernel wants >= one (8, 128) f32 tile per axis; tiny banks
+        # or lanes-overflowing latents route to the identical XLA math
+        ok = (jax.default_backend() == "tpu" and dim <= LANE
+              and banks % LANE == 0)
+        mode = "pallas" if ok else "xla"
+    if mode == "xla":
+        return pairwise_sq_dists(q, bank)
+    if mode not in ("pallas", "interpret"):
+        raise ValueError(f"unknown dist mode {mode!r}; expected "
+                         "'pallas' | 'xla' | 'interpret' | 'auto'")
+    if dim > LANE:
+        raise ValueError(f"pallas distance tiles pack the latent into "
+                         f"{LANE} lanes; got latent_dim={dim}")
+    if banks % LANE:
+        # the bank axis is the output tile's LANE dimension: a bank below
+        # (or not tiling) 128 sits under the Mosaic tile floor — 'auto'
+        # routes such banks to XLA silently, the explicit escape hatch
+        # must fail with the clear error, not a Mosaic lowering crash
+        raise ValueError(
+            f"pallas distance tiles need the bank to tile {LANE} lanes; "
+            f"got {banks} bank rows — use mode='xla' (identical math) or "
+            f"a power-of-two bank size >= {LANE}")
+    block_b = min(block_b, pow2_ceil(banks))
+    # quantize the q block to 16 sublanes: the bf16 minimum tile is
+    # (16, 128) — an (8, 128) bf16 block would sit below Mosaic's floor
+    # (the same constraint that keeps ops/pallas_ae.py's biases f32);
+    # 16 also satisfies the f32 (8, 128) minimum
+    block_q = min(block_q, pl.cdiv(rows, 16) * 16)
+    rows_pad = pl.cdiv(rows, block_q) * block_q
+    banks_pad = pl.cdiv(banks, block_b) * block_b
+    x_pad = jnp.zeros((rows_pad, LANE), q.dtype).at[:rows, :dim].set(q)
+    b_pad = jnp.zeros((banks_pad, LANE), bank.dtype).at[:banks, :dim].set(bank)
+    d = _dist_pallas(x_pad, b_pad, block_q, block_b, mode == "interpret")
+    return d[:rows, :banks]
+
+
+# ------------------------------- top-k --------------------------------- #
+
+def _blocked_smallest_k(d: jax.Array, k: int, block: int) -> jax.Array:
+    """[T, B] -> [T, k] smallest distances ascending, via per-block
+    partial top-k then merge (exact: each block keeps its own k, so the
+    true k nearest all survive their block's cut)."""
+    t, b = d.shape
+    block = min(block, b)
+    if b % block:
+        block = b  # ragged banks: single block (b is pow2 in practice)
+    nb = b // block
+    kk = min(k, block)
+    part = -jax.lax.top_k(-d.reshape(t, nb, block), kk)[0]  # [T, nb, kk]
+    cand = part.reshape(t, nb * kk)
+    if cand.shape[1] < k:  # bank smaller than k: pad candidates with +inf
+        cand = jnp.concatenate(
+            [cand, jnp.full((t, k - cand.shape[1]), jnp.inf)], axis=1)
+    return -jax.lax.top_k(-cand, k)[0]
+
+
+def _binned_smallest_k(d: jax.Array, k: int, bins: int) -> jax.Array:
+    """[T, B] -> [T, k] approximate smallest: each bin contributes only
+    its MINIMUM (TPU-KNN partial reduce), top-k over the bin minima.
+
+    Bins are STRIDED (slot i -> bin i % bins), not contiguous: a ragged
+    bank's valid rows occupy the FIRST count slots, so contiguous bins
+    would cram them into ceil(count/width) bins — count < k·width would
+    leave fewer than k finite minima (+inf kth distance for every query)
+    and even count ≥ k·width confines the candidates to a fraction of the
+    bins, silently degrading recall. Strided bins spread the valid prefix
+    round-robin across ALL bins: every bin holds ~count/bins valid slots,
+    and when count <= bins each valid row IS its own candidate (the
+    approximation degenerates to exact). Bank order is a uniform random
+    permutation either way (bank.downsample_latents), so the recall
+    argument is unchanged for full banks."""
+    t, b = d.shape
+    bins = min(bins, b)
+    if b % bins:
+        bins = b
+    mins = jnp.min(d.reshape(t, b // bins, bins), axis=1)  # [T, bins]
+    if bins < k:
+        mins = jnp.concatenate(
+            [mins, jnp.full((t, k - bins), jnp.inf)], axis=1)
+    return -jax.lax.top_k(-mins, k)[0]
+
+
+def _smallest_k(d: jax.Array, k: int, topk: str, block: int,
+                approx_oversample: int) -> jax.Array:
+    """The ONE topk dispatch (shared by the single-bank and routed
+    entries): exact -> per-block partial top-k + merge, approx -> per-bin
+    partial reduce with bins = pow2(k · oversample)."""
+    if topk == "exact":
+        return _blocked_smallest_k(d, k, block)
+    if topk == "approx":
+        return _binned_smallest_k(d, k, pow2_ceil(k * approx_oversample))
+    raise ValueError(f"unknown topk {topk!r}; expected 'exact' | 'approx'")
+
+
+def knn_smallest_k(q: jax.Array, bank: jax.Array, count, k: int,
+                   topk: str = "exact", dist_mode: str = "auto",
+                   block: int = 512, approx_oversample: int = 32
+                   ) -> jax.Array:
+    """[T, k] smallest squared bank distances, ascending; padding slots
+    (>= count) masked +inf first so they can never be neighbors."""
+    d = dist_tiles(q, bank, mode=dist_mode)
+    d = jnp.where(jnp.arange(bank.shape[0])[None, :] < count, d, jnp.inf)
+    return _smallest_k(d, k, topk, block, approx_oversample)
+
+
+def _kth_of_smallest(smallest: jax.Array, counts, k: int) -> jax.Array:
+    """[T, k] ascending candidates + per-row valid counts -> the kth-
+    neighbor score [T] f32. A row whose gateway holds fewer than k valid
+    latents scores against its farthest available neighbor (index
+    min(k, count) − 1); an EMPTY bank scores 0 — pad gateways must emit
+    finite scores, their rows are masked out of every metric downstream."""
+    t = smallest.shape[0]
+    idx = jnp.clip(jnp.minimum(k, counts) - 1, 0, k - 1)
+    kth = jnp.take_along_axis(
+        smallest, jnp.broadcast_to(idx, (t,))[:, None], axis=1)[:, 0]
+    return jnp.where(jnp.broadcast_to(counts, (t,)) > 0,
+                     jnp.sqrt(kth), 0.0)
+
+
+def knn_kth_distance(q: jax.Array, bank: jax.Array, count, k: int,
+                     topk: str = "exact", dist_mode: str = "auto",
+                     block: int = 512) -> jax.Array:
+    """The anomaly score [T]: Euclidean distance to the k-th nearest bank
+    latent (f32), one gateway's bank."""
+    smallest = knn_smallest_k(q, bank, count, k, topk=topk,
+                              dist_mode=dist_mode, block=block)
+    return _kth_of_smallest(smallest, count, k)
+
+
+def routed_kth_distance(latents: jax.Array, gw: jax.Array, bank, k: int,
+                        topk: str = "exact", block: int = 512,
+                        approx_oversample: int = 32,
+                        max_onehot_cols: int = 4096) -> jax.Array:
+    """Multi-tenant kth-distance: row i scores against gateway gw[i]'s bank
+    out of a stacked knn.ReferenceBank — the serving engine's bucketed
+    scorer path (serving/engine.py).
+
+    The naive routing — gather each row's [B, L] bank then a batched
+    matvec — moves b·B·L bank bytes per dispatch and runs the cross term
+    at vector-unit intensity (measured 10x the MSE scorer at batch 1024).
+    Instead the routing is ENCODED IN THE OPERAND: expand each latent into
+    a one-hot-gateway block vector A[i] = e_{gw[i]} ⊗ lat[i] of length
+    N·L, so the cross term is ONE dense [b, N·L] x [N·L, B] matmul with
+    f32 accumulation — rows contract only against their own gateway's
+    slice (the other N−1 blocks are exact zeros), the bank tensor moves
+    once (N·B·L bytes, not b·B·L), and the matrix unit runs dense. Same
+    math as the gather path to f32 association (the extra terms are
+    exactly 0.0); measured 6x faster at N=10, B=1024, batch 1024 — 1.6x
+    of the MSE scorer. Past `max_onehot_cols` (N·L) the one-hot operand's
+    N× zero-redundancy stops paying and the per-row gather takes over —
+    big-N multi-tenancy trades bank bytes for dense-matmul redundancy."""
+    n, b_, l = bank.latents.shape
+    counts = bank.count[gw]
+    if n * l <= max_onehot_cols:
+        lat = latents.astype(jnp.float32)
+        oh = jax.nn.one_hot(gw, n, dtype=jnp.float32)
+        a = (oh[:, :, None] * lat[:, None, :]).reshape(lat.shape[0], n * l)
+        w = bank.latents.transpose(0, 2, 1).reshape(n * l, b_)
+        cross = jax.lax.dot_general(a, w, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+        d = jnp.maximum(
+            sq_norms(lat)[:, None] - 2.0 * cross + sq_norms(bank.latents)[gw],
+            0.0)
+    else:
+        row_banks = bank.latents[gw]
+        d = jax.vmap(lambda x, bk: pairwise_sq_dists(x[None], bk)[0])(
+            latents, row_banks)
+    d = jnp.where(jnp.arange(b_)[None, :] < counts[:, None], d, jnp.inf)
+    return _kth_of_smallest(
+        _smallest_k(d, k, topk, block, approx_oversample), counts, k)

@@ -140,8 +140,17 @@ def init_client_params(model: nn.Module, rng: jax.Array) -> Dict[str, Any]:
 
 def init_stacked_params(model: nn.Module, rng: jax.Array, n_clients: int):
     """Independent per-client inits stacked on a leading `clients` axis —
-    the vectorized analog of constructing N torch models (src/main.py:225-236)."""
-    rngs = jax.random.split(rng, n_clients)
+    the vectorized analog of constructing N torch models (src/main.py:225-236).
+
+    Keys come from `fold_in(rng, client_index)`, NOT `split(rng, n_clients)`:
+    split has no prefix property, so under split a real client's init
+    weights changed whenever the PADDED axis length changed — i.e. results
+    depended on the mesh size the run happened to pad for (the root cause
+    of the long-standing test_round_with_padded_clients_matches_unpadded
+    seed failure — PARITY.md §8; rule + rationale:
+    utils/seeding.fold_in_keys)."""
+    from fedmse_tpu.utils.seeding import fold_in_keys
+    rngs = fold_in_keys(rng, n_clients)
     return jax.vmap(lambda r: init_client_params(model, r))(rngs)
 
 

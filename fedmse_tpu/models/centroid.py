@@ -20,6 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from fedmse_tpu.ops.distance import norm_to_origin
 from fedmse_tpu.ops.stats import masked_mean_std, masked_percentile
 
 
@@ -35,14 +36,13 @@ class CentroidClassifier:
     def get_density(self, x: jax.Array, scale: bool = True) -> jax.Array:
         """Distance to the origin of standardized latents (Centroid.py:30-35).
 
-        The norm accumulates in f32: this is the hybrid model's anomaly
+        The norm accumulates in f32 (ops/distance.norm_to_origin — the one
+        home of origin-distance math): this is the hybrid model's anomaly
         SCORE, and the fitted mean/scale are f32 masters — bf16 latents
         upcast exactly, f32 latents are untouched (ops/precision.py)."""
         if scale:
             x = (x - self.mean) / self.scale  # f32 stats promote x to f32
-        if x.dtype != jnp.float32:
-            x = x.astype(jnp.float32)
-        return jnp.linalg.norm(x, axis=-1)
+        return norm_to_origin(x)
 
     def predict(self, x: jax.Array) -> jax.Array:
         """Boolean anomaly prediction (Centroid.py:37-39)."""
@@ -60,6 +60,6 @@ def fit_centroid(train_latent: jax.Array,
     mean, scale = masked_mean_std(train_latent, mask, ddof=0)
     scale = jnp.where(scale == 0.0, 1.0, scale)
     scaled = (train_latent - mean) / scale
-    dists = jnp.linalg.norm(scaled, axis=-1)
+    dists = norm_to_origin(scaled)
     abs_threshold = masked_percentile(dists, 100.0 * threshold, mask)
     return CentroidClassifier(mean=mean, scale=scale, abs_threshold=abs_threshold)

@@ -1,3 +1,9 @@
+from fedmse_tpu.ops.distance import (
+    mahalanobis_sq,
+    norm_to_origin,
+    pairwise_sq_dists,
+    sq_norms,
+)
 from fedmse_tpu.ops.losses import (
     masked_mean,
     mse_loss,
@@ -17,14 +23,18 @@ __all__ = [
     "PrecisionPolicy",
     "classification_metrics",
     "get_policy",
+    "mahalanobis_sq",
     "masked_auc",
     "masked_mean",
     "masked_mean_std",
     "masked_percentile",
     "mse_loss",
+    "norm_to_origin",
+    "pairwise_sq_dists",
     "per_sample_mse",
     "prox_term",
     "roc_auc",
     "shrink_loss",
+    "sq_norms",
     "tree_cast",
 ]

@@ -1,0 +1,69 @@
+"""Pairwise/origin distance math with f32 accumulation pinned — ONE home.
+
+Three score paths used to each carry their own distance formula: the
+centroid classifier's distance-to-origin (models/centroid.py), the
+host-side Gaussian-divergence analytics' Mahalanobis form
+(utils/similarity.py), and — with fedmse_tpu/knn/ — the blocked
+query-to-bank distance tiles of the kNN scorer. Every one of them is a
+score surface (ops/precision.py: accumulation dtype is a correctness
+knob, not a quality knob), so the math lives here once with the f32
+contract pinned:
+
+  * `sq_norms` / `norm_to_origin` — row squared-norms / L2 norms, f32
+    accumulation whatever the operand dtype (bf16 latents upcast before
+    the square; f32 inputs are bit-identical to the unannotated formula).
+  * `pairwise_sq_dists` — the MIPS-style blocked-distance identity
+    ‖q − b‖² = ‖q‖² − 2 q·bᵀ + ‖b‖² (TPU-KNN, arxiv 2206.14286): the
+    cross term is ONE matmul that runs at matrix-unit FLOP/s with
+    `preferred_element_type=f32`, instead of the O(Q·B·L) broadcast
+    subtract XLA would materialize for the naive form. Clamped at 0 —
+    the identity can go infinitesimally negative under float
+    cancellation for near-identical rows.
+  * `mahalanobis_sq` — host-side numpy quadratic form diffᵀ Σ⁻¹ diff
+    (similarity.py's closed-form Gaussian KL), f64 like the rest of that
+    offline-analytics path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# score/distance accumulation dtype (PrecisionPolicy.accum_dtype is always
+# f32; pinned here so distance math cannot silently follow a bf16 operand)
+ACCUM = jnp.float32
+
+
+def sq_norms(x: jax.Array) -> jax.Array:
+    """Row squared L2 norms over the last axis, f32 accumulation/output."""
+    return jnp.sum(jnp.square(x.astype(ACCUM)), axis=-1, dtype=ACCUM)
+
+
+def norm_to_origin(x: jax.Array) -> jax.Array:
+    """Row L2 norms over the last axis (the centroid density score —
+    models/centroid.py get_density): f32 accumulation/output."""
+    if x.dtype != ACCUM:
+        x = x.astype(ACCUM)
+    return jnp.linalg.norm(x, axis=-1)
+
+
+def pairwise_sq_dists(q: jax.Array, b: jax.Array) -> jax.Array:
+    """All-pairs squared Euclidean distances [Q, L] x [B, L] -> [Q, B].
+
+    ‖q‖² − 2 q·bᵀ + ‖b‖² with the cross term accumulating f32 on the
+    matrix unit (`preferred_element_type`) — operands may be bf16 (the
+    policy's compute dtype), the distances are always f32. Clamped at 0:
+    float cancellation can drive the identity a few ulp negative for
+    near-coincident rows, and a negative squared distance would NaN the
+    sqrt downstream."""
+    cross = jnp.dot(q, b.T, preferred_element_type=ACCUM)
+    d = sq_norms(q)[:, None] - 2.0 * cross + sq_norms(b)[None, :]
+    return jnp.maximum(d, 0.0)
+
+
+def mahalanobis_sq(diff: np.ndarray, cov_inv: np.ndarray) -> float:
+    """Quadratic form diffᵀ Σ⁻¹ diff (host-side numpy, f64 accumulation —
+    the Gaussian-KL analytics path, utils/similarity.py)."""
+    diff = np.asarray(diff, dtype=np.float64)
+    return float(diff.T @ np.asarray(cov_inv, dtype=np.float64) @ diff)

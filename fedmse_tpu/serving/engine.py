@@ -79,8 +79,21 @@ class ServingEngine:
         'hybrid' (score = centroid density of the latent).
     params : single param tree (multi_tenant=False) or stacked [N, ...]
         pytree (multi_tenant=True).
-    centroids : CentroidClassifier pytree — required for 'hybrid'; single
-        (multi_tenant=False) or leaves stacked [N, ...] (multi_tenant=True).
+    centroids : CentroidClassifier pytree — required for the centroid
+        score; single (multi_tenant=False) or leaves stacked [N, ...]
+        (multi_tenant=True).
+    banks : knn.ReferenceBank — required for score_kind='knn'; stacked
+        [N, B, L] (multi_tenant=True) or a single gateway's [1, B, L].
+    score_kind : 'auto' (default; the reference pairing — model_type
+        decides: autoencoder -> 'mse', hybrid -> 'centroid'), or an
+        explicit 'mse' | 'centroid' | 'knn' orthogonal to model_type.
+        'knn' serves bank lookups inside the bucketed scorer: each row's
+        latent scores against ITS gateway's bank (distance to the
+        knn_k-th neighbor — fedmse_tpu/knn/score.py blocked distance
+        tiles, f32 accumulation), gathered per row out of the stacked
+        bank exactly like params/centroids. Per-gateway kth-distance
+        thresholds come from the ordinary `fit_calibration` path — it
+        calibrates through engine.score, whatever the score kind.
     max_bucket : largest compiled row bucket; larger requests are chunked.
     precision : 'f32' (default, bit-identical to the pre-policy engine) or
         'bf16' (or a PrecisionPolicy, ops/precision.py). Under bf16 the
@@ -99,14 +112,21 @@ class ServingEngine:
     """
 
     def __init__(self, model, model_type: str, params: Any,
-                 centroids: Any = None, *, multi_tenant: bool = True,
+                 centroids: Any = None, *, banks: Any = None,
+                 score_kind: str = "auto", knn_k: int = 8,
+                 knn_topk: str = "exact", multi_tenant: bool = True,
                  max_bucket: int = 1024,
                  precision: Union[str, PrecisionPolicy] = "f32"):
+        from fedmse_tpu.evaluation.evaluator import resolve_score_kind
         if model_type not in ("autoencoder", "hybrid"):
             raise ValueError(f"unknown model_type {model_type!r}")
-        if model_type == "hybrid" and centroids is None:
-            raise ValueError("hybrid serving needs fitted centroids "
+        score_kind = resolve_score_kind(model_type, score_kind)
+        if score_kind == "centroid" and centroids is None:
+            raise ValueError("centroid serving needs fitted centroids "
                              "(fit_gateway_centroids)")
+        if score_kind == "knn" and banks is None:
+            raise ValueError("knn serving needs reference banks "
+                             "(knn.build_banks / knn.load_bank)")
         if max_bucket < 1:
             raise ValueError(f"max_bucket must be >= 1, got {max_bucket}")
         self.policy = get_policy(precision)
@@ -127,10 +147,30 @@ class ServingEngine:
         # the latent before the distance — a score-deciding statistic
         self.centroids = (None if centroids is None
                           else jax.tree.map(jnp.asarray, centroids))
+        # reference banks likewise stay f32 masters (the latents the
+        # kth-distance is measured against; distances accumulate f32)
+        self.banks = (None if banks is None
+                      else jax.tree.map(jnp.asarray, banks))
+        self.score_kind = score_kind
+        self.knn_k = knn_k
+        self.knn_topk = knn_topk
         self.multi_tenant = multi_tenant
         self.max_bucket = 1 << (max_bucket - 1).bit_length()  # round up pow2
         self.num_gateways = (
             jax.tree.leaves(params)[0].shape[0] if multi_tenant else 1)
+        if self.banks is not None \
+                and self.banks.num_gateways != self.num_gateways:
+            # a stale persisted bank must fail HERE: inside jit the bank
+            # gathers clamp out-of-range gateway indices silently (and
+            # the single-tenant path takes banks[0] unchecked), which
+            # would score rows against the wrong gateway's bank — finite,
+            # plausible-looking, wrong. Single-tenant engines require a
+            # [1, B, L] bank for the same reason.
+            raise ValueError(
+                f"banks hold {self.banks.num_gateways} gateways but this "
+                f"{'multi-tenant' if multi_tenant else 'single-tenant'} "
+                f"engine serves {self.num_gateways}; was the bank "
+                f"persisted from a different federation?")
         self.dim = int(model.input_dim)
         self._score_fn: Optional[Any] = None
         self.dispatches: collections.Counter = collections.Counter()
@@ -154,19 +194,32 @@ class ServingEngine:
         return 1 << max(0, n_rows - 1).bit_length()
 
     def _build_scorer(self):
-        model, model_type = self.model, self.model_type
-        params, centroids = self.params, self.centroids
+        model, kind = self.model, self.score_kind
+        params, centroids, banks = self.params, self.centroids, self.banks
+        knn_k, knn_topk = self.knn_k, self.knn_topk
+        if kind == "knn":
+            from fedmse_tpu.knn import knn_kth_distance, routed_kth_distance
 
         if self.multi_tenant:
             def score_rows(x, gw):
                 # per-row gateway routing: gather each row's model (and
-                # centroid) out of the stacked federation pytree
+                # centroid) out of the stacked federation pytree; the kNN
+                # bank routing is instead ENCODED IN THE OPERAND (one-hot
+                # block latents -> one dense matmul against all banks,
+                # knn/score.routed_kth_distance) — a per-row bank gather
+                # would move b·B·L bytes per dispatch
                 row_params = jax.tree.map(lambda t: t[gw], params)
-                if model_type == "autoencoder":
+                if kind == "mse":
                     def one(p, xi):
                         _, recon = model.apply({"params": p}, xi)
                         return per_sample_mse(xi, recon)
                     scores = jax.vmap(one)(row_params, x)
+                elif kind == "knn":
+                    latents = jax.vmap(
+                        lambda p, xi: model.apply({"params": p}, xi)[0])(
+                            row_params, x)
+                    scores = routed_kth_distance(latents, gw, banks, knn_k,
+                                                 topk=knn_topk)
                 else:
                     row_cens = jax.tree.map(lambda t: t[gw], centroids)
                     def one(p, c, xi):
@@ -179,8 +232,12 @@ class ServingEngine:
             def score_rows(x, gw):
                 del gw  # single-global: every row scores under one model
                 latent, recon = model.apply({"params": params}, x)
-                if model_type == "autoencoder":
+                if kind == "mse":
                     scores = per_sample_mse(x, recon)
+                elif kind == "knn":
+                    one = jax.tree.map(lambda t: t[0], banks)
+                    scores = knn_kth_distance(latent, one.latents, one.count,
+                                              knn_k, topk=knn_topk)
                 else:
                     scores = centroids.get_density(latent)
                 return jnp.nan_to_num(scores)
@@ -270,19 +327,35 @@ class ServingEngine:
 
     @classmethod
     def from_federation(cls, model, model_type: str, stacked_params,
-                        train_x=None, train_m=None, **kw) -> "ServingEngine":
+                        train_x=None, train_m=None, *, score_kind="auto",
+                        banks=None, knn_bank_size: int = 1024,
+                        knn_seed: int = 0, **kw) -> "ServingEngine":
         """Multi-tenant engine straight from an in-memory training result
-        (`engine.states.params`). Hybrid needs the training rows (the
-        FederatedData train_xb/train_mb slices) to fit the centroids."""
+        (`engine.states.params`). The centroid score needs the training
+        rows (the FederatedData train_xb/train_mb slices) to fit the
+        centroids; score_kind='knn' builds the per-gateway reference banks
+        from the same rows (knn.build_banks) unless a prebuilt/reloaded
+        `banks` is passed (the persisted-bank deployment path)."""
+        from fedmse_tpu.evaluation.evaluator import resolve_score_kind
+        kind = resolve_score_kind(model_type, score_kind)
         centroids = None
-        if model_type == "hybrid":
+        if kind == "centroid":
             if train_x is None:
-                raise ValueError("hybrid serving needs train rows to fit "
+                raise ValueError("centroid serving needs train rows to fit "
                                  "the per-gateway centroids")
             centroids = fit_gateway_centroids(model, stacked_params,
                                               train_x, train_m)
+        if kind == "knn" and banks is None:
+            if train_x is None:
+                raise ValueError("knn serving needs train rows (or a "
+                                 "prebuilt `banks`) to build the "
+                                 "per-gateway reference banks")
+            from fedmse_tpu.knn import build_banks
+            banks = build_banks(model, stacked_params, train_x, train_m,
+                                bank_size=knn_bank_size, seed=knn_seed)
         return cls(model, model_type, stacked_params, centroids,
-                   multi_tenant=True, **kw)
+                   banks=banks, score_kind=score_kind, multi_tenant=True,
+                   **kw)
 
     @classmethod
     def from_checkpoint(cls, writer, model, model_type: str,

@@ -71,7 +71,17 @@ def run_serve_smoke(cfg, data, n_real: int, writer, device_names: Sequence[str],
         run=run,
         train_x=np.asarray(data.train_xb[:n_real]),
         train_m=np.asarray(data.train_mb[:n_real]),
-        max_bucket=max_batch, precision=cfg.precision)
+        max_bucket=max_batch, precision=cfg.precision,
+        score_kind=cfg.score_kind, knn_bank_size=cfg.knn_bank_size,
+        knn_k=cfg.knn_k, knn_topk=cfg.knn_topk)
+    bank_file = None
+    if engine.score_kind == "knn":
+        # persist the reference banks beside the checkpoint tree, so a
+        # serving process can reload them with no training-side state
+        # (fedmse_tpu/knn/bank.py; the calibration JSON's twin)
+        from fedmse_tpu.knn import bank_path, save_bank
+        bank_file = save_bank(
+            bank_path(writer, run, model_type, update_type), engine.banks)
     calib = fit_calibration(engine, np.asarray(data.valid_x[:n_real]),
                             np.asarray(data.valid_m[:n_real]),
                             percentile=percentile)
@@ -113,6 +123,8 @@ def run_serve_smoke(cfg, data, n_real: int, writer, device_names: Sequence[str],
         "run": run,
         "gateways": n_real,
         "rows": int(len(rows)),
+        "score_kind": engine.score_kind,
+        "knn_bank_path": bank_file,
         "calibration_path": calib_path,
         "calibration_percentile": percentile,
         "verdict_anomaly_rate": (float(np.mean(verdicts))

@@ -35,6 +35,21 @@ import numpy as np
 CHAOS_STREAM_TAG = 0x4348414F  # "CHAO"
 
 
+def fold_in_keys(key: jax.Array, n: int) -> jax.Array:
+    """[n] per-index keys `fold_in(key, i)` — the ONE home of the
+    padding-invariance key rule (PARITY.md §8): index i's key depends only
+    on i and the base key. `jax.random.split(key, n)` has NO prefix
+    property (split(k, 4) shares nothing with the first 4 keys of
+    split(k, 8)), so anything keyed by split over a PADDED axis silently
+    changes when the padding changes — which is how mesh size leaked into
+    seeded science results until round 9. Callers: per-client init
+    (models/autoencoder.py), vote tie-break streams (federation/
+    voting.py), kNN bank downsample keys (knn/bank.py, evaluation/
+    evaluator.py — their equality is the persisted-vs-in-program bank
+    parity contract)."""
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(n))
+
+
 def set_seeds(seed: int) -> None:
     """Global fallback seeding (reference set_seeds, src/main.py:73-78)."""
     _pyrandom.seed(seed)

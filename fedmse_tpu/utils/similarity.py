@@ -16,6 +16,8 @@ import numpy as np
 from scipy.spatial.distance import jensenshannon
 from sklearn.neighbors import KernelDensity
 
+from fedmse_tpu.ops.distance import mahalanobis_sq
+
 
 def similarity_score(dev_kde_scores: np.ndarray, dataset_2: np.ndarray) -> float:
     """JS divergence between exp(KDE log-scores) of dev data and dataset_2."""
@@ -30,8 +32,9 @@ def kl_divergence(p_mean: np.ndarray, p_cov: np.ndarray,
     k = p_mean.shape[0]
     q_cov_inv = np.linalg.inv(q_cov)
     tr = np.trace(q_cov_inv @ p_cov)
-    diff = q_mean - p_mean
-    mahalanobis = float(diff.T @ q_cov_inv @ diff)
+    # quadratic-form distance from the shared ops/ helper (ops/distance.py
+    # is the one home of distance math across centroid/knn/analytics)
+    mahalanobis = mahalanobis_sq(q_mean - p_mean, q_cov_inv)
     det_ratio = float(np.log(np.linalg.det(q_cov) / np.linalg.det(p_cov)))
     return 0.5 * (tr + mahalanobis - k + det_ratio)
 

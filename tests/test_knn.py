@@ -1,0 +1,464 @@
+"""kNN scorer tests (fedmse_tpu/knn/): sklearn NearestNeighbors parity for
+the exact blocked top-k (every bucket size, both model types, through a
+checkpoint round-trip), the approximate-vs-exact recall bound, the
+bf16-input/f32-accum contract of the distance tiles, bank lifecycle
+(downsample / padding-invariance / persistence), and the score_kind
+wiring through evaluator, serving engine, config and driver."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.checkpointing import ResultsWriter, save_client_models
+from fedmse_tpu.evaluation import make_evaluate_all
+from fedmse_tpu.knn import (ReferenceBank, bank_path, build_banks,
+                            downsample_latents, knn_kth_distance,
+                            knn_smallest_k, load_bank, pow2_bank_size,
+                            save_bank)
+from fedmse_tpu.knn.score import dist_tiles
+from fedmse_tpu.models import init_stacked_params, make_model
+from fedmse_tpu.ops.distance import pairwise_sq_dists
+from fedmse_tpu.serving import ServingEngine
+
+pytestmark = pytest.mark.knn
+
+DIM = 12
+N = 3
+
+
+def _data(seed=0, t=90):
+    rng = np.random.default_rng(seed)
+    test_x = rng.normal(size=(N, t, DIM)).astype(np.float32)
+    test_m = (rng.random((N, t)) < 0.9).astype(np.float32)
+    test_y = (rng.random((N, t)) < 0.4).astype(np.float32)
+    train_xb = rng.normal(size=(N, 6, 10, DIM)).astype(np.float32)
+    train_mb = np.ones((N, 6, 10), np.float32)
+    return test_x, test_m, test_y, train_xb, train_mb
+
+
+# ------------------------ exact top-k: sklearn parity ------------------------ #
+
+@pytest.mark.parametrize("bank_size,k,count", [
+    (128, 8, 128), (256, 5, 100), (512, 8, 512), (32, 8, 3), (64, 1, 64),
+])
+def test_exact_kth_distance_matches_sklearn(bank_size, k, count):
+    """The blocked partial-top-k merge is EXACT: the kth distance equals
+    sklearn NearestNeighbors on the same (valid) bank rows — including
+    ragged banks (count < bank_size) and banks smaller than k."""
+    from sklearn.neighbors import NearestNeighbors
+
+    rng = np.random.default_rng(bank_size + k)
+    bank = rng.normal(size=(bank_size, 7)).astype(np.float32)
+    q = rng.normal(size=(41, 7)).astype(np.float32)
+    got = np.asarray(knn_kth_distance(jnp.asarray(q), jnp.asarray(bank),
+                                      count, k))
+    kk = min(k, count)
+    nn = NearestNeighbors(n_neighbors=kk).fit(bank[:count])
+    want = nn.kneighbors(q)[0][:, kk - 1]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_blocked_merge_equals_single_block():
+    """Per-block partial top-k + merge == the unblocked top-k (the exactness
+    argument: every true neighbor survives its own block's cut)."""
+    rng = np.random.default_rng(1)
+    bank = jnp.asarray(rng.normal(size=(1024, 7)).astype(np.float32))
+    q = jnp.asarray(rng.normal(size=(17, 7)).astype(np.float32))
+    a = np.asarray(knn_smallest_k(q, bank, 1024, 8, block=128))
+    b = np.asarray(knn_smallest_k(q, bank, 1024, 8, block=1024))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------- approx top-k: recall bound ---------------------- #
+
+def test_approx_upper_bounds_exact_and_recall():
+    """The approximate kth distance can never UNDERSHOOT the exact one
+    (its candidate set is a subset of the bank), and with bins ≈ 32·k the
+    per-bin partial reduce keeps expected recall ≈ 1 − (k−1)/(2·bins) —
+    asserted with slack at ≥ 0.9 over the true neighbor sets."""
+    from sklearn.neighbors import NearestNeighbors
+
+    rng = np.random.default_rng(2)
+    k = 8
+    bank = rng.normal(size=(4096, 7)).astype(np.float32)
+    q = rng.normal(size=(128, 7)).astype(np.float32)
+    exact = np.asarray(knn_kth_distance(jnp.asarray(q), jnp.asarray(bank),
+                                        4096, k))
+    approx = np.asarray(knn_kth_distance(jnp.asarray(q), jnp.asarray(bank),
+                                         4096, k, topk="approx"))
+    assert np.all(approx >= exact - 1e-6)
+
+    # recall: how many of the true k nearest the approx candidates kept —
+    # reconstructed from the approx smallest-k distances (a true neighbor
+    # was found iff its exact distance appears among the approx top-k)
+    ap_sets = np.sqrt(np.asarray(knn_smallest_k(
+        jnp.asarray(q), jnp.asarray(bank), 4096, k,
+        topk="approx")))  # smallest-k returns SQUARED distances
+    nn = NearestNeighbors(n_neighbors=k).fit(bank)
+    true_d = nn.kneighbors(q)[0]
+    hits = sum(np.isclose(ap_sets[i][:, None], true_d[i][None, :],
+                          rtol=1e-5, atol=1e-6).any(axis=0).sum()
+               for i in range(len(q)))
+    recall = hits / (len(q) * k)
+    # bins = pow2(32·8) = 256 -> expected ≈ 1 − 7/512 ≈ 0.986
+    assert recall >= 0.9, recall
+
+
+# ------------------- distance tiles: precision contract ------------------- #
+
+def test_distance_tiles_bf16_inputs_f32_accumulation():
+    """bf16 operands, f32 distances: the tile output dtype is float32 and
+    matches f64 math on the bf16-ROUNDED inputs to f32-accumulation
+    precision — a bf16 accumulator would be ~256x looser."""
+    rng = np.random.default_rng(3)
+    q64 = rng.normal(size=(64, 7))
+    b64 = rng.normal(size=(256, 7))
+    qb = jnp.asarray(q64, jnp.bfloat16)
+    bb = jnp.asarray(b64, jnp.bfloat16)
+    d = pairwise_sq_dists(qb, bb)
+    assert d.dtype == jnp.float32
+    # f64 reference on the SAME quantized operands: only accumulation
+    # precision separates the two
+    qr = np.asarray(qb, np.float64)
+    br = np.asarray(bb, np.float64)
+    want = ((qr ** 2).sum(1)[:, None] - 2 * qr @ br.T
+            + (br ** 2).sum(1)[None, :])
+    err = np.abs(np.asarray(d, np.float64) - want).max()
+    assert err < 1e-4, err  # f32 accumulation; bf16 accum would be ~1e-1
+
+    # f32 operands are bit-identical to the plain f32 formula
+    qf, bf = jnp.asarray(q64, jnp.float32), jnp.asarray(b64, jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(pairwise_sq_dists(qf, bf)),
+        np.asarray(jnp.maximum(
+            jnp.sum(qf * qf, axis=1)[:, None]
+            - 2.0 * qf @ bf.T + jnp.sum(bf * bf, axis=1)[None, :], 0.0)))
+
+
+def test_pallas_interpret_tile_matches_xla():
+    """The Pallas distance-tile kernel (interpret mode on CPU) computes the
+    identical tile math as the XLA path — same contract as
+    ops/pallas_ae.py's kernel-vs-XLA pin."""
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(50, 7)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(256, 7)).astype(np.float32))
+    dx = np.asarray(dist_tiles(q, b, mode="xla"))
+    di = np.asarray(dist_tiles(q, b, mode="interpret"))
+    np.testing.assert_allclose(dx, di, rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="mode"):
+        dist_tiles(q, b, mode="nope")
+
+
+def test_centroid_density_unchanged_by_distance_hoist():
+    """models/centroid.get_density now routes through ops/distance
+    .norm_to_origin — bit-identical to the inlined formula it replaced."""
+    from fedmse_tpu.models.centroid import fit_centroid
+
+    rng = np.random.default_rng(5)
+    lat = jnp.asarray(rng.normal(size=(100, 7)).astype(np.float32))
+    cen = fit_centroid(lat)
+    got = np.asarray(cen.get_density(lat))
+    want = np.asarray(jnp.linalg.norm((lat - cen.mean) / cen.scale, axis=-1))
+    np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------ bank lifecycle ------------------------------ #
+
+def test_downsample_uniform_subset_and_caps():
+    rng = np.random.default_rng(6)
+    lat = jnp.asarray(rng.normal(size=(300, 7)).astype(np.float32))
+    mask = jnp.asarray((np.arange(300) < 200).astype(np.float32))
+    bank, count = downsample_latents(lat, mask, 128, jax.random.key(1))
+    assert int(count) == 128 and bank.shape == (128, 7)
+    # every bank row IS a valid latent row (a sample, not an aggregate);
+    # float cancellation in the ‖q‖²−2qb+‖b‖² identity leaves ~1e-6
+    # residue on exactly-coincident rows
+    d = np.asarray(pairwise_sq_dists(bank, lat[:200]))
+    assert (d.min(axis=1) < 1e-5).all()
+    # capacity above the valid rows: keep all, zero the padding slots
+    bank2, count2 = downsample_latents(lat, mask, 512, jax.random.key(1))
+    assert int(count2) == 200 and bank2.shape == (512, 7)
+    assert np.abs(np.asarray(bank2)[200:]).max() == 0.0
+    # reproducible per key, different across keys
+    bank3, _ = downsample_latents(lat, mask, 128, jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(bank), np.asarray(bank3))
+    bank4, _ = downsample_latents(lat, mask, 128, jax.random.key(2))
+    assert not np.array_equal(np.asarray(bank), np.asarray(bank4))
+    assert pow2_bank_size(100) == 128 and pow2_bank_size(128) == 128
+    with pytest.raises(ValueError, match="bank_size"):
+        pow2_bank_size(0)
+
+
+def test_build_banks_padding_invariant_and_roundtrip(tmp_path):
+    """Gateway i's bank depends only on its own rows + absolute index
+    (fold_in keys): padding the client axis must not move it. Persistence
+    round-trips exactly (npz beside the checkpoint tree)."""
+    model = make_model("hybrid", DIM, shrink_lambda=1.0)
+    params = init_stacked_params(model, jax.random.key(0), N + 2)
+    _, _, _, train_xb, train_mb = _data()
+    pad_xb = np.concatenate([train_xb, np.zeros_like(train_xb[:2])])
+    pad_mb = np.concatenate([train_mb, np.zeros_like(train_mb[:2])])
+    b1 = build_banks(model, jax.tree.map(lambda t: t[:N], params),
+                     train_xb, train_mb, bank_size=32)
+    b2 = build_banks(model, params, pad_xb, pad_mb, bank_size=32)
+    np.testing.assert_array_equal(np.asarray(b1.latents),
+                                  np.asarray(b2.latents)[:N])
+    np.testing.assert_array_equal(np.asarray(b1.count),
+                                  np.asarray(b2.count)[:N])
+    # zero-mask pad gateways carry empty banks
+    assert np.asarray(b2.count)[N:].tolist() == [0, 0]
+    assert b1.num_gateways == N and b1.bank_size == 32 and b1.latent_dim == 7
+
+    path = save_bank(os.path.join(str(tmp_path), "bank.npz"), b1)
+    back = load_bank(path)
+    np.testing.assert_array_equal(np.asarray(b1.latents),
+                                  np.asarray(back.latents))
+    np.testing.assert_array_equal(np.asarray(b1.count),
+                                  np.asarray(back.count))
+
+
+# ----------------- serving parity through checkpoint round-trip ----------------- #
+
+@pytest.mark.parametrize("model_type", ["autoencoder", "hybrid"])
+def test_served_knn_scores_match_evaluator_across_every_bucket(model_type,
+                                                               tmp_path):
+    """Acceptance pin (the serving contract, test_serving.py's twin for
+    score_kind='knn'): served kNN scores for a CHECKPOINTED federation
+    equal make_evaluate_all's scores-oracle to float32 tolerance at every
+    bucket size, under BOTH model types — bank gather + bucket padding
+    provably never perturb real rows."""
+    model = make_model(model_type, DIM, shrink_lambda=1.0)
+    params = init_stacked_params(model, jax.random.key(1), N)
+    test_x, test_m, test_y, train_xb, train_mb = _data()
+    oracle = np.asarray(make_evaluate_all(
+        model, model_type, metric="scores", score_kind="knn",
+        knn_bank_size=32, knn_k=4)(
+            params, test_x, test_m, test_y, train_xb, train_mb))
+
+    writer = ResultsWriter(str(tmp_path), N, "exp", "FL-IoT", "AUC", 0.5)
+    names = [f"Client-{k}" for k in range(1, N + 1)]
+    save_client_models(writer, 0, model_type, "mse_avg", names, params)
+    eng = ServingEngine.from_checkpoint(
+        writer, model, model_type, "mse_avg", names, run=0,
+        train_x=train_xb, train_m=train_mb, max_bucket=16,
+        score_kind="knn", knn_bank_size=32, knn_k=4)
+    for g in range(N):
+        for n_rows in (1, 2, 3, 4, 5, 7, 8, 9, 15, 16):
+            got = eng.score(test_x[g, :n_rows], g)
+            np.testing.assert_allclose(
+                got, oracle[g, :n_rows], atol=1e-5,
+                err_msg=f"{model_type} g={g} n={n_rows}")
+    # oversize requests chunk at max_bucket and still agree
+    got = eng.score(test_x[0, :37], 0)
+    np.testing.assert_allclose(got, oracle[0, :37], atol=1e-5)
+    assert sorted(eng.dispatches) == [1, 2, 4, 8, 16]
+
+
+def test_serving_persisted_bank_path_and_validation(tmp_path):
+    """A PERSISTED bank (save_bank -> load_bank -> banks=) serves the
+    identical scores as the freshly built one — the deployment path where
+    the serving process owns no training state; constructor validation
+    rejects knn without banks and bad score kinds."""
+    model = make_model("autoencoder", DIM)
+    params = init_stacked_params(model, jax.random.key(2), N)
+    test_x, _, _, train_xb, train_mb = _data()
+    fresh = ServingEngine.from_federation(
+        model, "autoencoder", params, train_x=train_xb, train_m=train_mb,
+        score_kind="knn", knn_bank_size=32, max_bucket=16)
+    writer = ResultsWriter(str(tmp_path), N, "exp", "FL-IoT", "AUC", 0.5)
+    path = save_bank(bank_path(writer, 0, "autoencoder", "mse_avg"),
+                     fresh.banks)
+    reloaded = ServingEngine.from_federation(
+        model, "autoencoder", params, banks=load_bank(path),
+        score_kind="knn", max_bucket=16)
+    for g in range(N):
+        np.testing.assert_array_equal(fresh.score(test_x[g, :9], g),
+                                      reloaded.score(test_x[g, :9], g))
+    with pytest.raises(ValueError, match="banks"):
+        ServingEngine(model, "autoencoder", params, score_kind="knn")
+    with pytest.raises(ValueError, match="score_kind"):
+        ServingEngine(model, "autoencoder", params, score_kind="nope")
+    # a bank persisted from a DIFFERENT federation must fail loudly at
+    # construction: inside jit the bank gathers clamp out-of-range
+    # gateway indices silently (wrong scores, no exception)
+    stale = ReferenceBank(latents=fresh.banks.latents[:N - 1],
+                          count=fresh.banks.count[:N - 1])
+    with pytest.raises(ValueError, match="different federation"):
+        ServingEngine(model, "autoencoder", params, banks=stale,
+                      score_kind="knn")
+    # ... and a single-tenant engine must reject a multi-gateway bank
+    # (its scorer takes banks[0] — a silent wrong-gateway score otherwise)
+    single_params = jax.tree.map(lambda t: t[0], params)
+    with pytest.raises(ValueError, match="single-tenant"):
+        ServingEngine(model, "autoencoder", single_params,
+                      banks=fresh.banks, score_kind="knn",
+                      multi_tenant=False)
+
+
+def test_knn_calibration_thresholds_kth_distance(tmp_path):
+    """fit_calibration through a kNN engine calibrates per-gateway
+    KTH-DISTANCE thresholds: the threshold is the requested percentile of
+    the gateway's own kth-distance scores (the generic calibration path,
+    no kNN special-casing)."""
+    from fedmse_tpu.serving import fit_calibration
+
+    model = make_model("hybrid", DIM, shrink_lambda=1.0)
+    params = init_stacked_params(model, jax.random.key(3), N)
+    _, _, _, train_xb, train_mb = _data()
+    eng = ServingEngine.from_federation(
+        model, "hybrid", params, train_x=train_xb, train_m=train_mb,
+        score_kind="knn", knn_bank_size=32, max_bucket=16)
+    rng = np.random.default_rng(7)
+    valid_x = rng.normal(size=(N, 80, DIM)).astype(np.float32)
+    cal = fit_calibration(eng, valid_x, percentile=90.0)
+    assert cal.model_type == "hybrid"
+    for g in range(N):
+        scores = eng.score(valid_x[g], g)
+        assert cal.thresholds[g] == pytest.approx(
+            np.percentile(scores, 90.0), rel=1e-6)
+        rate = float(np.mean(cal.verdicts(scores, g)))
+        assert rate == pytest.approx(0.10, abs=0.03)
+
+
+def test_approx_handles_ragged_banks():
+    """Regression: a thin bank (count << capacity B) keeps its valid rows
+    in the FIRST count slots; the binned partial reduce must stride its
+    bins across the slot axis, or the valid prefix crams into a few bins
+    and the kth candidate goes +inf (count < k·width) / recall silently
+    degrades. With strided bins: count <= bins degenerates to EXACT, and
+    every score stays finite whenever count > 0."""
+    rng = np.random.default_rng(9)
+    B, k, count = 4096, 8, 40
+    bank = rng.normal(size=(B, 7)).astype(np.float32)
+    q = rng.normal(size=(33, 7)).astype(np.float32)
+    exact = np.asarray(knn_kth_distance(jnp.asarray(q), jnp.asarray(bank),
+                                        count, k))
+    approx = np.asarray(knn_kth_distance(jnp.asarray(q), jnp.asarray(bank),
+                                         count, k, topk="approx"))
+    assert np.isfinite(approx).all()
+    # count (40) <= bins (256): every valid row is its own bin candidate,
+    # so the approximation IS exact here
+    np.testing.assert_allclose(approx, exact, rtol=1e-6, atol=1e-6)
+    # a mid-size ragged bank (count > bins) stays a bounded approximation
+    approx2 = np.asarray(knn_kth_distance(jnp.asarray(q), jnp.asarray(bank),
+                                          512, k, topk="approx"))
+    exact2 = np.asarray(knn_kth_distance(jnp.asarray(q), jnp.asarray(bank),
+                                         512, k))
+    assert np.isfinite(approx2).all() and np.all(approx2 >= exact2 - 1e-6)
+
+
+def test_routed_onehot_path_matches_gather_fallback():
+    """The serving engine's one-hot-matmul bank routing == the per-row
+    gather fallback == the single-gateway scorer, for every row of a
+    mixed-gateway batch (the extra one-hot contraction terms are exact
+    zeros, so only f32 association separates the paths). Both exact and
+    approx top-k, ragged counts included."""
+    from fedmse_tpu.knn import routed_kth_distance
+
+    rng = np.random.default_rng(8)
+    n, b, l = 4, 64, 7
+    bank = ReferenceBank(
+        latents=jnp.asarray(rng.normal(size=(n, b, l)).astype(np.float32)),
+        count=jnp.asarray([64, 10, 64, 3], jnp.int32))
+    lat = jnp.asarray(rng.normal(size=(50, l)).astype(np.float32))
+    gw = jnp.asarray(rng.integers(0, n, size=50).astype(np.int32))
+    for topk in ("exact", "approx"):
+        onehot = np.asarray(routed_kth_distance(lat, gw, bank, 8, topk=topk))
+        gather = np.asarray(routed_kth_distance(lat, gw, bank, 8, topk=topk,
+                                                max_onehot_cols=0))
+        np.testing.assert_allclose(onehot, gather, rtol=1e-4, atol=1e-5)
+        for g in range(n):
+            sel = np.asarray(gw) == g
+            single = np.asarray(knn_kth_distance(
+                lat[sel], bank.latents[g], bank.count[g], 8, topk=topk))
+            np.testing.assert_allclose(onehot[sel], single, rtol=1e-4,
+                                       atol=1e-5)
+
+
+# ----------------------------- evaluator wiring ----------------------------- #
+
+def test_score_kind_auto_matches_reference_pairing():
+    """score_kind='auto' must be EXACTLY the pre-knn behavior: AE-MSE under
+    'autoencoder', centroid density under 'hybrid' (the default pairing
+    every committed artifact was produced under)."""
+    data = _data()
+    test_x, test_m, test_y, train_xb, train_mb = data
+    for model_type, kind in (("autoencoder", "mse"), ("hybrid", "centroid")):
+        model = make_model(model_type, DIM, shrink_lambda=1.0)
+        params = init_stacked_params(model, jax.random.key(4), N)
+        auto = np.asarray(make_evaluate_all(model, model_type,
+                                            metric="scores")(
+            params, test_x, test_m, test_y, train_xb, train_mb))
+        forced = np.asarray(make_evaluate_all(model, model_type,
+                                              metric="scores",
+                                              score_kind=kind)(
+            params, test_x, test_m, test_y, train_xb, train_mb))
+        np.testing.assert_array_equal(auto, forced)
+    with pytest.raises(ValueError, match="score_kind"):
+        make_evaluate_all(make_model("hybrid", DIM), "hybrid",
+                          score_kind="nope")
+
+
+def test_knn_beats_centroid_on_multimodal_latents():
+    """The quality claim at test scale (ROADMAP 4): on multi-modal normal
+    traffic with between-mode anomalies, the kNN score's AUC beats the
+    single-prototype centroid's on every gateway (data/synthetic.py
+    synthetic_multimodal_clients; the 500-client artifact is
+    BENCH_KNN_r09)."""
+    from fedmse_tpu.data import (build_dev_dataset, stack_clients,
+                                 synthetic_multimodal_clients)
+
+    clients = synthetic_multimodal_clients(n_clients=4, dim=DIM,
+                                           n_normal=320, n_abnormal=64,
+                                           modes=3, seed=0)
+    dev_x = build_dev_dataset(clients, np.random.default_rng(0))
+    data = stack_clients(clients, dev_x, 8)
+    model = make_model("hybrid", DIM, shrink_lambda=1.0)
+    params = init_stacked_params(model, jax.random.key(5), 4)
+    args = (params, data.test_x, data.test_m, data.test_y,
+            data.train_xb, data.train_mb)
+    cen = np.asarray(make_evaluate_all(model, "hybrid")(*args))
+    knn = np.asarray(make_evaluate_all(model, "hybrid", score_kind="knn",
+                                       knn_bank_size=128)(*args))
+    assert (knn >= cen).all(), (knn, cen)
+    assert knn.mean() >= cen.mean() + 0.1
+
+
+# ------------------------------ driver wiring ------------------------------ #
+
+def test_cli_score_kind_knn_end_to_end(tmp_path):
+    """--score-kind knn --knn-bank-size through the real CLI driver: the
+    round metrics come from the kNN scorer, the serve smoke serves bank
+    lookups, and the bank persists beside the calibration JSON."""
+    from fedmse_tpu.config import DatasetConfig
+    from fedmse_tpu.main import main as cli_main
+    from tests.test_data import _write_client_csvs
+
+    root = str(tmp_path / "shards")
+    _write_client_csvs(root, 4, dim=6, n_normal=60, n_abnormal=24)
+    cfg_path = os.path.join(root, "config.json")
+    with open(cfg_path, "w") as f:
+        json.dump(DatasetConfig.for_client_dirs(root, 4).to_json(), f)
+    out = cli_main([
+        "--dataset-config", cfg_path,
+        "--model-types", "hybrid", "--update-types", "mse_avg",
+        "--network-size", "4", "--dim-features", "6",
+        "--epochs", "1", "--num-rounds", "1", "--batch-size", "8",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--experiment-name", "knn-t",
+        "--score-kind", "knn", "--knn-bank-size", "16", "--knn-k", "3",
+        "--serve", "--serve-rows", "128",
+    ])
+    smoke = out["serve_smoke"]
+    assert smoke["score_kind"] == "knn"
+    assert smoke["rows"] > 0
+    assert os.path.exists(smoke["knn_bank_path"])
+    bank = load_bank(smoke["knn_bank_path"])
+    assert bank.num_gateways == 4 and bank.bank_size == 16
+    assert os.path.exists(smoke["calibration_path"])
+    json.dumps(smoke)  # report stays JSON-safe

@@ -277,7 +277,9 @@ def test_drift_welford_matches_numpy_and_flags_shifted_gateway():
     assert dm.drifted().tolist() == [False, False, False]
 
     # gateway 0's traffic shifts far from the calibration distribution
-    shifted = live[0, :60] + 5.0
+    # (+20 sigma in input space — far enough that the score-space shift
+    # clears 3 sigma under ANY random-init param draw, not just one seed's)
+    shifted = live[0, :60] + 20.0
     dm.update(eng.score(shifted, 0), np.zeros(60))
     assert dm.drifted().tolist() == [True, False, False]
     rep = dm.report()
