@@ -36,7 +36,8 @@ bench-suite:
 # serving throughput/latency: bucketed micro-batched scorer vs per-request
 # dispatch (writes BENCH_SERVE_pr02_cpu.json; hermetic CPU like the tests)
 serve-bench:
-	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python bench_serve.py
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python bench_serve.py \
+		--continuous
 
 # resilience operating-point sweep (fedmse_tpu/chaos/): dropout x
 # aggregator-crash grid + attack-composition and burst-recovery rows

@@ -23,6 +23,10 @@ repo-grown axes):
      the MSE/centroid baselines) + serving bank-lookup rows/s vs the MSE
      scorer (suite runs a 100-client reduced grid; the committed
      standalone artifact is BENCH_KNN_r09_cpu.json at 500 clients)
+ 12. continuous-batching serving front (serving/continuous.py, DESIGN.md
+     §14): paired sync vs continuous vs burst-admission rows/s + p99 +
+     device-idle fractions at batch 1024 — guards the overlap win and
+     the 2.5x acceptance bar (full protocol: make serve-bench)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -233,6 +237,50 @@ def scen_knn(cfg):
                         "scorer", **row}
 
 
+def scen_continuous_serving(cfg):
+    """Scenario 12: the continuous-batching serving front (ISSUE 8,
+    serving/continuous.py) vs the synchronous micro-batcher — a reduced
+    paired comparison (3 reps, 16k rows) guarding the overlap win; the
+    committed standalone artifact (make serve-bench ->
+    BENCH_SERVE_pr02_cpu.json --continuous block) carries the full
+    protocol. Regression guard: the continuous front must stay ahead of
+    sync, and the burst-admission column must clear the 2.5x acceptance
+    bar."""
+    import jax
+    import numpy as np
+
+    from bench_serve import bench_fronts
+    from fedmse_tpu.models import init_stacked_params, make_model
+    from fedmse_tpu.serving import ServingEngine, fit_calibration
+
+    rng = np.random.default_rng(0)
+    dim, n_gw = 115, 10
+    model = make_model("hybrid", dim, shrink_lambda=10.0)
+    params = init_stacked_params(model, jax.random.key(0), n_gw)
+    engine = ServingEngine.from_federation(
+        model, "hybrid", params,
+        train_x=rng.normal(size=(n_gw, 512, dim)).astype(np.float32),
+        max_bucket=1024)
+    calibration = fit_calibration(
+        engine, rng.normal(size=(n_gw, 256, dim)).astype(np.float32))
+    engine.warmup()
+    rows = rng.normal(size=(16384, dim)).astype(np.float32)
+    gws = rng.integers(0, n_gw, size=16384).astype(np.int32)
+    res = bench_fronts(engine, rows, gws, 1024, calibration, reps=3)
+    return {"scenario": "continuous-batching serving front vs sync "
+                        "micro-batcher, 10 gateways, batch 1024, paired",
+            "sync_rows_per_sec": res["sync"]["rows_per_sec"],
+            "continuous_rows_per_sec": res["continuous"]["rows_per_sec"],
+            "burst_rows_per_sec": res["burst"]["rows_per_sec"],
+            "speedup_continuous_vs_sync": res["speedup_continuous_vs_sync"],
+            "speedup_burst_vs_sync": res["speedup_burst_vs_sync"],
+            "sync_p99_ms": res["sync"]["latency_p99_ms"],
+            "burst_p99_ms": res["burst"]["latency_p99_ms"],
+            "device_idle_sync": res["sync"]["device_idle_fraction"],
+            "device_idle_burst": res["burst"]["device_idle_fraction"],
+            "acceptance_met": res["acceptance"]["met"]}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -255,9 +303,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-11")
-        if not 1 <= only <= 11:
-            sys.exit(f"--only expects a scenario number 1-11, got {only}")
+            sys.exit("--only expects a scenario number 1-12")
+        if not 1 <= only <= 12:
+            sys.exit(f"--only expects a scenario number 1-12, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -339,6 +387,9 @@ def main():
 
     if only in (None, 11):
         emit(scen_knn(ExperimentConfig()))
+
+    if only in (None, 12):
+        emit(scen_continuous_serving(ExperimentConfig()))
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

@@ -281,6 +281,17 @@ class ExperimentConfig:
     knn_bank_size: int = 512
     knn_k: int = 8
     knn_topk: str = "approx"
+    # Serving front (fedmse_tpu/serving/, DESIGN.md §8 + §14): the knobs a
+    # deployment (and the --serve smoke pass) builds its batching front
+    # from. serve_max_batch bounds the dispatch bucket (and the engine's
+    # largest compiled bucket in the smoke);  serve_latency_budget_ms is
+    # the sync micro-batcher's max_wait AND the continuous front's latency
+    # budget — under the continuous front it also steers the adaptive
+    # bucket pick (the front targets the largest power-of-two bucket the
+    # live arrival rate fills within the budget, so p99 tracks the budget
+    # while throughput tracks the offered load).
+    serve_max_batch: int = 256
+    serve_latency_budget_ms: float = 2.0
     # optax.flatten around Adam: folds the per-leaf update (12 small
     # elementwise ops per step across the param tree; the training loop
     # runs ~275 serial steps per round inside the fused program) into ONE

@@ -99,7 +99,8 @@ def downsample_latents(latent: jax.Array, mask: Optional[jax.Array],
 
 
 def build_banks(model, stacked_params: Any, train_x, train_m=None,
-                bank_size: int = 1024, seed: int = 0) -> ReferenceBank:
+                bank_size: Optional[int] = None, seed: int = 0,
+                existing: Optional[ReferenceBank] = None) -> ReferenceBank:
     """Encode each gateway's train rows with ITS OWN params and downsample
     to a stacked ReferenceBank — the exact encode path the evaluator's
     hybrid fit uses (serving/engine.fit_gateway_centroids's twin).
@@ -108,32 +109,72 @@ def build_banks(model, stacked_params: Any, train_x, train_m=None,
     [N, S, D] train rows. `seed` keys the downsample draw; the per-gateway
     key is fold_in(key(seed), gateway_index) — the SAME scheme
     evaluation/evaluator.py uses in-program, so a persisted bank and an
-    in-program bank built from the same inputs are identical."""
+    in-program bank built from the same inputs are identical.
+
+    REFRESH (`existing`): pass a resident bank and the rows become *new*
+    normal latents reservoir-merged into it — each gateway's refreshed
+    bank is a uniform sample over (its retained slots ∪ its new latents),
+    drawn by the same one-top_k priority trick over the concatenated
+    slot axis, with the old bank's padding and the new rows' mask both
+    excluded. Capacity defaults to the existing bank's (pass `bank_size`
+    to grow/shrink — the scorer recompiles per capacity). This is the
+    drift-triggered hot-swap payload for score_kind='knn'
+    (serving/continuous.py swap(banks=...)): the monitor flags a
+    gateway, fresh normal traffic re-encodes under the CURRENT params,
+    and the merged bank swaps in between dispatches. Note the merge is
+    uniform over the union, not over all history — by design: a refresh
+    exists to pull the bank toward recent traffic."""
     train_x = jnp.asarray(train_x)
     if train_x.ndim == 4:
         train_x = train_x.reshape(train_x.shape[0], -1, train_x.shape[-1])
     if train_m is not None:
         train_m = jnp.asarray(train_m).reshape(train_m.shape[0], -1)
     n = train_x.shape[0]
+    if existing is not None and existing.num_gateways != n:
+        raise ValueError(f"existing bank holds {existing.num_gateways} "
+                         f"gateways, refresh rows cover {n}")
+    if bank_size is None:
+        bank_size = existing.bank_size if existing is not None else 1024
     bank_size = pow2_bank_size(bank_size)
 
     @jax.jit
-    def build(params, xf, mf):
+    def build(params, xf, mf, old_lat, old_cnt):
         from fedmse_tpu.utils.seeding import fold_in_keys
         keys = fold_in_keys(jax.random.key(seed), n)
 
-        def one(p, x, m, k):
+        def one(p, x, m, k, ol, oc):
             latent, _ = model.apply({"params": p}, x)
-            return downsample_latents(latent, m, bank_size, k)
+            latent = latent.astype(jnp.float32)
+            valid = (jnp.ones(latent.shape[0]) if m is None
+                     else m.reshape(latent.shape[0]))
+            if ol is not None:
+                # merge pool = retained slots (slot < count) ++ new rows
+                slot_valid = (jnp.arange(ol.shape[0]) < oc).astype(valid.dtype)
+                latent = jnp.concatenate([ol, latent], axis=0)
+                valid = jnp.concatenate([slot_valid, valid], axis=0)
+            return downsample_latents(latent, valid, bank_size, k)
 
-        if mf is None:
-            lat, cnt = jax.vmap(
-                lambda p, x, k: one(p, x, None, k))(params, xf, keys)
+        if old_lat is None:
+            if mf is None:
+                lat, cnt = jax.vmap(lambda p, x, k: one(
+                    p, x, None, k, None, None))(params, xf, keys)
+            else:
+                lat, cnt = jax.vmap(lambda p, x, m, k: one(
+                    p, x, m, k, None, None))(params, xf, mf, keys)
         else:
-            lat, cnt = jax.vmap(one)(params, xf, mf, keys)
+            if mf is None:
+                lat, cnt = jax.vmap(lambda p, x, k, ol, oc: one(
+                    p, x, None, k, ol, oc))(params, xf, keys,
+                                            old_lat, old_cnt)
+            else:
+                lat, cnt = jax.vmap(one)(params, xf, mf, keys,
+                                         old_lat, old_cnt)
         return ReferenceBank(latents=lat, count=cnt)
 
-    return build(stacked_params, train_x, train_m)
+    old_lat = None if existing is None else jnp.asarray(existing.latents,
+                                                        jnp.float32)
+    old_cnt = None if existing is None else jnp.asarray(existing.count)
+    return build(stacked_params, train_x, train_m, old_lat, old_cnt)
 
 
 # ------------------------------ persistence ------------------------------ #

@@ -475,14 +475,17 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                    resume_dir: Optional[str] = None,
                    attack=None, chaos=None, batch_runs: bool = False,
                    serve: bool = False, serve_rows: int = 2048,
-                   serve_warmup: bool = False) -> Dict:
+                   serve_warmup: bool = False,
+                   serve_continuous: bool = False) -> Dict:
     """The full sweep (src/main.py:108-399) -> training summary dict.
 
     `serve=True` appends a serving smoke pass (fedmse_tpu/serving/): the
     first combination's checkpointed ClientModel tree is loaded back from
     disk, calibrated on validation normals, and test traffic is streamed
     through the micro-batched bucketed scorer with drift monitoring; the
-    report lands under the returned dict's "serve_smoke" key."""
+    report lands under the returned dict's "serve_smoke" key.
+    `serve_continuous=True` streams through the continuous-batching front
+    (serving/continuous.py) instead of the synchronous micro-batcher."""
     mesh = None
     pad_multiple = None
     if use_mesh and len(jax.devices()) > 1:
@@ -579,7 +582,9 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                 cfg, data, n_real, writer, device_names,
                 model_type=cfg.model_types[0],
                 update_type=cfg.update_types[0], run=0,
-                max_rows=serve_rows, warmup=serve_warmup)
+                max_rows=serve_rows, max_batch=cfg.serve_max_batch,
+                max_wait_ms=cfg.serve_latency_budget_ms,
+                warmup=serve_warmup, continuous=serve_continuous)
     return out
 
 
@@ -611,6 +616,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "startup (serving/engine.py warmup) so a first-hit "
                         "bucket no longer spikes tail latency inside the "
                         "served stream; compile times land in the report")
+    p.add_argument("--serve-continuous", action="store_true",
+                   help="stream the --serve smoke pass through the "
+                        "continuous-batching front (serving/continuous.py:"
+                        " double-buffered dispatch — the forming bucket "
+                        "admits rows while the in-flight bucket scores — "
+                        "with adaptive bucket selection and drift-triggered"
+                        " hot swap) instead of the synchronous "
+                        "wait-then-flush micro-batcher")
+    # (--serve-max-batch / --serve-latency-budget-ms ride in free via
+    # config.add_cli_overrides: they are ExperimentConfig fields)
     p.add_argument("--no-pipeline", action="store_true",
                    help="disable pipelined chunk execution (federation/"
                         "pipeline.py) and run the serial chunk loop: "
@@ -718,7 +733,8 @@ def main(argv: Optional[List[str]] = None) -> Dict:
                           resume_dir=args.resume_dir, attack=attack,
                           chaos=chaos, batch_runs=args.batch_runs,
                           serve=args.serve, serve_rows=args.serve_rows,
-                          serve_warmup=args.serve_warmup)
+                          serve_warmup=args.serve_warmup,
+                          serve_continuous=args.serve_continuous)
 
 
 def cli() -> int:

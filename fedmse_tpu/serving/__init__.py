@@ -6,27 +6,40 @@ This package turns that into a deployed detector:
 
   engine.py       compiled scorer with static power-of-two row buckets;
                   single-global and multi-tenant (per-row gateway routing
-                  by gather over the stacked pytree) paths
+                  by gather over the stacked pytree) paths; serving state
+                  passed as a jit OPERAND -> zero-recompile hot swap
+                  (swap_state) and a non-blocking dispatch/harvest split
   calibration.py  score -> verdict: per-gateway percentile thresholds fit
-                  on validation normals, persisted beside the checkpoint
+                  on validation normals, persisted beside the checkpoint;
+                  `refit` builds the threshold hot-swap payload
   batcher.py      host-side dynamic micro-batcher (max_batch / max_wait_ms)
-                  with p50/p95/p99 latency and rows/sec accounting
+                  with p50/p95/p99 latency and rows/sec accounting — the
+                  synchronous wait-then-flush front
+  continuous.py   continuous-batching front: forming/in-flight double
+                  buffer over engine.dispatch, adaptive bucket pick from
+                  the live arrival rate, drift-triggered hot swap of
+                  thresholds / checkpoints / kNN banks between dispatches
   drift.py        streaming Welford mean/var over served scores per
-                  gateway vs the calibration distribution
+                  gateway vs the calibration distribution, with the
+                  debounced `swap_recommended` trigger
   smoke.py        end-to-end smoke pass (load checkpoint -> calibrate ->
                   serve -> drift report) wired to `fedmse_tpu.main --serve`
+                  (`--serve-continuous` swaps in the continuous front)
 
-Design rationale lives in DESIGN.md §8.
+Design rationale lives in DESIGN.md §8 (buckets) and §14 (continuous
+batching + hot swap).
 """
 
 from fedmse_tpu.serving.batcher import MicroBatcher
 from fedmse_tpu.serving.calibration import ServingCalibration, fit_calibration
+from fedmse_tpu.serving.continuous import ContinuousBatcher
 from fedmse_tpu.serving.drift import DriftMonitor
 from fedmse_tpu.serving.engine import ServingEngine, fit_gateway_centroids
 from fedmse_tpu.serving.smoke import run_serve_smoke
 
 __all__ = [
     "MicroBatcher",
+    "ContinuousBatcher",
     "ServingCalibration",
     "fit_calibration",
     "DriftMonitor",

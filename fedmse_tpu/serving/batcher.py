@@ -67,8 +67,14 @@ class MicroBatcher:
         self._gateways: List[int] = []
         self._enqueued_at: List[float] = []
         self._tickets: List[Ticket] = []
-        # accounting: bounded windows + exact lifetime totals
+        # accounting: bounded windows + exact lifetime totals. The three
+        # per-row deques (latency, enqueue time, result time) share one
+        # maxlen so every windowed stat describes the SAME most-recent rows
         self._latencies: collections.deque = collections.deque(
+            maxlen=stats_window)
+        self._win_enqueued: collections.deque = collections.deque(
+            maxlen=stats_window)
+        self._win_resulted: collections.deque = collections.deque(
             maxlen=stats_window)
         self.rows_served = 0
         self.dispatch_count = 0
@@ -134,6 +140,8 @@ class MicroBatcher:
             tk.latency_s = t1 - enq[i]
             tk.done = True
             self._latencies.append(tk.latency_s)
+            self._win_enqueued.append(enq[i])
+            self._win_resulted.append(t1)
         self.rows_served += len(tickets)
         self.dispatch_count += 1
         self.dispatch_batch_sizes.append(len(tickets))
@@ -148,8 +156,16 @@ class MicroBatcher:
 
     def stats(self) -> Dict:
         lat = np.asarray(self._latencies)
-        wall = ((self._last_result - self._first_submit)
-                if self._latencies else 0.0)
+        # rows_per_sec_wall is WINDOWED, matching the latency percentiles:
+        # rows in the current window over the span that produced them
+        # (first enqueue in the window -> last result). The old lifetime
+        # quotient diluted a long-lived server's recent rate with its whole
+        # history while the percentiles beside it were windowed — it rides
+        # along under the _lifetime key for exact long-horizon accounting.
+        win_wall = ((self._win_resulted[-1] - self._win_enqueued[0])
+                    if self._win_resulted else 0.0)
+        life_wall = ((self._last_result - self._first_submit)
+                     if self._latencies else 0.0)
         p = (lambda q: float(np.percentile(lat, q) * 1000.0)) if len(lat) \
             else (lambda q: None)
         return {
@@ -161,8 +177,10 @@ class MicroBatcher:
             "max_wait_ms": self.max_wait_s * 1000.0,
             "latency_p50_ms": p(50), "latency_p95_ms": p(95),
             "latency_p99_ms": p(99),
-            "rows_per_sec_wall": (self.rows_served / wall if wall > 0
-                                  else None),
+            "rows_per_sec_wall": (len(self._win_resulted) / win_wall
+                                  if win_wall > 0 else None),
+            "rows_per_sec_wall_lifetime": (self.rows_served / life_wall
+                                           if life_wall > 0 else None),
             "rows_per_sec_service": (self.rows_served / self.service_s
                                      if self.service_s > 0 else None),
             "service_s": self.service_s,

@@ -49,6 +49,30 @@ class ServingCalibration:
                                  scores.shape)
         return scores > self.thresholds[gw]
 
+    def refit(self, gateway: int, scores,
+              percentile: Optional[float] = None) -> "ServingCalibration":
+        """A COPY with one gateway's threshold/mean/std/count refit on
+        fresh normal scores — the drift-triggered threshold hot-swap
+        payload (serving/continuous.py swap(calibration=...)): when the
+        monitor recommends a swap, score a batch of known-normal rows for
+        the flagged gateway and install the refit copy; every other
+        gateway's calibration is untouched. The copy leaves `self` alone
+        so batches already dispatched keep their snapshot."""
+        scores = np.asarray(scores, np.float64)
+        if scores.size == 0:
+            raise ValueError("refit needs at least one normal score")
+        pct = self.percentile if percentile is None else percentile
+        thresholds = self.thresholds.copy()
+        mean, std = self.mean.copy(), self.std.copy()
+        count = self.count.copy()
+        thresholds[gateway] = float(np.percentile(scores, pct))
+        mean[gateway] = float(np.mean(scores))
+        std[gateway] = float(np.std(scores))
+        count[gateway] = scores.size
+        return ServingCalibration(percentile=self.percentile,
+                                  thresholds=thresholds, mean=mean, std=std,
+                                  count=count, model_type=self.model_type)
+
     # ---------------------------- persistence ---------------------------- #
 
     def save(self, path: str) -> str:

@@ -30,17 +30,29 @@ class DriftMonitor:
     |live_mean - calib_mean| > z_threshold * calib_std — a mean shift of
     z_threshold calibration standard deviations. Gateways the calibration
     never saw (count 0) are reported as uncalibrated, never drifted.
+
+    A drifted gateway becomes `swap_recommended` once the drifted state
+    has been SUSTAINED for `min_batches` consecutive `update()` calls
+    that carried its traffic — the hot-swap trigger the continuous front
+    acts on (serving/continuous.py swap), debounced so one anomalous
+    burst does not churn checkpoints. The field is computed entirely
+    here, so the trigger is testable without an engine in the loop.
     """
 
     def __init__(self, calibration: ServingCalibration,
-                 z_threshold: float = 3.0, min_count: int = 30):
+                 z_threshold: float = 3.0, min_count: int = 30,
+                 min_batches: int = 3):
         self.calibration = calibration
         self.z_threshold = z_threshold
         self.min_count = min_count
+        self.min_batches = min_batches
         n = calibration.num_gateways
         self.count = np.zeros(n, np.int64)
         self.mean = np.zeros(n)
         self._m2 = np.zeros(n)  # sum of squared deviations from the mean
+        # consecutive drifted updates (per gateway, counting only updates
+        # that carried that gateway's rows)
+        self._streak = np.zeros(n, np.int64)
 
     def update(self, scores, gateway_ids=None) -> None:
         """Absorb one served batch of scores (+ per-row gateway ids)."""
@@ -50,7 +62,8 @@ class DriftMonitor:
         else:
             gw = np.broadcast_to(np.asarray(gateway_ids, np.int32),
                                  scores.shape)
-        for g in np.unique(gw):
+        present = np.unique(gw)
+        for g in present:
             xs = scores[gw == g]
             nb = len(xs)
             mb = float(np.mean(xs))
@@ -62,6 +75,12 @@ class DriftMonitor:
             self.mean[g] = ma + delta * nb / n
             self._m2[g] += m2b + delta * delta * na * nb / n
             self.count[g] = n
+        # sustain accounting: a gateway that saw traffic this update either
+        # extends its drifted streak or resets it; quiet gateways keep
+        # theirs (no evidence either way)
+        drifted = self.drifted()
+        self._streak[present] = np.where(drifted[present],
+                                         self._streak[present] + 1, 0)
 
     def live_std(self) -> np.ndarray:
         with np.errstate(invalid="ignore", divide="ignore"):
@@ -87,10 +106,36 @@ class DriftMonitor:
                 & (self.calibration.count > 0)
                 & (z > self.z_threshold))
 
+    def swap_recommended(self) -> np.ndarray:
+        """[N] bool: drifted AND sustained for min_batches updates — the
+        debounced hot-swap trigger (recalibrate / refresh bank / pull a
+        newer checkpoint, serving/continuous.py swap)."""
+        return self.drifted() & (self._streak >= self.min_batches)
+
+    def rebaseline(self, calibration: ServingCalibration,
+                   reset: bool = True) -> None:
+        """Swap in a recalibrated reference distribution (the threshold
+        hot-swap path). `reset=True` restarts the live moments and
+        streaks — the old traffic was measured against the old baseline,
+        so carrying it over would immediately re-flag the gateway the
+        swap just fixed."""
+        if calibration.num_gateways != self.calibration.num_gateways:
+            raise ValueError(
+                f"rebaseline calibration covers "
+                f"{calibration.num_gateways} gateways, monitor tracks "
+                f"{self.calibration.num_gateways}")
+        self.calibration = calibration
+        if reset:
+            self.count[:] = 0
+            self.mean[:] = 0.0
+            self._m2[:] = 0.0
+            self._streak[:] = 0
+
     def report(self) -> Dict:
-        """JSON-safe summary (per-gateway rows + the flagged list)."""
+        """JSON-safe summary (per-gateway rows + the flagged lists)."""
         z = self.shift()
         drifted = self.drifted()
+        recommended = self.swap_recommended()
         live_std = self.live_std()
         cal = self.calibration
         gateways: List[Dict] = []
@@ -106,10 +151,15 @@ class DriftMonitor:
                                  else float(z[g])),
                 "calibrated": bool(cal.count[g] > 0),
                 "drifted": bool(drifted[g]),
+                "drift_streak": int(self._streak[g]),
+                "swap_recommended": bool(recommended[g]),
             })
         return {
             "z_threshold": self.z_threshold,
             "min_count": self.min_count,
+            "min_batches": self.min_batches,
             "drifted_gateways": [int(g) for g in np.nonzero(drifted)[0]],
+            "swap_recommended_gateways": [int(g) for g in
+                                          np.nonzero(recommended)[0]],
             "gateways": gateways,
         }

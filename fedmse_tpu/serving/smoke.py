@@ -51,7 +51,8 @@ def run_serve_smoke(cfg, data, n_real: int, writer, device_names: Sequence[str],
                     model_type: str, update_type: str, run: int = 0,
                     max_rows: int = 2048, max_batch: int = 256,
                     max_wait_ms: float = 2.0,
-                    percentile: float = 95.0, warmup: bool = False) -> Dict:
+                    percentile: float = 95.0, warmup: bool = False,
+                    continuous: bool = False) -> Dict:
     """One serving smoke pass over a just-checkpointed combination.
 
     `warmup=True` (`--serve-warmup`) precompiles every power-of-two bucket
@@ -60,7 +61,14 @@ def run_serve_smoke(cfg, data, n_real: int, writer, device_names: Sequence[str],
     Default False: the stream is served cold — the realistic first-boot
     deployment — and any compile spikes show up honestly in the latency
     percentiles (calibration already compiles the buckets it happens to
-    touch either way)."""
+    touch either way).
+
+    `continuous=True` (`--serve-continuous`) streams through the
+    continuous-batching front (serving/continuous.py: double-buffered
+    dispatch, adaptive bucket pick, `max_wait_ms` as the latency budget)
+    instead of the synchronous micro-batcher; the report's "batcher"
+    block then carries the continuous front's stats (front:
+    "continuous", target bucket, host-blocked fraction)."""
     from fedmse_tpu.models import make_model
 
     model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
@@ -90,8 +98,14 @@ def run_serve_smoke(cfg, data, n_real: int, writer, device_names: Sequence[str],
         writer.serving_dir(run),
         f"{model_type}_{update_type}_calibration.json"))
 
-    batcher = MicroBatcher(engine, max_batch=max_batch,
-                           max_wait_ms=max_wait_ms, calibration=calib)
+    if continuous:
+        from fedmse_tpu.serving.continuous import ContinuousBatcher
+        batcher = ContinuousBatcher(engine, max_batch=max_batch,
+                                    latency_budget_ms=max_wait_ms,
+                                    calibration=calib)
+    else:
+        batcher = MicroBatcher(engine, max_batch=max_batch,
+                               max_wait_ms=max_wait_ms, calibration=calib)
     # --serve-warmup: every bucket compiles before the timed stream
     warmup_sec = engine.warmup() if warmup else None
     # the report's bucket_dispatches must describe the served test stream,
@@ -132,6 +146,7 @@ def run_serve_smoke(cfg, data, n_real: int, writer, device_names: Sequence[str],
         "label_anomaly_rate": (float(np.mean(anomaly))
                                if len(rows) else None),
         "verdict_label_agreement": agree,
+        "front": "continuous" if continuous else "sync",
         "batcher": batcher.stats(),
         "bucket_dispatches": {str(k): int(v)
                               for k, v in sorted(engine.dispatches.items())},
@@ -142,10 +157,11 @@ def run_serve_smoke(cfg, data, n_real: int, writer, device_names: Sequence[str],
             else {str(k): round(v, 4) for k, v in warmup_sec.items()}),
     }
     logger.info(
-        "serve smoke [%s/%s]: %d rows, %.0f rows/s (service), p95 %.2f ms, "
+        "serve smoke [%s/%s]: %d rows, %.0f rows/s, p95 %.2f ms, "
         "verdict/label agreement %.3f, drifted gateways %s",
         model_type, update_type, report["rows"],
-        report["batcher"]["rows_per_sec_service"] or 0.0,
+        report["batcher"].get("rows_per_sec_service",
+                              report["batcher"]["rows_per_sec_wall"]) or 0.0,
         report["batcher"]["latency_p95_ms"] or 0.0,
         agree if agree is not None else float("nan"),
         report["drift"]["drifted_gateways"])

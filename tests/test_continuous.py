@@ -1,0 +1,496 @@
+"""Continuous-batching front tests (fedmse_tpu/serving/continuous.py):
+ticket integrity and ordering under the forming/in-flight double buffer,
+swap atomicity across all three hot-swap kinds (thresholds, checkpoint,
+kNN bank — every submitted ticket scored exactly once, in order, under
+the regime that admitted it), adaptive bucket selection, kNN bank
+REFRESH + persistence, drift swap_recommended debounce, the engine's
+dispatch/harvest split and zero-recompile swap_state, dense-vs-gather
+routing parity, mesh-sharded serving parity, and the windowed wall
+throughput fix in the sync batcher."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedmse_tpu.knn import build_banks, load_bank, save_bank
+from fedmse_tpu.models import init_stacked_params, make_model
+from fedmse_tpu.serving import (ContinuousBatcher, DriftMonitor, MicroBatcher,
+                                ServingEngine, fit_calibration,
+                                fit_gateway_centroids)
+
+pytestmark = pytest.mark.serve
+
+DIM = 12
+N = 3
+
+
+def _setup(model_type="hybrid", seed=0, max_bucket=64, **kw):
+    rng = np.random.default_rng(seed)
+    model = make_model(model_type, DIM, shrink_lambda=1.0)
+    params = init_stacked_params(model, jax.random.key(seed), N)
+    train_x = rng.normal(size=(N, 60, DIM)).astype(np.float32)
+    eng = ServingEngine.from_federation(
+        model, model_type, params, train_x=train_x, max_bucket=max_bucket,
+        **kw)
+    valid_x = rng.normal(size=(N, 120, DIM)).astype(np.float32)
+    cal = fit_calibration(eng, valid_x)
+    rows = rng.normal(size=(400, DIM)).astype(np.float32)
+    gws = rng.integers(0, N, 400).astype(np.int32)
+    return model, params, train_x, eng, cal, rows, gws
+
+
+# -------------------- ticket integrity and ordering -------------------- #
+
+def test_continuous_scores_match_sync_in_order():
+    """Every submitted ticket completes exactly once, in submission
+    order, with the same scores the blocking engine produces — across
+    size-triggered flushes, a mid-stream burst, and the drain tail."""
+    _, _, _, eng, cal, rows, gws = _setup()
+    front = ContinuousBatcher(eng, max_batch=32, latency_budget_ms=1e9,
+                              calibration=cal)
+    tks = [front.submit(rows[i], gws[i]) for i in range(100)]
+    blk = front.submit_many(rows[100:300], gws[100:300])
+    tks2 = [front.submit(rows[i], gws[i]) for i in range(300, 345)]
+    front.drain()
+    assert all(t.done for t in tks) and blk.done and all(
+        t.done for t in tks2)
+    got = np.concatenate([np.asarray([t.score for t in tks]), blk.scores,
+                          np.asarray([t.score for t in tks2])])
+    np.testing.assert_allclose(got, eng.score(rows[:345], gws[:345]),
+                               atol=1e-5)
+    st = front.stats()
+    assert st["rows_served"] == st["rows_submitted"] == 345  # zero drops
+    assert front.in_flight_rows == 0 and front.forming_rows == 0
+    # TicketBlock is a real lazy sequence: len / index / iterate agree
+    assert len(blk) == 200 and blk[0].done and blk[-1].done
+    assert blk[3].score == pytest.approx(float(blk.scores[3]))
+    assert sum(1 for _ in blk) == 200
+    assert blk.verdicts is not None and blk.verdicts.shape == (200,)
+
+
+def test_tickets_complete_one_flush_late_and_poll_harvests():
+    _, _, _, eng, cal, rows, gws = _setup()
+    front = ContinuousBatcher(eng, max_batch=8, latency_budget_ms=1e9)
+    t1 = [front.submit(rows[i], gws[i]) for i in range(8)]
+    # batch 1 dispatched (in flight) but NOT harvested yet: the double
+    # buffer holds it until the next flush or a poll
+    assert not t1[0].done and front.in_flight_rows == 8
+    t2 = [front.submit(rows[i], gws[i]) for i in range(8, 16)]
+    # flushing batch 2 harvested batch 1
+    assert all(t.done for t in t1) and not t2[0].done
+    # poll() harvests a ready in-flight batch without new traffic
+    for _ in range(1000):
+        if front.poll():
+            break
+    assert all(t.done for t in t2)
+    np.testing.assert_allclose(
+        [t.score for t in t1 + t2], eng.score(rows[:16], gws[:16]),
+        atol=1e-5)
+
+
+# ------------------------------ hot swap ------------------------------- #
+
+def test_threshold_swap_mid_stream_is_atomic_per_batch():
+    """Verdicts use the calibration active at each batch's DISPATCH:
+    batches in flight keep the old thresholds, batches formed after the
+    swap use the new — no ticket is dropped or scored twice."""
+    _, _, _, eng, cal, rows, gws = _setup()
+    lo = cal.refit(0, np.asarray([-1e9]))  # g0 threshold -inf-ish: always
+    for g in range(1, N):                  # flags; same for every gateway
+        lo = lo.refit(g, np.asarray([-1e9]))
+    front = ContinuousBatcher(eng, max_batch=16, latency_budget_ms=1e9,
+                              calibration=cal)
+    pre = [front.submit(rows[i], gws[i]) for i in range(24)]  # 16 flushed,
+    event = front.swap(calibration=lo)                        # 8 forming
+    post = [front.submit(rows[i], gws[i]) for i in range(24, 48)]
+    front.drain()
+    assert event["kinds"] == ["thresholds"]
+    assert all(t.done for t in pre + post)
+    # batch 1 (rows 0..15) dispatched under the ORIGINAL calibration
+    want_pre = cal.verdicts(eng.score(rows[:16], gws[:16]), gws[:16])
+    assert [t.verdict for t in pre[:16]] == list(want_pre)
+    # everything dispatched after the swap flags unconditionally
+    assert all(t.verdict for t in pre[16:] + post)
+    # scores themselves are unaffected by a threshold swap
+    np.testing.assert_allclose([t.score for t in pre + post],
+                               eng.score(rows[:48], gws[:48]), atol=1e-5)
+    assert front.stats()["rows_served"] == 48
+
+
+def test_checkpoint_swap_mid_stream_zero_recompile():
+    """A params swap takes effect at the next dispatch, leaves the
+    in-flight batch on the old checkpoint, retraces nothing, and drops
+    no tickets."""
+    model, params, train_x, eng, cal, rows, gws = _setup()
+    params2 = init_stacked_params(model, jax.random.key(9), N)
+    cens2 = fit_gateway_centroids(model, params2, train_x)
+    eng2 = ServingEngine.from_federation(model, "hybrid", params2,
+                                         train_x=train_x, max_bucket=64)
+    want_old = eng.score(rows[:64], gws[:64])
+    want_new = eng2.score(rows[:64], gws[:64])
+
+    front = ContinuousBatcher(eng, max_batch=16, latency_budget_ms=1e9)
+    pre = [front.submit(rows[i], gws[i]) for i in range(16)]  # in flight
+    cache = eng._score_fn._cache_size()
+    event = front.swap(params=params2, centroids=cens2)
+    post = [front.submit(rows[i], gws[i]) for i in range(16, 64)]
+    front.drain()
+    assert set(event["kinds"]) == {"params", "centroids"}
+    assert eng._score_fn._cache_size() == cache  # pointer flip, no retrace
+    np.testing.assert_allclose([t.score for t in pre], want_old[:16],
+                               atol=1e-5)
+    np.testing.assert_allclose([t.score for t in post], want_new[16:64],
+                               atol=1e-5)
+    assert front.stats()["rows_served"] == 64
+    assert eng.swap_count == 1
+
+
+def test_bank_swap_with_refresh_and_roundtrip(tmp_path):
+    """score_kind='knn': build_banks(existing=...) reservoir-merges new
+    normal latents into the resident bank, the result round-trips
+    persistence exactly, and swapping it in mid-stream re-scores nothing
+    already in flight."""
+    rng = np.random.default_rng(3)
+    model, params, train_x, eng, cal, rows, gws = _setup(
+        "autoencoder", score_kind="knn", knn_bank_size=16)
+    bank = eng.banks
+    new_x = rng.normal(size=(N, 40, DIM)).astype(np.float32) + 0.5
+    refreshed = build_banks(model, params, new_x, existing=bank, seed=7)
+    assert refreshed.bank_size == bank.bank_size
+    assert refreshed.num_gateways == N
+    # refreshed slots come from (retained old slots) U (new latents)
+    own = jax.tree.map(lambda t: t[0], params)
+    lat_new = np.asarray(model.apply({"params": own}, new_x[0])[0])
+    pool = np.concatenate(
+        [np.asarray(bank.latents[0])[:int(bank.count[0])], lat_new])
+    for r in np.asarray(refreshed.latents[0])[:int(refreshed.count[0])]:
+        assert np.abs(pool - r).sum(axis=1).min() < 1e-5
+    # ... and genuinely mix both sources at these sizes
+    n_old = sum(1 for r in np.asarray(refreshed.latents[0])
+                if np.abs(np.asarray(bank.latents[0])[:int(bank.count[0])]
+                          - r).sum(axis=1).min() < 1e-5)
+    assert 0 < n_old < refreshed.bank_size
+    # persistence round-trip is exact
+    path = save_bank(os.path.join(str(tmp_path), "bank.npz"), refreshed)
+    back = load_bank(path)
+    np.testing.assert_array_equal(np.asarray(back.latents),
+                                  np.asarray(refreshed.latents))
+    np.testing.assert_array_equal(np.asarray(back.count),
+                                  np.asarray(refreshed.count))
+
+    eng_new = ServingEngine(model, "autoencoder", params, banks=back,
+                            score_kind="knn", max_bucket=64)
+    want_old = eng.score(rows[:48], gws[:48])
+    want_new = eng_new.score(rows[:48], gws[:48])
+    front = ContinuousBatcher(eng, max_batch=16, latency_budget_ms=1e9)
+    pre = [front.submit(rows[i], gws[i]) for i in range(16)]
+    front.swap(banks=back)
+    post = [front.submit(rows[i], gws[i]) for i in range(16, 48)]
+    front.drain()
+    np.testing.assert_allclose([t.score for t in pre], want_old[:16],
+                               atol=1e-5)
+    np.testing.assert_allclose([t.score for t in post], want_new[16:48],
+                               atol=1e-5)
+    assert front.stats()["rows_served"] == 48
+
+
+def test_swap_rejects_foreign_payloads():
+    model, params, train_x, eng, cal, rows, gws = _setup()
+    front = ContinuousBatcher(eng, max_batch=16, calibration=cal)
+    wrong = init_stacked_params(model, jax.random.key(1), N + 2)
+    with pytest.raises(ValueError, match="swap params"):
+        front.swap(params=wrong)
+    import dataclasses
+    bad_cal = dataclasses.replace(
+        cal, thresholds=np.zeros(N + 2), mean=np.zeros(N + 2),
+        std=np.zeros(N + 2), count=np.zeros(N + 2, np.int64))
+    with pytest.raises(ValueError, match="calibration"):
+        front.swap(calibration=bad_cal)
+    with pytest.raises(ValueError, match="without kNN banks"):
+        front.swap(banks=object())
+    with pytest.raises(ValueError, match="nothing to swap"):
+        front.swap()
+
+
+def test_calibration_swap_does_not_seed_rebaselined_drift():
+    """A batch in flight at swap(calibration=...) time was scored under
+    the OLD regime: its scores must not be absorbed into the just-reset
+    drift monitor (which would seed the new baseline with old-regime
+    traffic and could re-recommend the swap that just happened)."""
+    _, _, _, eng, cal, rows, gws = _setup()
+    dm = DriftMonitor(cal, min_count=5, min_batches=2)
+    front = ContinuousBatcher(eng, max_batch=16, latency_budget_ms=1e9,
+                              calibration=cal, drift=dm)
+    pre = [front.submit(rows[i], gws[i]) for i in range(16)]  # in flight
+    front.swap(calibration=cal.refit(0, np.linspace(0, 1, 50)))
+    assert dm.count.sum() == 0  # rebaselined
+    post = [front.submit(rows[i], gws[i]) for i in range(16, 48)]
+    front.drain()
+    assert all(t.done for t in pre + post)
+    # only the 32 post-swap rows reached the rebaselined monitor
+    assert dm.count.sum() == 32
+
+
+def test_submit_many_detaches_from_reused_caller_buffer():
+    """The NIC-poll pattern: the caller refills its staging buffer after
+    submit_many but before the window flushes — tickets must still score
+    the bytes that were submitted, not the buffer's later content."""
+    _, _, _, eng, cal, rows, gws = _setup()
+    front = ContinuousBatcher(eng, max_batch=64, latency_budget_ms=1e9)
+    buf = rows[:16].copy()
+    gbuf = gws[:16].copy()
+    want = eng.score(buf, gbuf)
+    blk = front.submit_many(buf, gbuf)
+    buf[:] = 1e6  # socket read overwrites the staging buffer
+    gbuf[:] = 0
+    front.drain()
+    np.testing.assert_allclose(blk.scores, want, atol=1e-5)
+
+
+def test_ticket_block_rejects_out_of_range_indices():
+    _, _, _, eng, cal, rows, gws = _setup()
+    front = ContinuousBatcher(eng, max_batch=64, latency_budget_ms=1e9)
+    blk = front.submit_many(rows[:5], gws[:5])
+    front.drain()
+    assert blk[-1].score == blk[4].score
+    with pytest.raises(IndexError):
+        blk[5]
+    with pytest.raises(IndexError):
+        blk[-6]
+
+
+# ------------------------- adaptive bucket pick ------------------------ #
+
+def test_adaptive_bucket_tracks_arrival_rate():
+    """Slow traffic settles on the largest bucket the rate fills within
+    the budget (near-unpadded deadline dispatches); a traffic surge
+    ramps the target back toward max_batch."""
+    _, _, _, eng, cal, rows, gws = _setup()
+    now = [0.0]
+    front = ContinuousBatcher(eng, max_batch=64, latency_budget_ms=8.0,
+                              clock=lambda: now[0])
+    # 1 row per ms: the 8 ms budget holds ~8 rows
+    i = 0
+    for _ in range(40):
+        front.submit(rows[i % 400], gws[i % 400]); i += 1
+        now[0] += 0.001
+    st = front.stats()
+    assert st["target_bucket"] == 8  # largest pow2 the rate fills in-budget
+    assert max(front.dispatch_batch_sizes) <= 16
+    # surge: 16 rows per ms -> the EMA ramps the target to max_batch
+    for _ in range(400):
+        front.submit(rows[i % 400], gws[i % 400]); i += 1
+        now[0] += 0.0000625
+    assert front.stats()["target_bucket"] == 64
+    front.drain()
+    assert front.stats()["rows_served"] == front.stats()["rows_submitted"]
+
+
+# ----------------------- drift swap recommendation --------------------- #
+
+def test_drift_swap_recommended_is_debounced_and_rebaselines():
+    """swap_recommended = drifted AND sustained min_batches updates —
+    testable without an engine; rebaseline() restarts the moments."""
+    _, _, _, eng, cal, rows, gws = _setup()
+    dm = DriftMonitor(cal, z_threshold=3.0, min_count=10, min_batches=2)
+    shifted = cal.mean[0] + 50.0 * max(cal.std[0], 1e-3)
+    dm.update(np.full(20, shifted), np.zeros(20, np.int32))
+    rep1 = dm.report()
+    assert rep1["gateways"][0]["drifted"]
+    assert not rep1["gateways"][0]["swap_recommended"]  # streak 1 < 2
+    assert rep1["swap_recommended_gateways"] == []
+    dm.update(np.full(20, shifted), np.zeros(20, np.int32))
+    rep2 = dm.report()
+    assert rep2["gateways"][0]["swap_recommended"]
+    assert rep2["swap_recommended_gateways"] == [0]
+    assert rep2["min_batches"] == 2
+    json.dumps(rep2)
+    # enough in-band traffic pulls the cumulative mean back and resets
+    # the streak (the moments are lifetime Welford state, so one quiet
+    # batch after a hard shift is NOT enough — by design)
+    dm.update(np.full(10_000, float(cal.mean[0])), np.zeros(10_000,
+                                                            np.int32))
+    assert not dm.drifted().any() and not dm.swap_recommended().any()
+    # rebaseline (the threshold-swap hook) restarts the live moments
+    dm.update(np.full(20, shifted), np.zeros(20, np.int32))
+    dm.rebaseline(cal.refit(0, np.full(50, shifted)))
+    assert dm.count.sum() == 0 and not dm.drifted().any()
+    with pytest.raises(ValueError, match="rebaseline"):
+        import dataclasses
+        dm.rebaseline(dataclasses.replace(
+            cal, thresholds=np.zeros(N + 1), mean=np.zeros(N + 1),
+            std=np.zeros(N + 1), count=np.zeros(N + 1, np.int64)))
+
+
+# --------------------- engine: dispatch/harvest split ------------------ #
+
+def test_engine_dispatch_harvest_equals_score():
+    _, _, _, eng, cal, rows, gws = _setup()
+    pend = eng.dispatch(rows[:20], gws[:20])
+    got = pend.harvest()
+    assert pend.is_ready()
+    np.testing.assert_allclose(got, eng.score(rows[:20], gws[:20]),
+                               atol=1e-5)
+    assert got.dtype == np.float32 and got.shape == (20,)
+    with pytest.raises(ValueError, match="at most one bucket"):
+        eng.dispatch(rows[:65], gws[:65])  # max_bucket=64
+    with pytest.raises(ValueError, match="gateway_ids"):
+        eng.dispatch(rows[:4])
+
+
+def test_engine_swap_state_validates_and_swaps():
+    model, params, train_x, eng, cal, rows, gws = _setup()
+    with pytest.raises(ValueError, match="nothing to swap"):
+        eng.swap_state()
+    with pytest.raises(ValueError, match="without kNN banks"):
+        eng.swap_state(banks=object())
+    p2 = init_stacked_params(model, jax.random.key(4), N)
+    c2 = fit_gateway_centroids(model, p2, train_x)
+    info = eng.swap_state(params=p2, centroids=c2)
+    assert set(info["swapped"]) == {"params", "centroids"}
+    eng_ref = ServingEngine.from_federation(model, "hybrid", p2,
+                                            train_x=train_x, max_bucket=64)
+    np.testing.assert_allclose(eng.score(rows[:32], gws[:32]),
+                               eng_ref.score(rows[:32], gws[:32]),
+                               atol=1e-5)
+
+
+# ----------------------------- routing --------------------------------- #
+
+@pytest.mark.parametrize("model_type", ["autoencoder", "hybrid"])
+def test_dense_and_gather_routing_agree(model_type):
+    """'dense' (compute-all-gateways + select) and 'gather' (per-row
+    param gather) are the same math in different lowerings; scores agree
+    to float tolerance at every bucket shape."""
+    rng = np.random.default_rng(5)
+    model = make_model(model_type, DIM, shrink_lambda=1.0)
+    params = init_stacked_params(model, jax.random.key(5), N)
+    train_x = rng.normal(size=(N, 40, DIM)).astype(np.float32)
+    kw = dict(train_x=train_x, max_bucket=16)
+    dense = ServingEngine.from_federation(model, model_type, params,
+                                          routing="dense", **kw)
+    gather = ServingEngine.from_federation(model, model_type, params,
+                                           routing="gather", **kw)
+    assert dense.routing == "dense" and gather.routing == "gather"
+    rows = rng.normal(size=(37, DIM)).astype(np.float32)
+    gws = rng.integers(0, N, 37).astype(np.int32)
+    for n in (1, 3, 16, 37):
+        np.testing.assert_allclose(dense.score(rows[:n], gws[:n]),
+                                   gather.score(rows[:n], gws[:n]),
+                                   atol=1e-5)
+    # auto: dense for small federations, gather past the breakeven
+    assert ServingEngine(model, "autoencoder", params).routing == "dense"
+    big = jax.tree.map(
+        lambda t: np.repeat(np.asarray(t), 12, axis=0), params)  # N=36
+    assert ServingEngine(model, "autoencoder", big).routing == "gather"
+
+
+def test_mesh_sharded_serving_matches_unsharded(mesh8):
+    """mesh= places the gateway axis (divisible) or the row axis over
+    all devices; scores equal the single-device engine at sharded and
+    sub-device-count buckets alike."""
+    rng = np.random.default_rng(6)
+    n = 8  # divisible by the 8-device mesh: gateway-sharded state
+    model = make_model("autoencoder", DIM, shrink_lambda=1.0)
+    params = init_stacked_params(model, jax.random.key(6), n)
+    plain = ServingEngine(model, "autoencoder", params, max_bucket=64)
+    meshed = ServingEngine(model, "autoencoder", params, max_bucket=64,
+                           mesh=mesh8)
+    rows = rng.normal(size=(64, DIM)).astype(np.float32)
+    gws = rng.integers(0, n, 64).astype(np.int32)
+    for take in (64, 16, 3):  # sharded rows / sharded / replicated small
+        np.testing.assert_allclose(meshed.score(rows[:take], gws[:take]),
+                                   plain.score(rows[:take], gws[:take]),
+                                   atol=1e-5)
+    # the continuous front runs unchanged over a meshed engine
+    front = ContinuousBatcher(meshed, max_batch=32, latency_budget_ms=1e9)
+    tks = [front.submit(rows[i], gws[i]) for i in range(40)]
+    front.drain()
+    np.testing.assert_allclose([t.score for t in tks],
+                               plain.score(rows[:40], gws[:40]), atol=1e-5)
+
+
+# --------------------- sync batcher windowed wall ---------------------- #
+
+def test_microbatcher_windowed_wall_reflects_recent_rate():
+    """rows_per_sec_wall is windowed like the percentiles beside it: a
+    long slow history no longer dilutes the recent rate (the lifetime
+    quotient survives under _lifetime)."""
+    _, _, _, eng, cal, rows, gws = _setup()
+    now = [0.0]
+    b = MicroBatcher(eng, max_batch=4, max_wait_ms=1e9,
+                     clock=lambda: now[0], stats_window=8)
+    # slow era: 4 rows over 100 seconds
+    for i in range(4):
+        b.submit(rows[i], gws[i]); now[0] += 25.0
+    # fast era: 8 rows over 0.8 seconds (fills the 8-row window)
+    for i in range(4, 12):
+        b.submit(rows[i], gws[i]); now[0] += 0.1
+    b.drain()
+    st = b.stats()
+    assert st["rows_served"] == 12
+    # windowed: 8 recent rows over ~0.8 s ~ 10 rows/s
+    assert st["rows_per_sec_wall"] == pytest.approx(8 / 0.8, rel=0.2)
+    # lifetime: 12 rows over ~100.8 s ~ 0.12 rows/s
+    assert st["rows_per_sec_wall_lifetime"] == pytest.approx(12 / 100.8,
+                                                             rel=0.05)
+
+
+# --------------------------- calibration refit ------------------------- #
+
+def test_calibration_refit_builds_single_gateway_payload():
+    _, _, _, eng, cal, rows, gws = _setup()
+    fresh = np.linspace(0.0, 1.0, 101)
+    new = cal.refit(1, fresh, percentile=90.0)
+    assert new is not cal and new.num_gateways == cal.num_gateways
+    assert new.thresholds[1] == pytest.approx(np.percentile(fresh, 90.0))
+    assert new.mean[1] == pytest.approx(fresh.mean())
+    assert new.count[1] == 101
+    for g in (0, 2):  # other gateways untouched
+        assert new.thresholds[g] == cal.thresholds[g]
+        assert new.count[g] == cal.count[g]
+    with pytest.raises(ValueError, match="at least one"):
+        cal.refit(0, np.empty(0))
+
+
+# ------------------------------ driver --------------------------------- #
+
+def test_cli_serve_continuous(tmp_path):
+    """--serve --serve-continuous: the smoke pass streams through the
+    continuous front end to end (train -> checkpoint -> calibrate ->
+    serve -> drift) and reports its stats."""
+    from fedmse_tpu.config import DatasetConfig
+    from fedmse_tpu.main import main as cli_main
+    from tests.test_data import _write_client_csvs
+
+    root = str(tmp_path / "shards")
+    _write_client_csvs(root, 4, dim=6, n_normal=60, n_abnormal=24)
+    cfg_path = os.path.join(root, "config.json")
+    with open(cfg_path, "w") as f:
+        json.dump(DatasetConfig.for_client_dirs(root, 4).to_json(), f)
+    out = cli_main([
+        "--dataset-config", cfg_path,
+        "--model-types", "hybrid", "--update-types", "mse_avg",
+        "--network-size", "4", "--dim-features", "6",
+        "--epochs", "1", "--num-rounds", "1", "--batch-size", "8",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--experiment-name", "serve-c", "--serve", "--serve-rows", "256",
+        "--serve-continuous", "--serve-max-batch", "64",
+    ])
+    smoke = out["serve_smoke"]
+    assert smoke["front"] == "continuous"
+    st = smoke["batcher"]
+    assert st["front"] == "continuous"
+    assert st["rows_served"] == smoke["rows"] > 0
+    assert st["max_batch"] == 64
+    assert st["latency_p99_ms"] > 0 and st["swaps"] == []
+    assert "swap_recommended_gateways" in smoke["drift"]
+    assert glob.glob(os.path.join(
+        str(tmp_path / "ckpt"), "4", "serve-c", "0", "Serving", "*",
+        "*_calibration.json"))
+    json.dumps(smoke)
