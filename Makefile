@@ -8,8 +8,8 @@ CXXFLAGS ?= -O3 -fPIC -Wall -Wextra
 LIB := fedmse_tpu/native/libfedmse_io.so
 
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
-        serve-bench chaos-sweep pipeline-bench precision-bench shard-bench \
-        knn-bench tpu-check
+        serve-bench chaos-sweep churn-sweep pipeline-bench precision-bench \
+        shard-bench knn-bench tpu-check
 
 native: $(LIB)
 
@@ -45,6 +45,15 @@ serve-bench:
 chaos-sweep:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		python chaos_sweep.py --out CHAOS_r06.json
+
+# elastic-federation churn sweep (federation/elastic.py): 500-client
+# non-IID grid under steady churn / 50% leave burst / churn x chaos x
+# attack composition, plus the 10k-client zero-recompile pin (writes
+# CHURN_r10.json; hermetic CPU — the script pins the 8-virtual-device
+# platform itself)
+churn-sweep:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python churn_sweep.py --out CHURN_r10.json
 
 # dispatch-pipeline benchmark (federation/pipeline.py): pipelined vs
 # serial chunk loop + host-gap telemetry (writes BENCH_PIPELINE_r06_cpu.json;

@@ -27,6 +27,11 @@ repo-grown axes):
      §14): paired sync vs continuous vs burst-admission rows/s + p99 +
      device-idle fractions at batch 1024 — guards the overlap win and
      the 2.5x acceptance bar (full protocol: make serve-bench)
+ 13. elastic federation (federation/elastic.py, DESIGN.md §15): 30%/round
+     membership churn on a reduced non-IID Dirichlet grid — round cost
+     (churn must not de-fuse or recompile the dispatch), recovery rounds
+     after a 50% leave burst, membership/staleness metrics (full
+     protocol: make churn-sweep -> CHURN_r10.json)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -281,6 +286,49 @@ def scen_continuous_serving(cfg):
             "acceptance_met": res["acceptance"]["met"]}
 
 
+def scen_elastic_churn(cfg):
+    """Scenario 13: elastic membership (ISSUE 10, federation/elastic.py) —
+    a reduced 50-client Dirichlet non-IID grid under 30%/round churn plus
+    the 50% leave-burst recovery row; the committed standalone artifact
+    (make churn-sweep -> CHURN_r10.json) runs the 500-client protocol.
+    Regression guards: churned sec/round must stay in the static round's
+    regime (membership is a scan input, not a recompile), the burst must
+    recover, and joins must actually recycle slots."""
+    from churn_sweep import BURST, build_grid, run_cell
+    from fedmse_tpu.chaos import joiner_incumbent_gap
+    from fedmse_tpu.federation import ElasticSpec
+
+    ecfg = cfg.replace(network_size=50, num_participants=0.2,
+                       num_rounds=12, epochs=1)
+    data, n_real = build_grid(ecfg, 50)
+    base, base_final, _ = run_cell(ecfg, data, n_real, None,
+                                   rounds=12, label="static")
+    churn, _, _ = run_cell(
+        ecfg, data, n_real, ElasticSpec(leave_p=0.3, join_p=0.5,
+                                        start_round=1),
+        rounds=12, label="steady")
+    b0, b1 = BURST
+    burst, burst_final, burst_gen = run_cell(
+        ecfg, data, n_real,
+        ElasticSpec(leave_p=0.3, join_p=0.6, leave_window=(b0, b1),
+                    join_window=(b1, None)),
+        rounds=12, burst=(b0, b1), label="burst")
+    gap = joiner_incumbent_gap(burst_final, burst_gen,
+                               baseline_metrics=base_final)
+    return {"scenario": "elastic federation: 50-client Dirichlet grid, "
+                        "30%/round churn + 50% leave burst, 12 rounds",
+            "static_sec_per_round": base["sec_per_round"],
+            "churn_sec_per_round": churn["sec_per_round"],
+            "churn_final_auc": churn["final_auc"],
+            "mean_occupancy": churn["membership"]["mean_occupancy"],
+            "recycled_slots": churn["membership"]["recycled_slots"],
+            "mean_staleness_at_rejoin":
+                churn["membership"]["mean_staleness_at_rejoin"],
+            "burst_rounds_to_recover": burst["burst"]["rounds_to_recover"],
+            "joiner_gap_vs_baseline": gap.get("per_slot_gap_vs_baseline"),
+            "joiner_mean_gap": gap.get("mean_gap")}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -303,9 +351,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-12")
-        if not 1 <= only <= 12:
-            sys.exit(f"--only expects a scenario number 1-12, got {only}")
+            sys.exit("--only expects a scenario number 1-13")
+        if not 1 <= only <= 13:
+            sys.exit(f"--only expects a scenario number 1-13, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -390,6 +438,9 @@ def main():
 
     if only in (None, 12):
         emit(scen_continuous_serving(ExperimentConfig()))
+
+    if only in (None, 13):
+        emit(scen_elastic_churn(ExperimentConfig()))
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

@@ -1,0 +1,326 @@
+"""Elastic-federation churn sweep (ISSUE 10): dynamic membership x chaos x
+attack on the 500-client non-IID grid, plus the 10k-client zero-recompile
+pin — the measurement half of federation/elastic.py.
+
+chaos_sweep.py measured peers that VANISH transiently; attack_sweep.py
+peers that LIE. This sweep measures a fleet that is never the same twice:
+slots retire (tenant leaves, moments invalidated), recycle (new tenant,
+generation += 1, params inherited from the incumbent-mean global model),
+and the schedule never recompiles because membership rides the fused scan
+as precomputed [T, N] tensors.
+
+Protocol (hermetic CPU, 8 virtual devices pinned at module import):
+
+  * **grid**: 500-client Dirichlet(alpha=0.5) non-IID shards
+    (data/synthetic.py synthetic_dirichlet_clients — ROADMAP 5's "the
+    current grids are IID" closed), hybrid + mse_avg, 16 fused rounds,
+    20% participation. Rows: static baseline, null-ElasticSpec (pinned
+    BIT-identical to static), steady churn at 10% and 30%/round;
+  * **burst**: a 50% leave burst (leave_p=0.3 over rounds [4, 6) ≈ 51%
+    departed), rejoin wave from round 6 — reports rounds-to-recover-AUC
+    (chaos/metrics.py) and the late-joiner-vs-incumbent final-AUC gap,
+    per-slot against the static baseline (acceptance bar: within 2e-3);
+  * **composition**: churn x chaos (30% dropout, crash p=0.1) x attack
+    (scale-50 malicious aggregator from round 1) — the full threat model
+    in one schedule;
+  * **10k zero-recompile**: a 10k-client fused schedule with 30%/round
+    membership churn on the virtual 8-device mesh; after a warmup chunk
+    the jit executable-cache size is pinned across further churning
+    chunks (the PR 8 `_cache_size` idiom) — membership is DATA, so churn
+    compiles nothing.
+
+Writes CHURN.json (override with --out) and prints one line per row.
+Run: `make churn-sweep` (env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+python churn_sweep.py --out CHURN_r10.json).
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+# the 10k row needs the 8-virtual-device mesh, and XLA reads the flag at
+# backend init — pin it before anything imports jax (conftest idiom)
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+from bench import _ensure_live_backend  # noqa: E402
+
+ROUNDS = 16
+BURST = (4, 6)          # leave burst window [start, stop)
+GRID_CLIENTS = 500
+ALPHA = 0.5
+
+
+def build_grid(cfg, n_clients, alpha=ALPHA, label_shift=0.0):
+    """The non-IID churn grid: Dirichlet(alpha) feature skew (+ optional
+    label shift) over synthetic traffic modes — heterogeneous shards, the
+    regime ROADMAP 5 asked the churn scenarios to run over."""
+    import numpy as np
+    from fedmse_tpu.data import (build_dev_dataset, stack_clients,
+                                 synthetic_dirichlet_clients)
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    clients = synthetic_dirichlet_clients(
+        n_clients=n_clients, dim=cfg.dim_features, rows_per_client=160,
+        abnormal_per_client=64, modes=3, alpha=alpha,
+        label_shift=label_shift, seed=7)
+    rngs = ExperimentRngs(run=0, data_seed=cfg.data_seed)
+    dev_x = build_dev_dataset(clients, rngs.data_rng)
+    data = stack_clients(clients, dev_x, cfg.batch_size)
+    return data, len(clients)
+
+
+def run_cell(cfg, data, n_real, elastic, chaos=None, attack=None,
+             rounds=ROUNDS, burst=None, label=None):
+    import numpy as np
+    from fedmse_tpu.chaos import membership_metrics, resilience_metrics
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.federation.attack import make_poison_fn
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.parallel import host_fetch
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    poison = None if attack is None else make_poison_fn(attack)
+    model = make_model("hybrid", cfg.dim_features,
+                       shrink_lambda=cfg.shrink_lambda)
+    engine = RoundEngine(model, cfg, data, n_real=n_real,
+                         rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                         model_type="hybrid", update_type="mse_avg",
+                         fused=True, poison_fn=poison, chaos=chaos,
+                         elastic=elastic)
+    t0 = time.time()
+    results = engine.run_rounds(0, rounds)
+    sec = (time.time() - t0) / rounds
+    final_metrics = np.asarray(host_fetch(engine.evaluate_all(
+        engine.states.params, data.test_x, data.test_m, data.test_y,
+        data.train_xb, data.train_mb)))[:n_real]
+    if results[-1].members is not None:
+        # a slot retired at the horizon holds its departed tenant's frozen
+        # params — NaN it (the driver's final-roster rule, main.py), so a
+        # stale leaver can't pose as an incumbent in joiner_incumbent_gap
+        member = np.zeros(n_real, bool)
+        member[results[-1].members] = True
+        final_metrics = np.where(member, final_metrics, np.nan)
+    burst_kw = ({} if burst is None
+                else {"burst_start": burst[0], "burst_stop": burst[1],
+                      "recover_eps": 2e-3})
+    row = {
+        "label": label or "grid",
+        "elastic": None if elastic is None else {
+            "leave_p": elastic.leave_p, "join_p": elastic.join_p,
+            "preempt_p": elastic.preempt_p,
+            "signature": elastic.signature()},
+        "chaos": None if chaos is None else {
+            "dropout_p": chaos.dropout_p, "crash_p": chaos.crash_p},
+        "attack": (None if attack is None else
+                   f"{attack.kind}-{attack.strength:g}"
+                   f"-s{attack.start_round}"),
+        "sec_per_round": round(sec, 4),
+        **resilience_metrics(results, **burst_kw),
+        "membership": membership_metrics(results),
+    }
+    generations = (results[-1].generations
+                   if results[-1].generations is not None else None)
+    return row, final_metrics, generations
+
+
+def zero_recompile_10k(cfg):
+    """10k-client fused schedule, 30%/round churn, virtual 8-device mesh:
+    after the warmup chunk compiles, further churning chunks must hit the
+    SAME executable (membership is a scan input, not program structure) —
+    pinned via the jit cache size, and null-churn pinned bit-identical to
+    the static path at the same scale."""
+    import numpy as np
+    import jax
+    from bench import _light_clients
+    from fedmse_tpu.data import stack_clients
+    from fedmse_tpu.federation import ElasticSpec, RoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.parallel import client_mesh, shard_federation
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    n_clients = 10_000
+    mesh = client_mesh()
+    assert mesh.devices.size >= 8, (
+        "10k row needs the 8-virtual-device mesh "
+        "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    # thin shards, bulk-drawn (the BENCH_SHARD builder): the row measures
+    # dispatch/compile behavior under churn, not AUC
+    rngs = ExperimentRngs(run=0, data_seed=cfg.data_seed)
+    clients, dev_x = _light_clients(n_clients, cfg.dim_features)
+    data = stack_clients(clients, dev_x, cfg.batch_size)
+
+    ccfg = cfg.replace(network_size=n_clients, num_participants=0.02,
+                       num_rounds=8, epochs=1, fused_schedule_chunk=2)
+    spec = ElasticSpec(leave_p=0.3, join_p=0.3)
+    model = make_model("hybrid", ccfg.dim_features,
+                       shrink_lambda=ccfg.shrink_lambda)
+    out = {"n_clients": n_clients, "mesh_devices": int(mesh.devices.size),
+           "churn": "leave_p=0.3 join_p=0.3 (30%/round)"}
+
+    def run_chunks(elastic):
+        eng = RoundEngine(model, ccfg, data, n_real=n_clients, rngs=rngs,
+                          model_type="hybrid", update_type="mse_avg",
+                          fused=True, elastic=elastic, mesh=mesh)
+        eng.data, eng.states = shard_federation(data, eng.states, mesh)
+        eng._ver_x, eng._ver_m = eng._verification_tensors()
+        t0 = time.time()
+        eng.run_schedule_chunk(0, 2)          # warmup chunk (compiles)
+        warm = time.time() - t0
+        cache = eng._fused_scan._cache_size()
+        t0 = time.time()
+        eng.run_schedule_chunk(2, 2)          # churned chunks: same program
+        eng.run_schedule_chunk(4, 2)
+        sec = (time.time() - t0) / 4
+        return eng, cache, eng._fused_scan._cache_size(), warm, sec
+
+    eng, cache0, cache1, warm, sec = run_chunks(spec)
+    out["jit_cache_after_warmup"] = cache0
+    out["jit_cache_after_churn_chunks"] = cache1
+    out["zero_recompiles"] = bool(cache0 == cache1)
+    out["warmup_chunk_sec"] = round(warm, 2)
+    out["warm_sec_per_round"] = round(sec, 3)
+
+    # null-churn bitwise pin at the same scale: 2 rounds static vs null
+    def two_rounds(elastic):
+        eng = RoundEngine(model, ccfg.replace(num_rounds=2), data,
+                          n_real=n_clients, rngs=ExperimentRngs(
+                              run=0, data_seed=ccfg.data_seed),
+                          model_type="hybrid", update_type="mse_avg",
+                          fused=True, elastic=elastic, mesh=mesh)
+        eng.data, eng.states = shard_federation(data, eng.states, mesh)
+        eng._ver_x, eng._ver_m = eng._verification_tensors()
+        eng.run_schedule_chunk(0, 2)
+        return jax.tree.leaves(jax.device_get(eng.states.params))
+
+    static = two_rounds(None)
+    null = two_rounds(ElasticSpec())
+    out["null_churn_bitwise_identical"] = bool(all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(static, null)))
+    return out
+
+
+def main():
+    _ensure_live_backend()
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
+    enable_compilation_cache()
+    capture_provenance()  # pin git state before any timed work
+    import numpy as np
+    import jax
+
+    from fedmse_tpu.chaos import ChaosSpec, joiner_incumbent_gap
+    from fedmse_tpu.config import ExperimentConfig
+    from fedmse_tpu.federation import ElasticSpec
+    from fedmse_tpu.federation.attack import AttackSpec
+
+    out_path = "CHURN.json"
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    n_grid = GRID_CLIENTS
+    if "--clients" in sys.argv:
+        n_grid = int(sys.argv[sys.argv.index("--clients") + 1])
+
+    cfg = ExperimentConfig(network_size=n_grid, num_participants=0.2,
+                           num_rounds=ROUNDS, epochs=1)
+    data, n_real = build_grid(cfg, n_grid)
+
+    rows = []
+
+    def emit(row):
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # ---- static baseline + the null-spec bitwise pin ----
+    base_row, base_final, _ = run_cell(cfg, data, n_real, None,
+                                       label="static-baseline")
+    emit(base_row)
+    null_row, null_final, _ = run_cell(cfg, data, n_real, ElasticSpec(),
+                                       label="null-elastic")
+    # equal_nan: hybrid-CEN per-client metrics legitimately carry NaN for
+    # clients whose thin non-IID shard defeats the metric; both runs must
+    # produce the SAME NaNs in the SAME slots (NaN != NaN would fail a
+    # bit-identical pair under plain array_equal)
+    null_row["bit_identical_to_static"] = bool(
+        np.array_equal(base_final, null_final, equal_nan=True)
+        and base_row["auc_curve"] == null_row["auc_curve"])
+    emit(null_row)
+
+    # ---- steady churn: 10% and 30% per-round ----
+    for leave_p, join_p in ((0.1, 0.3), (0.3, 0.5)):
+        row, _, _ = run_cell(
+            cfg, data, n_real,
+            ElasticSpec(leave_p=leave_p, join_p=join_p, start_round=1),
+            label=f"steady-churn-{leave_p:g}")
+        emit(row)
+
+    # ---- the 50% leave burst + rejoin wave (the acceptance row) ----
+    b0, b1 = BURST
+    burst_spec = ElasticSpec(leave_p=0.3, join_p=0.6,
+                             leave_window=(b0, b1),
+                             join_window=(b1, None))
+    row, burst_final, burst_gen = run_cell(cfg, data, n_real, burst_spec,
+                                           rounds=ROUNDS, burst=(b0, b1),
+                                           label="leave-burst-50pct")
+    gap = joiner_incumbent_gap(burst_final, burst_gen,
+                               baseline_metrics=base_final)
+    row["joiner_gap"] = gap
+    # the acceptance bar is stated over the joiner-vs-incumbent reading
+    # (joiner cohort mean within 2e-3 of the incumbent cohort mean) with
+    # the deconfounded mean per-slot deficit agreeing; the per-slot MAX is
+    # reported alongside but not gated — under non-IID churn a single
+    # late-joining slot on a hard shard can lag by more than the cohort
+    # without the recovery mechanism being at fault
+    row["joiners_within_2e3_of_incumbents"] = bool(
+        gap.get("mean_gap") is not None and abs(gap["mean_gap"]) <= 2e-3
+        and gap.get("per_slot_gap_mean_vs_baseline") is not None
+        and gap["per_slot_gap_mean_vs_baseline"] <= 2e-3)
+    emit(row)
+
+    # ---- composition: churn x chaos x attack (the full threat model) ----
+    row, _, _ = run_cell(
+        cfg, data, n_real,
+        ElasticSpec(leave_p=0.2, join_p=0.4, start_round=1),
+        chaos=ChaosSpec(dropout_p=0.3, crash_p=0.1),
+        attack=AttackSpec(kind="scale", strength=50.0, start_round=1),
+        label="churn+chaos+attack")
+    emit(row)
+
+    # ---- 10k clients, 30%/round churn, zero recompiles ----
+    emit({"label": "10k-zero-recompile",
+          **zero_recompile_10k(ExperimentConfig())})
+
+    device = jax.devices()[0]
+    out = {
+        "protocol": f"{n_grid}-client Dirichlet({ALPHA}) non-IID synthetic "
+                    f"grid, hybrid+mse_avg, {ROUNDS} fused rounds, 20% "
+                    f"participation; leave burst rounds [{b0}, {b1}) at "
+                    f"leave_p=0.3 (~51% departed), rejoin from {b1}; "
+                    f"joiner acceptance: joiner-cohort mean AUC within 2e-3 "
+                    f"of the incumbent cohort AND mean per-slot deficit vs "
+                    f"the static baseline within 2e-3 (max per-slot deficit "
+                    f"reported, not gated — chaos/metrics.py "
+                    f"joiner_incumbent_gap); 10k row pins zero recompiles "
+                    f"across churning chunks (_cache_size) and null-churn "
+                    f"bitwise parity; sec_per_round of the first static and "
+                    f"first elastic row includes that program's jit compile "
+                    f"(later rows of the same program family are warm)",
+        "device": str(device), "platform": device.platform,
+        "rows": rows,
+        **capture_provenance(),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"wrote": out_path, "n_rows": len(rows)}))
+
+
+if __name__ == "__main__":
+    main()

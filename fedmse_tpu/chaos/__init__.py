@@ -13,7 +13,9 @@ that lie AND peers that vanish is the paper's actual threat model
 from fedmse_tpu.chaos.masks import (ChaosMasks, all_clear_masks,
                                     make_batched_chaos_masks,
                                     make_chaos_masks)
-from fedmse_tpu.chaos.metrics import (mean_auc_curve, quota_exhaustion_round,
+from fedmse_tpu.chaos.metrics import (joiner_incumbent_gap, mean_auc_curve,
+                                      membership_metrics,
+                                      quota_exhaustion_round,
                                       resilience_metrics, rounds_to_recover)
 from fedmse_tpu.chaos.spec import ChaosSpec
 
@@ -21,9 +23,11 @@ __all__ = [
     "ChaosMasks",
     "ChaosSpec",
     "all_clear_masks",
+    "joiner_incumbent_gap",
     "make_batched_chaos_masks",
     "make_chaos_masks",
     "mean_auc_curve",
+    "membership_metrics",
     "quota_exhaustion_round",
     "resilience_metrics",
     "rounds_to_recover",

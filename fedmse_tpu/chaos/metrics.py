@@ -25,7 +25,12 @@ Metrics:
     must absorb;
   * rounds-to-recover — after a fault/attack burst ends (AttackSpec
     stop_round / ChaosSpec stop_round), how many rounds until mean AUC is
-    back within `eps` of its pre-burst best (None = never recovered).
+    back within `eps` of its pre-burst best (None = never recovered);
+  * membership metrics (elastic federation, federation/elastic.py) —
+    slot-recycle counts, staleness-at-rejoin (how many rounds a slot sat
+    retired before a new tenant recycled it), join/leave totals, mean
+    occupancy, and the late-joiner-vs-incumbent final-AUC gap the
+    churn-recovery guarantee is stated over (churn_sweep.py).
 """
 
 from __future__ import annotations
@@ -126,4 +131,117 @@ def resilience_metrics(results: Sequence, burst_start: Optional[int] = None,
         out["burst"] = {"start": burst_start, "stop": burst_stop,
                         "recover_eps": recover_eps,
                         "rounds_to_recover": rec}
+    return out
+
+
+def membership_metrics(results: Sequence,
+                       initial_members: Optional[np.ndarray] = None) -> Dict:
+    """Churn observables from an elastic schedule's RoundResult stream
+    (each result carries `members` — the occupied real slots — and
+    `generations`; federation/elastic.py).
+
+    Staleness-at-rejoin: for every recycle event (a slot's generation
+    increments between consecutive rounds) the number of rounds the slot
+    sat retired beforehand — 0 for a preemption (the slot never emptied),
+    k for a slot recycled k rounds after its tenant left. The longer a
+    slot was dark, the further the federation moved past its last tenant;
+    the join-inherits-global rule is what keeps this number from mattering
+    (the new tenant starts at the CURRENT model, not the departed one's).
+
+    `initial_members` is the [n_real] bool occupancy BEFORE the first row
+    (ElasticSpec.initial_member_frac < 1 starts some slots empty); without
+    it the default full pool would miscount every initially-empty slot as
+    a first-round leave."""
+    rows = [r for r in results if r.members is not None]
+    if not rows:
+        return {"elastic": False}
+    n_real = len(rows[0].generations)
+    if initial_members is None:
+        prev_member = np.ones(n_real, dtype=bool)  # pool starts occupied
+    else:
+        prev_member = np.asarray(initial_members, dtype=bool).copy()
+    prev_gen = np.zeros(n_real, dtype=np.int64)
+    retired_since = np.full(n_real, -1, dtype=np.int64)  # -1 = occupied
+    first_round = rows[0].round_index
+    # an initially-empty slot was never occupied: it is "retired since
+    # before the stream", not a leave — staleness for its first tenant
+    # measures from the schedule start
+    retired_since[~prev_member] = first_round
+    staleness: List[int] = []
+    joins = 0
+    leaves = 0
+    occupancy = []
+    for r in rows:
+        member = np.zeros(n_real, dtype=bool)
+        member[r.members] = True
+        gen = np.asarray(r.generations)
+        t = r.round_index
+        for i in np.flatnonzero(gen > prev_gen):
+            staleness.append(int(t - retired_since[i])
+                             if retired_since[i] >= 0 else 0)
+            joins += 1
+        left_now = prev_member & ~member
+        leaves += int(left_now.sum())
+        retired_since[left_now] = t
+        retired_since[member] = -1
+        occupancy.append(member.sum() / n_real)
+        prev_member, prev_gen = member, gen
+    final_gen = rows[-1].generations
+    return {
+        "elastic": True,
+        "joins": joins,
+        "leaves": leaves,
+        "mean_occupancy": round(float(np.mean(occupancy)), 4),
+        "final_members": int(len(rows[-1].members)),
+        "slot_recycle_counts": np.asarray(final_gen).astype(int).tolist(),
+        "recycled_slots": int((np.asarray(final_gen) > 0).sum()),
+        "staleness_at_rejoin": staleness,
+        "mean_staleness_at_rejoin": (
+            round(float(np.mean(staleness)), 3) if staleness else None),
+        "max_staleness_at_rejoin": (max(staleness) if staleness else None),
+    }
+
+
+def joiner_incumbent_gap(final_metrics: np.ndarray,
+                         generations: np.ndarray,
+                         baseline_metrics: Optional[np.ndarray] = None
+                         ) -> Dict:
+    """The churn-recovery guarantee's observable: how close late joiners
+    end up to the incumbents.
+
+    Two readings, both reported:
+      * `mean_gap` — incumbent-mean final AUC minus joiner-mean final AUC
+        on the SAME run (positive = joiners trail). Confounded by shard
+        composition when the data is non-IID (a joiner slot may simply
+        hold a harder shard);
+      * `per_slot_gap_vs_baseline` — with `baseline_metrics` from a static
+        run of the same seed/data, each recycled slot's AUC deficit
+        against what that SAME slot scored as a never-churned incumbent.
+        This is the deconfounded reading the CHURN artifact's 2e-3
+        acceptance bar is stated over.
+    """
+    gen = np.asarray(generations)
+    m = np.asarray(final_metrics, dtype=float)
+    joiner = gen > 0
+    out = {
+        "joiners": int(joiner.sum()),
+        "incumbents": int((~joiner).sum()),
+        "joiner_mean_auc": (round(float(np.nanmean(m[joiner])), 5)
+                            if joiner.any() else None),
+        "incumbent_mean_auc": (round(float(np.nanmean(m[~joiner])), 5)
+                               if (~joiner).any() else None),
+    }
+    if joiner.any() and (~joiner).any():
+        out["mean_gap"] = round(
+            float(np.nanmean(m[~joiner]) - np.nanmean(m[joiner])), 5)
+    else:
+        out["mean_gap"] = None
+    if baseline_metrics is not None and joiner.any():
+        base = np.asarray(baseline_metrics, dtype=float)
+        gaps = base[joiner] - m[joiner]
+        finite = gaps[~np.isnan(gaps)]
+        out["per_slot_gap_vs_baseline"] = (
+            round(float(np.max(finite)), 5) if finite.size else None)
+        out["per_slot_gap_mean_vs_baseline"] = (
+            round(float(np.mean(finite)), 5) if finite.size else None)
     return out

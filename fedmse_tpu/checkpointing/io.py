@@ -33,6 +33,7 @@ import pickle
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
@@ -66,14 +67,23 @@ class ResultsWriter:
         os.makedirs(d, exist_ok=True)
         path = os.path.join(
             d, f"{self.scen}_{self.ratio}_{model_type}_{update_type}_results.json")
+        metrics = np.asarray(client_metrics, dtype=float)
+        # nan-aware min: under elastic membership a retired slot's metric
+        # is NaN ("nobody there" — federation/elastic.py), and np.min
+        # would poison global_loss for the whole round; static runs never
+        # carry NaN here, so the reference artifact is unchanged for them
+        finite = metrics.size and bool(np.any(~np.isnan(metrics)))
         with open(path, "a") as f:
             json.dump({
                 "round": round_index + 1,
-                "client_metrics": [float(m) for m in client_metrics],
+                # a retired slot's NaN serializes as null, not the bare
+                # NaN token (json.dump default) that strict parsers reject
+                "client_metrics": [None if np.isnan(m) else float(m)
+                                   for m in metrics],
                 "update_type": update_type,
                 "model_type": model_type,
-                "global_loss": float(np.min(client_metrics))
-                if len(client_metrics) else float("inf"),
+                "global_loss": float(np.nanmin(metrics))
+                if finite else float("inf"),
             }, f)
             f.write("\n")
         return path
@@ -189,8 +199,25 @@ class CheckpointManager:
     def save(self, tag: str, states: ClientStates, host: HostState,
              round_index: int, extra: Optional[Dict] = None,
              tracking: Optional[np.ndarray] = None) -> None:
+        # Hand Orbax host-owned COPIES, never live jax buffers: the
+        # TensorStore write path retains a zero-copy reference to the
+        # source memory beyond wait_until_finished
+        # (can_reference_source_data_indefinitely=True in orbax
+        # serialization), and on CPU np.asarray(jax.Array) aliases the XLA
+        # buffer directly — so when the donated fused scan later reuses
+        # that buffer, the retained chunk-cache reference is silently
+        # poisoned and the NEXT save of this tag writes garbage to disk.
+        # Multi-controller arrays can't be gathered to one host (np.array
+        # raises on non-addressable shards); they pass through unchanged —
+        # their serialization D2H-copies into fresh host buffers, so the
+        # aliasing hazard is CPU/fully-addressable-only anyway.
+        def host_copy(leaf):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                return leaf
+            return np.array(leaf)
+
         payload = {
-            "states": dataclasses.asdict(states),
+            "states": jax.tree.map(host_copy, dataclasses.asdict(states)),
             "round_index": np.asarray(round_index),
         }
         self._ckpt.save(self._path(tag), payload, force=True)
@@ -255,6 +282,14 @@ class CheckpointManager:
             "round_index": np.asarray(0),
         }
         payload = self._ckpt.restore(self._path(tag), target)
+        # The mirror of save()'s host-copy rule: TensorStore's restore can
+        # alias its chunk-cache host buffers straight into the returned
+        # jax.Arrays (zero-copy device_put on CPU). Handing those to the
+        # engine lets the donated fused scan scribble on memory TensorStore
+        # still references, so the NEXT save of this tag flushes poisoned
+        # bytes to disk. jnp.copy rehomes each leaf into a fresh XLA-owned
+        # buffer (keeping its sharding) before anything can donate it.
+        payload = jax.tree.map(jnp.copy, payload)
         states = ClientStates(**payload["states"])
         with open(self._path(tag) + ".host.json") as f:
             meta = json.load(f)

@@ -7,6 +7,7 @@ from fedmse_tpu.data.loader import (
 )
 from fedmse_tpu.data.stacking import FederatedData, stack_clients
 from fedmse_tpu.data.synthetic import (synthetic_clients,
+                                       synthetic_dirichlet_clients,
                                        synthetic_multimodal_clients)
 
 __all__ = [
@@ -18,5 +19,6 @@ __all__ = [
     "prepare_clients",
     "stack_clients",
     "synthetic_clients",
+    "synthetic_dirichlet_clients",
     "synthetic_multimodal_clients",
 ]

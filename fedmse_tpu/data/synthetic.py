@@ -4,6 +4,16 @@ Mirrors the statistical shape of the N-BaIoT pipeline output (standardized
 normal traffic clustered per client, abnormal traffic shifted/scaled) without
 touching the real CSVs. Used by the test pyramid (SURVEY.md §4: 'integration
 tests on synthetic Gaussian data, tiny dims') and by bench.py's warm-up mode.
+
+`synthetic_dirichlet_clients` closes the ROADMAP-5 gap "the current grids
+are IID": it reuses the offline shard tool's partitioners (data/prep.py —
+`dirichlet_partition` was previously reachable only through the CSV-
+rewriting CLI) to build HETEROGENEOUS in-memory grids: per-client feature
+distributions skewed by Dirichlet(alpha) over traffic modes, optionally
+with label shift (per-client anomaly prevalence skew). The churn scenarios
+(churn_sweep.py, bench_suite scenario 13) run over these shards — a fleet
+that is never the same twice, serving traffic that is never the same
+either.
 """
 
 from __future__ import annotations
@@ -106,6 +116,108 @@ def synthetic_multimodal_clients(
             valid_x=valid_x.astype(np.float32),
             test_x=np.concatenate([test_x, ab_x]).astype(np.float32),
             test_y=np.concatenate([test_y, ab_y]).astype(np.float32),
+            dev_raw=pd.DataFrame(dev),
+            scaler=proc,
+        ))
+    return clients
+
+
+def synthetic_dirichlet_clients(
+    n_clients: int = 4,
+    dim: int = 16,
+    rows_per_client: int = 240,
+    abnormal_per_client: int = 120,
+    modes: int = 3,
+    alpha: float = 0.5,
+    label_shift: float = 0.0,
+    min_rows: int = 40,
+    seed: int = 0,
+) -> List[ClientData]:
+    """Non-IID federated grid via the prep-tool partitioners (ROADMAP 5).
+
+    A pooled population of `modes` well-separated Gaussian traffic modes
+    (each row labeled by its mode of origin) is partitioned across clients
+    with `data.prep.dirichlet_partition(alpha)` — small alpha gives each
+    client a narrow mode mixture (heterogeneous feature distributions),
+    alpha ~ 1000 degenerates to IID. Abnormal rows (shifted/scaled, as in
+    `synthetic_clients`) are labeled by their NEAREST normal mode and
+    partitioned with the SAME per-label proportions (`prop_seed` —
+    the notebook's correlated-draw construction, data/prep.py), so each
+    client is tested against anomalies near the modes it actually serves.
+
+    `label_shift` > 0 additionally skews per-client anomaly PREVALENCE
+    (class-prior shift): each client's share of the anomaly pool is drawn
+    from Dirichlet(label_shift) instead of tracking its normal share —
+    small values give a few anomaly-flooded clients and many anomaly-free
+    ones. 0 (default) keeps prevalence tied to the feature partition.
+
+    Thin shards are expected under skew; `min_rows` tops up starved
+    clients with uniform pool re-draws so every client stays trainable
+    (the federation layer handles ragged shards via row masks). Splits and
+    standardization are per client, same 40/10/40/10 discipline as the
+    other generators."""
+    from fedmse_tpu.data.prep import dirichlet_partition
+
+    rng = np.random.default_rng(seed)
+    n_normal_total = n_clients * rows_per_client
+    n_abnormal_total = n_clients * abnormal_per_client
+    centers = rng.normal(0, 3.0, size=(modes, dim))
+    origin = rng.integers(0, modes, size=n_normal_total)
+    normal = centers[origin] + rng.normal(0, 1.0, size=(n_normal_total, dim))
+    ab_mode = rng.integers(0, modes, size=n_abnormal_total)
+    abnormal = (centers[ab_mode] + 4.0
+                + rng.normal(0, 2.0, size=(n_abnormal_total, dim)))
+
+    parts = dirichlet_partition(origin, n_clients, alpha, rng,
+                                prop_seed=seed)
+    if label_shift > 0:
+        # label shift: anomaly prevalence decouples from the feature
+        # partition — per-client anomaly volume from its own Dirichlet
+        shares = np.random.default_rng([seed, 0x4C53]).dirichlet(
+            np.full(n_clients, label_shift))
+        counts = np.floor(shares * n_abnormal_total).astype(int)
+        idx = rng.permutation(n_abnormal_total)
+        ab_parts = list(np.split(idx, np.cumsum(counts)[:-1]))[:n_clients]
+    else:
+        ab_parts = dirichlet_partition(ab_mode, n_clients, alpha, rng,
+                                       prop_seed=seed)
+
+    clients = []
+    for i in range(n_clients):
+        idx = parts[i]
+        if len(idx) < min_rows:  # top up starved shards: stay trainable
+            extra = rng.choice(n_normal_total, size=min_rows - len(idx),
+                               replace=False)
+            idx = np.concatenate([idx, extra]).astype(int)
+        rows = normal[idx]
+        rng.shuffle(rows)
+        ab_rows = abnormal[ab_parts[i]] if len(ab_parts[i]) else \
+            np.empty((0, dim))
+
+        n = len(rows)
+        n_train = int(0.4 * n)
+        n_valid = max(1, int(0.1 * n))
+        n_dev = int(0.4 * n)
+        train = rows[:n_train]
+        valid = rows[n_train:n_train + n_valid]
+        dev = rows[n_train + n_valid:n_train + n_valid + n_dev]
+        test = rows[n_train + n_valid + n_dev:]
+
+        proc = IoTDataProcessor(scaler="standard")
+        train_x, _ = proc.fit_transform(train)
+        valid_x, _ = proc.transform(valid)
+        test_x, test_y = proc.transform(test)
+        if len(ab_rows):
+            ab_x, ab_y = proc.transform(ab_rows, type="abnormal")
+            test_x = np.concatenate([test_x, ab_x])
+            test_y = np.concatenate([test_y, ab_y])
+
+        clients.append(ClientData(
+            name=f"dirichlet-{i + 1}",
+            train_x=train_x.astype(np.float32),
+            valid_x=valid_x.astype(np.float32),
+            test_x=test_x.astype(np.float32),
+            test_y=test_y.astype(np.float32),
             dev_raw=pd.DataFrame(dev),
             scaler=proc,
         ))

@@ -1,4 +1,9 @@
 from fedmse_tpu.federation.state import ClientStates, init_client_states
+from fedmse_tpu.federation.elastic import (ElasticSpec, MembershipMasks,
+                                           all_member_masks,
+                                           make_batched_membership_masks,
+                                           make_membership_masks,
+                                           membership_at)
 from fedmse_tpu.federation.local_training import make_local_train_all
 from fedmse_tpu.federation.aggregation import make_aggregate_fn
 from fedmse_tpu.federation.attack import AttackSpec, make_poison_fn, poison_params
@@ -14,6 +19,12 @@ __all__ = [
     "AttackSpec",
     "BatchedRunEngine",
     "ClientStates",
+    "ElasticSpec",
+    "MembershipMasks",
+    "all_member_masks",
+    "make_batched_membership_masks",
+    "make_membership_masks",
+    "membership_at",
     "InFlightChunk",
     "PipelineStats",
     "RoundEngine",

@@ -46,6 +46,7 @@ import numpy as np
 # per-chunk dispatch prep pays no import lookup
 from fedmse_tpu.chaos.masks import make_batched_chaos_masks
 from fedmse_tpu.config import ExperimentConfig
+from fedmse_tpu.federation.elastic import make_batched_membership_masks
 from fedmse_tpu.data.stacking import FederatedData
 from fedmse_tpu.federation.pipeline import InFlightChunk
 from fedmse_tpu.federation.rounds import (RoundResult, _PROGRAM_CACHE,
@@ -72,7 +73,7 @@ class BatchedRunEngine:
 
     def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
                  n_real: int, runs: int, model_type: str, update_type: str,
-                 poison_fn=None, chaos=None):
+                 poison_fn=None, chaos=None, elastic=None):
         if cfg.metric == "time":
             raise ValueError(
                 "metric='time' is host-side wall-clock and cannot be traced "
@@ -90,6 +91,9 @@ class BatchedRunEngine:
         # each drawn from that run's own domain-separated chaos key — the
         # batched lanes see bit-identical faults to R sequential chaos runs
         self.chaos = chaos
+        # elastic membership (federation/elastic.py): per-run timelines
+        # from each run's own domain-separated elastic key, same contract
+        self.elastic = elastic
 
         programs = _engine_programs(model, cfg, model_type, update_type)
         self.tx = programs["tx"]
@@ -119,6 +123,11 @@ class BatchedRunEngine:
         # whole-schedule per-run chaos-mask cache (see _chaos_masks)
         self._chaos_premade = None
         self._chaos_horizon = 0
+        self._elastic_keys = ([r.elastic_key() for r in self.rngs]
+                              if self.elastic is not None else None)
+        # whole-schedule per-run membership cache (see _elastic_masks)
+        self._elastic_premade = None
+        self._elastic_horizon = 0
 
     def _chaos_masks(self, start_round: int, k: int):
         """[k, R, ...]-stacked per-run fault tensors for the chunk — same
@@ -135,6 +144,45 @@ class BatchedRunEngine:
         return jax.tree.map(lambda t: t[start_round:end],
                             self._chaos_premade)
 
+    def _elastic_masks(self, start_round: int, k: int):
+        """[k, R, N]-stacked per-run membership tensors for the chunk —
+        the Markov timeline expands once from round 0 per run (one vmapped
+        dispatch) and chunks take slices; a replay recomputes nothing
+        (RoundEngine._elastic_masks docstring)."""
+        end = start_round + k
+        if self._elastic_premade is None or end > self._elastic_horizon:
+            self._elastic_horizon = max(end, self.cfg.num_rounds)
+            self._elastic_premade = make_batched_membership_masks(
+                self.elastic, self._elastic_keys, self._elastic_horizon,
+                self.n_pad)
+        return jax.tree.map(lambda t: t[start_round:end],
+                            self._elastic_premade)
+
+    def members_at(self, round_index: int, run: int):
+        """Host [n_real] bool occupancy of run `run` AFTER `round_index`
+        rounds (the RoundEngine.members_at contract, per-run timeline).
+        None without an ElasticSpec."""
+        if self.elastic is None:
+            return None
+        if round_index <= 0:
+            return np.ones(self.n_real, bool)
+        from fedmse_tpu.federation.elastic import membership_at
+        self._elastic_masks(round_index - 1, 1)
+        per_run = jax.tree.map(lambda t: t[:, run],
+                               self._elastic_premade)
+        member, _ = membership_at(per_run, round_index, self.n_real)
+        return member
+
+    def _mask_kwargs(self, start_round: int, k: int) -> dict:
+        """Fault/membership xs for one dispatch, as keywords (the
+        RoundEngine idiom — either axis composes alone)."""
+        kw = {}
+        if self.chaos is not None:
+            kw["chaos_masks"] = self._chaos_masks(start_round, k)
+        if self.elastic is not None:
+            kw["elastic_masks"] = self._elastic_masks(start_round, k)
+        return kw
+
     @property
     def compact(self) -> bool:
         """Same policy as RoundEngine.compact: compact-cohort gathers stay on
@@ -150,11 +198,13 @@ class BatchedRunEngine:
         self._scan_compact = self.compact
         args = self._builder_args + (self._scan_compact, self.poison_fn)
         with_chaos = self.chaos is not None  # program depends on the BOOL
-        key = ("batched_runs",) + args[:-1] + (with_chaos,)
+        with_elastic = self.elastic is not None
+        key = ("batched_runs",) + args[:-1] + (with_chaos, with_elastic)
         if self.poison_fn is None and key in _PROGRAM_CACHE:
             self._scan = _PROGRAM_CACHE[key]
             return
-        self._scan = make_batched_runs_scan(*args, chaos=with_chaos)
+        self._scan = make_batched_runs_scan(*args, chaos=with_chaos,
+                                            elastic=with_elastic)
         if self.poison_fn is None:
             _cache_put(key, self._scan)
 
@@ -208,17 +258,15 @@ class BatchedRunEngine:
         for i in range(k):
             for r in range(self.runs):
                 masks[i, r, schedule[i][r]] = 1.0
-        extra = ()
-        if self.chaos is not None:
-            # sliced from the hoisted whole-schedule expansion; a replay
-            # sees bit-identical fault tensors (absolute-round keying)
-            extra = (self._chaos_masks(start_round, k),)
         t0 = time.time()
+        # fault/membership tensors are sliced from the hoisted
+        # whole-schedule expansions; a replay sees bit-identical tensors
         self.states, out_agg, outs = self._scan(
             self.states, self.data, self._ver_x, self._ver_m,
             jnp.asarray(sel_idx), jnp.asarray(masks), agg_count,
             keys, jnp.arange(start_round, start_round + k, dtype=jnp.int32),
-            jnp.asarray(np.ascontiguousarray(active_rounds)), *extra)
+            jnp.asarray(np.ascontiguousarray(active_rounds)),
+            **self._mask_kwargs(start_round, k))
         return InFlightChunk(start_round=start_round, n_rounds=k,
                              schedule=schedule, keys=keys, outs=outs,
                              agg_count=out_agg,
@@ -261,7 +309,8 @@ class BatchedRunEngine:
         return absorb_fused_out(out_slice, round_index, selected, self.n_real,
                                 self.host[run],
                                 self.cfg.max_rejected_updates,
-                                chaos=self.chaos is not None)
+                                chaos=self.chaos is not None,
+                                elastic=self.elastic is not None)
 
     def evaluate_final(self) -> np.ndarray:
         """[R, n_real] (or [R, n_real, 3] for classification) final metrics —

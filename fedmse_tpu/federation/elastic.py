@@ -1,0 +1,287 @@
+"""Elastic federation: dynamic membership as precomputed schedule inputs.
+
+The reference (and every PR before this one) freezes the client axis at
+schedule-compile time: N gateways exist for the whole run. Real IoT fleets
+churn — devices join, leave, and get preempted mid-round — and a
+fixed-shape TPU program cannot add or remove rows without recompiling the
+fused scan. The resolution is the same one the chaos axis used for
+transient faults (chaos/masks.py, DESIGN.md §9), promoted from "a client
+is briefly unavailable" to "a client ceases to exist and its slot is
+re-tenanted":
+
+  * the federation is a **client-slot pool** of fixed size N. A *leave*
+    retires a slot: zero aggregation weight, no vote, no training, no
+    broadcast, optimizer moments invalidated, evaluation metric NaN. A
+    *join* recycles a retired slot for a NEW tenant — generation counter
+    incremented, params initialized from the current global model (the
+    incumbent-mean — see below), Adam moments zeroed, verifier history
+    cleared — all as masked selects inside the scan, so slot reuse never
+    leaks a previous tenant's state and nothing recompiles;
+  * a *preempt* is a leave+join collapsed into one round: the slot stays
+    occupied but its tenant restarts from the global model with fresh
+    optimizer state (the mid-round eviction a preemptible fleet hits);
+    its generation increments like any recycle;
+  * membership events are declared as an `ElasticSpec` (rates + windows,
+    ChaosSpec-style eager validation) and expanded by
+    `make_membership_masks` into per-round `[T, N]`
+    member/joined/left/generation tensors that ride the scan's xs exactly
+    like the selection schedule and the chaos masks — membership is an
+    INPUT to the program, not control flow around it, which is why a 30%
+    per-round churn rate compiles to ZERO recompiles after warmup
+    (tests/test_elastic.py pins the jit cache size).
+
+"Current global model": this federation is decentralized — there is no
+parameter server holding a canonical global tree. The joiner therefore
+inherits the **incumbent-mean model**: the uniform average of the params
+of every slot that is a member this round and is not itself joining
+(the same masked einsum as the divergence observable's federation mean,
+f32 accumulation per the PR 5 contract). After any aggregated round the
+incumbents all hold the last verified broadcast, so the incumbent-mean IS
+the latest global model; between aggregations it is the natural
+decentralized stand-in. Corner: if a round has no incumbents at all
+(everyone left and rejoined at once), the mean degenerates to zeros —
+the joiner then trains from a zero model until the next broadcast.
+
+Determinism contract (identical to the chaos masks'):
+  * the whole membership timeline is a pure function of (spec,
+    elastic_key) — a Markov chain over rounds expanded from round 0 in
+    one `lax.scan`, so chunked, replayed, pipelined and per-round
+    dispatches all see identical membership (the engines cache one
+    whole-schedule expansion and slice per chunk);
+  * round t's transition draws come from `fold_in(elastic_key, t)` with t
+    the ABSOLUTE round index, then slot i draws from `fold_in(·, i)`
+    alone (utils/seeding.fold_in_keys, PARITY.md §8): a shaped
+    bernoulli's counter layout depends on the draw WIDTH, so drawing over
+    the padded axis would let mesh size silently re-tenant different
+    slots for the same seed+spec — and defeat the checkpoint membership
+    signature, which encodes the spec but not the pad width;
+  * the elastic key is the domain-separated stream from
+    `ExperimentRngs.elastic_key()` (utils/seeding.py ELASTIC_STREAM_TAG):
+    enabling churn perturbs no training/eval/selection/chaos draw;
+  * a null spec (all rates zero, every slot initially occupied) produces
+    the all-member constants, and the elastic program's masked selects
+    are the identity on them — bit-identical to the static federation
+    (tests/test_elastic.py, the PR 3 zero-probability idiom).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedmse_tpu.utils.seeding import fold_in_keys
+
+_RATE_FIELDS = ("leave_p", "join_p", "preempt_p")
+_WINDOW_FIELDS = ("leave_window", "join_window", "preempt_window")
+
+# fold constant for the initial-occupancy draw (initial_member_frac < 1):
+# a branch no per-round fold_in(key, t >= 0) can reach
+_INIT_DRAW_TAG = 0x494E4954  # "INIT"
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticSpec:
+    """Membership-event rates + their active windows.
+
+    `leave_p` / `join_p` / `preempt_p` are per-slot per-round transition
+    probabilities: leave fires on occupied slots, join on retired slots,
+    preempt on occupied slots that did not just leave. The global
+    `[start_round, stop_round)` window bounds all three; each event kind
+    may override it with its own `(start, stop)` window (`stop=None` =
+    to the end of the schedule) — a leave burst followed by a rejoin wave
+    is `leave_window=(4, 6), join_window=(6, None)`.
+
+    `initial_member_frac` < 1 starts the pool partially occupied (drawn
+    once from the elastic key), leaving headroom for joins from round 0.
+    """
+
+    leave_p: float = 0.0
+    join_p: float = 0.0
+    preempt_p: float = 0.0
+    start_round: int = 0
+    stop_round: Optional[int] = None
+    leave_window: Optional[Tuple[int, Optional[int]]] = None
+    join_window: Optional[Tuple[int, Optional[int]]] = None
+    preempt_window: Optional[Tuple[int, Optional[int]]] = None
+    initial_member_frac: float = 1.0
+
+    def __post_init__(self):
+        for name in _RATE_FIELDS:
+            p = getattr(self, name)
+            # a bad probability would silently skew (or never fire) the
+            # bernoulli transition draws under jit — reject eagerly
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {p}")
+        if not 0.0 < self.initial_member_frac <= 1.0:
+            raise ValueError("initial_member_frac must be in (0, 1], got "
+                             f"{self.initial_member_frac} (an empty initial "
+                             "pool would have no model to join from)")
+        if self.start_round < 0:
+            raise ValueError(
+                f"start_round must be >= 0, got {self.start_round}")
+        if self.stop_round is not None and self.stop_round <= self.start_round:
+            raise ValueError(
+                f"stop_round ({self.stop_round}) must be > start_round "
+                f"({self.start_round}); the window [start, stop) is else "
+                f"empty and the spec is a silent no-op")
+        for name in _WINDOW_FIELDS:
+            win = getattr(self, name)
+            if win is None:
+                continue
+            if len(win) != 2:
+                raise ValueError(f"{name} must be (start, stop), got {win!r}")
+            start, stop = win
+            if start < 0:
+                raise ValueError(f"{name} start must be >= 0, got {start}")
+            if stop is not None and stop <= start:
+                raise ValueError(
+                    f"{name} ({win}) is empty: stop must be > start")
+
+    @property
+    def is_null(self) -> bool:
+        """True when the spec changes nothing (every rate zero and the
+        pool starts full; schedules must be bit-identical to the static
+        federation)."""
+        return (all(getattr(self, n) == 0.0 for n in _RATE_FIELDS)
+                and self.initial_member_frac == 1.0)
+
+    def window_for(self, kind: str) -> Tuple[int, Optional[int]]:
+        """(start, stop) of one event kind ('leave'|'join'|'preempt'),
+        falling back to the global window."""
+        win = getattr(self, f"{kind}_window")
+        return (self.start_round, self.stop_round) if win is None else win
+
+    def signature(self) -> str:
+        """Canonical string for checkpoint-compat validation: a snapshot
+        resumed under a DIFFERENT membership timeline would recompute
+        different generation tensors than the ones its states were trained
+        under (CheckpointManager expected_extra — JSON-stable, so tuples
+        vs lists never bite)."""
+        def w(win):
+            return "-" if win is None else f"{win[0]}.{win[1]}"
+        return (f"l{self.leave_p:g}j{self.join_p:g}p{self.preempt_p:g}"
+                f"s{self.start_round}e{self.stop_round}"
+                f"wl{w(self.leave_window)}wj{w(self.join_window)}"
+                f"wp{w(self.preempt_window)}m{self.initial_member_frac:g}")
+
+
+class MembershipMasks(NamedTuple):
+    """Per-round membership tensors. As built by `make_membership_masks`
+    every leaf carries a leading [T] rounds axis (and [T, R, N] from
+    `make_batched_membership_masks`); `lax.scan` slices one round off the
+    front, so the round body sees [N] leaves."""
+
+    member: jax.Array      # f32 1 = slot occupied by an active tenant
+    joined: jax.Array      # f32 1 = tenant's FIRST round (slot recycled at
+                           #   round entry: inherit global, fresh moments)
+    left: jax.Array        # f32 1 = tenant left at this round's entry
+                           #   (slot newly retired; moments invalidated)
+    generation: jax.Array  # i32 tenant generation (0 = founding tenant;
+                           #   increments on every recycle, incl. preempt)
+
+
+def all_member_masks(n_clients: int) -> MembershipMasks:
+    """The static-federation single-round masks (what a null spec draws)."""
+    return MembershipMasks(
+        member=jnp.ones((n_clients,), jnp.float32),
+        joined=jnp.zeros((n_clients,), jnp.float32),
+        left=jnp.zeros((n_clients,), jnp.float32),
+        generation=jnp.zeros((n_clients,), jnp.int32))
+
+
+def _in_window(t: jax.Array, window: Tuple[int, Optional[int]]) -> jax.Array:
+    start, stop = window
+    cond = t >= start
+    if stop is not None:
+        cond = cond & (t < stop)
+    return cond
+
+
+def make_membership_masks(spec: ElasticSpec, elastic_key: jax.Array,
+                          n_rounds: int, n_clients: int) -> MembershipMasks:
+    """Membership tensors for rounds [0, n_rounds), leaves stacked on a
+    leading [T] axis.
+
+    The timeline is a Markov chain (a slot's occupancy at round t depends
+    on its history), so unlike the memoryless chaos masks it always
+    expands from round 0 — chunking invariance comes from the engines
+    expanding the WHOLE schedule once and slicing per chunk, which is the
+    same hoist both engines already apply to chaos masks. The per-round
+    transition draws key on the ABSOLUTE round index, so regrowing the
+    horizon extends the timeline without changing its prefix."""
+    def bern(key, p):
+        # per-slot fold_in, NOT a shaped draw: slot i's draw must depend
+        # only on (key, i) so a padded client axis cannot perturb the
+        # real slots' timeline (see the determinism contract above)
+        return jax.vmap(lambda k: jax.random.bernoulli(k, p))(
+            fold_in_keys(key, n_clients))
+
+    member0 = jnp.ones((n_clients,), bool)
+    if spec.initial_member_frac < 1.0:
+        member0 = bern(jax.random.fold_in(elastic_key, _INIT_DRAW_TAG),
+                       spec.initial_member_frac)
+
+    def step(carry, t):
+        member, gen = carry
+        k_leave, k_join, k_pre = jax.random.split(
+            jax.random.fold_in(elastic_key, t), 3)
+        leave = (bern(k_leave, spec.leave_p)
+                 & _in_window(t, spec.window_for("leave")) & member)
+        join = (bern(k_join, spec.join_p)
+                & _in_window(t, spec.window_for("join")) & ~member)
+        pre = (bern(k_pre, spec.preempt_p)
+               & _in_window(t, spec.window_for("preempt")) & member & ~leave)
+        new_member = (member & ~leave) | join
+        recycled = join | pre  # new tenant this round (preempt = re-tenant)
+        new_gen = gen + recycled.astype(jnp.int32)
+        out = MembershipMasks(
+            member=new_member.astype(jnp.float32),
+            joined=recycled.astype(jnp.float32),
+            left=leave.astype(jnp.float32),
+            generation=new_gen)
+        return (new_member, new_gen), out
+
+    _, masks = jax.lax.scan(
+        step, (member0, jnp.zeros((n_clients,), jnp.int32)),
+        jnp.arange(n_rounds))
+    return masks
+
+
+def make_batched_membership_masks(spec: ElasticSpec, elastic_keys,
+                                  n_rounds: int,
+                                  n_clients: int) -> MembershipMasks:
+    """The runs-axis variant: one independent membership timeline per run
+    (run r evolves from its OWN domain-separated elastic key — exactly
+    what r sequential federations would draw), leaves stacked [T, R, ...]
+    to match the batched scan's xs layout (the chaos-mask batching lever:
+    fold_in/bernoulli/scan are pure per-element, so one vmapped dispatch
+    preserves each run's timeline bit-exactly)."""
+    per_run = jax.vmap(
+        lambda k: make_membership_masks(spec, k, n_rounds, n_clients))(
+            jnp.stack(list(elastic_keys)))
+    return jax.tree.map(lambda leaf: jnp.moveaxis(leaf, 0, 1), per_run)
+
+
+def membership_at(masks: MembershipMasks, round_index: int,
+                  n_real: Optional[int] = None):
+    """Host-side (member, generation) numpy snapshot AFTER `round_index`
+    rounds have run — i.e. the roster a serving front should hold once
+    round `round_index - 1` completed. `round_index=0` returns the full
+    generation-0 pool (checkpoints are only written after at least one
+    round, so the partial-initial-pool draw never reaches this branch).
+    Feeds the checkpoint `extra` generation counters and the serving
+    roster swap."""
+    if round_index <= 0:
+        n = masks.member.shape[1]
+        member = np.ones(n, bool)
+        gen = np.zeros(n, np.int64)
+    else:
+        member = np.asarray(masks.member[round_index - 1]) > 0
+        gen = np.asarray(masks.generation[round_index - 1]).astype(np.int64)
+    if n_real is not None:
+        member, gen = member[:n_real], gen[:n_real]
+    return member, gen

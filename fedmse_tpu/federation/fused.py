@@ -41,7 +41,8 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from fedmse_tpu.federation.state import (ClientStates, tree_client_divergence,
+from fedmse_tpu.federation.state import (ClientStates, client_mean_weights,
+                                         tree_client_divergence,
                                          tree_select_clients)
 
 
@@ -61,6 +62,11 @@ class FusedRoundOut(NamedTuple):
     eff_mask: jax.Array      # [N] f32 effective cohort after churn/stragglers
     crashed: jax.Array       # i32 scalar: crashed-then-replaced aggregator
     divergence: jax.Array    # [N] f32 param distance to the federation mean
+    # elastic-membership observability (federation/elastic.py, DESIGN.md
+    # §15); placeholders (member == client_mask, generation == 0) without
+    # an ElasticSpec
+    member: jax.Array        # [N] f32 1 = slot occupied this round
+    generation: jax.Array    # [N] i32 tenant generation of each slot
 
 
 def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
@@ -116,12 +122,14 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                     compact_cohort: bool = False,
                     poison_fn: Optional[Callable] = None,
                     chaos: bool = False,
+                    elastic: bool = False,
                     divergence_fn: Optional[Callable] = None) -> Callable:
     """Build the traceable round body (jit-wrapped by make_fused_round,
     scanned directly by make_fused_rounds_scan):
 
     fn(states, data, ver_x [N,V,D], ver_m [N,V], sel_indices [S],
-       sel_mask [N], agg_count [N], rng, round_index[, chaos_in])
+       sel_mask [N], agg_count [N], rng, round_index[, chaos_in]
+       [, elastic_in])
       -> (states, agg_count, FusedRoundOut)
 
     `data` (FederatedData) and the verification tensors are ARGUMENTS, not
@@ -152,6 +160,26 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
     where on an all-true predicate), so a zero-probability ChaosSpec is
     bit-identical to the chaos-free program (tests/test_chaos.py).
 
+    `elastic=True` adds a trailing `elastic_in` argument (a single-round
+    MembershipMasks slice, federation/elastic.py) and compiles the
+    client-slot-pool semantics into the program (DESIGN.md §15):
+      * at round ENTRY, slots whose tenant just joined (or was preempted
+        and restarts) inherit the incumbent-mean model — params and
+        prev_global set to the uniform average of the non-joining
+        members' params (f32-accumulated einsum) — with Adam moments
+        zeroed and verifier history/rejected counters cleared, so slot
+        reuse never leaks a previous tenant's state; slots whose tenant
+        just left have their moments invalidated (zeroed) too;
+      * the effective cohort is selected ∧ member (∧ the chaos terms when
+        both axes run): retired slots never train, vote, carry
+        aggregation weight, or receive the broadcast, and their
+        evaluation metric reads NaN ("nobody there"), not a stale score;
+      * an empty effective cohort degrades to the existing no_aggregate
+        path.
+    All-member masks make every elastic op the identity, so a null
+    ElasticSpec is bit-identical to the static program
+    (tests/test_elastic.py, the same contract as the chaos masks').
+
     `divergence_fn(params, client_mask) -> [N]`, when given, replaces the
     default dense `tree_client_divergence` for the chaos-only divergence
     observable — the engine passes the explicit shard_map + psum reduction
@@ -160,24 +188,67 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
     """
 
     def round_body(states: ClientStates, data, ver_x, ver_m, sel_indices,
-                   sel_mask, agg_count, rng, round_index, chaos_in=None):
+                   sel_mask, agg_count, rng, round_index, chaos_in=None,
+                   elastic_in=None):
         n_pad = data.num_clients_padded
         client_ids = jnp.arange(n_pad)
+        member_b = None
+        if elastic:
+            # ---- slot-pool entry transitions (federation/elastic.py) ----
+            member = elastic_in.member * data.client_mask  # pad never joins
+            member_b = member > 0
+            joined_b = elastic_in.joined > 0
+            left_b = elastic_in.left > 0
+            # the joiner's "current global model": the incumbent-mean —
+            # uniform average of the params of every slot that is a member
+            # this round and is not itself joining (f32 accumulation per
+            # the PR 5 contract; empty-incumbent clamp degenerates to a
+            # zero model — see the module docstring corner)
+            incumbents = member * (1.0 - elastic_in.joined)
+            w = client_mean_weights(incumbents, jnp.sum(incumbents))
+            mean_params = jax.tree.map(
+                lambda leaf: jnp.einsum(
+                    "n,n...->...", w, leaf,
+                    preferred_element_type=jnp.float32
+                ).astype(leaf.dtype)[None], states.params)
+            # leave invalidates moments; join starts fresh — either way a
+            # recycled slot's optimizer never sees the previous tenant's
+            reset_opt = joined_b | left_b
+            zeros_opt = jax.tree.map(jnp.zeros_like, states.opt_state)
+            states = ClientStates(
+                params=tree_select_clients(joined_b, mean_params,
+                                           states.params),
+                opt_state=tree_select_clients(~reset_opt, states.opt_state,
+                                              zeros_opt),
+                prev_global=tree_select_clients(joined_b, mean_params,
+                                                states.prev_global),
+                hist_params=tree_select_clients(
+                    ~joined_b, states.hist_params,
+                    jax.tree.map(jnp.zeros_like, states.hist_params)),
+                hist_perf=jnp.where(joined_b, jnp.float32(0),
+                                    states.hist_perf),
+                hist_seen=jnp.where(joined_b, False, states.hist_seen),
+                rejected=jnp.where(joined_b, jnp.int32(0), states.rejected))
         if chaos:
             eff_mask = sel_mask * chaos_in.available * \
                 (1.0 - chaos_in.straggler)
         else:
             eff_mask = sel_mask
+        if elastic:
+            # retired slots leave the effective cohort whatever the host
+            # selection drew (the host samples blind to membership)
+            eff_mask = eff_mask * member
         # ---- local training of the selected cohort (src/main.py:276-279) ----
         params, opt_state, best_params, min_valid, tracking = train_all(
             states.params, states.opt_state, states.prev_global, sel_mask,
             data.train_xb, data.train_mb, data.valid_xb, data.valid_mb,
             sel_idx=sel_indices if compact_cohort else None)
-        if chaos:
-            # selected clients that dropped out (never trained) or straggled
-            # past the round deadline (trained too late to count) contribute
-            # nothing: their state passes through and their curves blank to
-            # NaN like an unselected client's
+        if chaos or elastic:
+            # selected clients that dropped out (never trained), straggled
+            # past the round deadline (trained too late to count), or whose
+            # slot is retired (nobody there to train) contribute nothing:
+            # their state passes through and their curves blank to NaN like
+            # an unselected client's
             lost = (sel_mask > 0) & (eff_mask <= 0)
             params = tree_select_clients(~lost, params, states.params)
             opt_state = tree_select_clients(~lost, opt_state,
@@ -190,10 +261,10 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
             hist_seen=states.hist_seen, rejected=states.rejected)
 
         # ---- election (src/main.py:282-288): voting data is the FIRST
-        # selected client's valid split (src/main.py:285) — under chaos the
-        # first EFFECTIVE one (argmax of an all-true cohort is index 0, so
-        # the chaos-free gather is unchanged) ----
-        if chaos:
+        # selected client's valid split (src/main.py:285) — under chaos or
+        # churn the first EFFECTIVE one (argmax of an all-true cohort is
+        # index 0, so the fault-free gather is unchanged) ----
+        if chaos or elastic:
             vote_owner = sel_indices[jnp.argmax(eff_mask[sel_indices] > 0)]
         else:
             vote_owner = sel_indices[0]
@@ -240,18 +311,23 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
             outcome = verify(states, agg_params, ver_x, ver_m, onehot,
                              data.client_mask)
             new_states = outcome.states
-            if chaos:
+            if chaos or elastic:
                 # broadcast loss: a client that never RECEIVED the broadcast
                 # keeps its entire pre-merge state — params, prev_global,
                 # verifier history, rejected counter. Down clients (dropout,
                 # crashed ex-aggregator) miss it by definition — offline is
                 # offline whether or not they were selected; stragglers are
-                # merely SLOW, still online, and do receive. The elected
-                # aggregator holds the aggregate locally (nothing to lose).
-                received = ((chaos_in.bcast_drop <= 0)
-                            & (chaos_in.available > 0)
-                            & (client_ids != crashed)) \
-                    | (client_ids == aggregator)
+                # merely SLOW, still online, and do receive; a RETIRED slot
+                # has nobody listening at all. The elected aggregator holds
+                # the aggregate locally (nothing to lose).
+                received = jnp.ones((n_pad,), bool)
+                if chaos:
+                    received = ((chaos_in.bcast_drop <= 0)
+                                & (chaos_in.available > 0)
+                                & (client_ids != crashed))
+                if elastic:
+                    received = received & member_b
+                received = received | (client_ids == aggregator)
                 new_states = tree_select_clients(received, new_states,
                                                  states)
             return new_states, weights
@@ -267,6 +343,12 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
         # ---- evaluation of every client (src/main.py:333-339) ----
         metrics = evaluate_all(states.params, data.test_x, data.test_m,
                                data.test_y, data.train_xb, data.train_mb)
+        if elastic:
+            # a retired slot's metric is "nobody there", not the stale
+            # tenant's score — NaN rides every downstream nan-reduction
+            # (host logging, early stop, recovery curves) transparently
+            cond = member_b if metrics.ndim == 1 else member_b[:, None]
+            metrics = jnp.where(cond, metrics, jnp.nan)
 
         # resilience observable: post-merge per-client parameter divergence
         # (chaos runs only — the clean program does not pay for it)
@@ -278,30 +360,37 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                             scores=scores, weights=weights,
                             rejected=states.rejected, min_valid=min_valid,
                             tracking=tracking, eff_mask=eff_mask,
-                            crashed=crashed, divergence=divergence)
+                            crashed=crashed, divergence=divergence,
+                            member=(member if elastic else data.client_mask),
+                            generation=(elastic_in.generation if elastic
+                                        else jnp.zeros(n_pad, jnp.int32)))
         return states, agg_count, out
 
     return round_body
 
 
-def make_fused_round(*args, chaos: bool = False,
+def make_fused_round(*args, chaos: bool = False, elastic: bool = False,
                      divergence_fn: Optional[Callable] = None) -> Callable:
     """The single-dispatch round: jitted round body with the incoming states
     buffers donated (they are consumed and replaced every round). With
-    `chaos=True` the call takes a trailing single-round ChaosMasks slice."""
-    return jax.jit(make_round_body(*args, chaos=chaos,
+    `chaos=True` the call takes a trailing single-round ChaosMasks slice;
+    with `elastic=True` a single-round MembershipMasks slice (pass both as
+    KEYWORDS — `chaos_in=` / `elastic_in=` — so either axis composes alone
+    without positional ambiguity)."""
+    return jax.jit(make_round_body(*args, chaos=chaos, elastic=elastic,
                                    divergence_fn=divergence_fn),
                    donate_argnums=(0,))
 
 
-def make_fused_rounds_scan(*args, chaos: bool = False,
+def make_fused_rounds_scan(*args, chaos: bool = False, elastic: bool = False,
                            divergence_fn: Optional[Callable] = None
                            ) -> Callable:
     """Build the whole-schedule runner: `lax.scan` of the raw round body over
     a precomputed selection schedule.
 
     fn(states, data, ver_x, ver_m, sel_schedule [R, S], sel_masks [R, N],
-       agg_count [N], keys [R], round_indices [R][, chaos_masks])
+       agg_count [N], keys [R], round_indices [R][, chaos_masks=]
+       [, elastic_masks=])
       -> (states, agg_count, FusedRoundOut stacked on a leading [R] axis)
 
     `keys` is one PRNG key per round, drawn from the SAME host stream the
@@ -314,30 +403,36 @@ def make_fused_rounds_scan(*args, chaos: bool = False,
     With `chaos=True` the precomputed fault tensors (`chaos_masks`, a
     ChaosMasks with [R, N] / [R] leaves — chaos/masks.py) ride the scan's
     xs exactly like the selection schedule: failure is an INPUT to the
-    program, not control flow around it (DESIGN.md §9).
+    program, not control flow around it (DESIGN.md §9). `elastic=True`
+    threads the membership tensors (`elastic_masks`, a MembershipMasks
+    with [R, N] leaves — federation/elastic.py) the same way: the
+    client-slot pool's joins/leaves are data, so a churning fleet runs
+    with ZERO recompiles after warmup.
     """
-    round_body = make_round_body(*args, chaos=chaos,
+    round_body = make_round_body(*args, chaos=chaos, elastic=elastic,
                                  divergence_fn=divergence_fn)
 
     @partial(jax.jit, donate_argnums=(0,))
     def run_all(states: ClientStates, data, ver_x, ver_m, sel_schedule,
-                sel_masks, agg_count, keys, round_indices, chaos_masks=None):
+                sel_masks, agg_count, keys, round_indices, chaos_masks=None,
+                elastic_masks=None):
         def step(carry, xs):
             states, agg_count = carry
-            if chaos:
-                sel_indices, sel_mask, key, round_index, ch = xs
-            else:
-                sel_indices, sel_mask, key, round_index = xs
-                ch = None
+            sel_indices, sel_mask, key, round_index = xs[:4]
+            rest = list(xs[4:])
+            ch = rest.pop(0) if chaos else None
+            el = rest.pop(0) if elastic else None
             states, agg_count, out = round_body(states, data, ver_x, ver_m,
                                                 sel_indices, sel_mask,
                                                 agg_count, key, round_index,
-                                                ch)
+                                                ch, el)
             return (states, agg_count), out
 
         xs = (sel_schedule, sel_masks, keys, round_indices)
         if chaos:
             xs = xs + (chaos_masks,)
+        if elastic:
+            xs = xs + (elastic_masks,)
         (states, agg_count), outs = jax.lax.scan(step, (states, agg_count),
                                                  xs)
         return states, agg_count, outs
@@ -345,13 +440,14 @@ def make_fused_rounds_scan(*args, chaos: bool = False,
     return run_all
 
 
-def make_batched_runs_scan(*args, chaos: bool = False) -> Callable:
+def make_batched_runs_scan(*args, chaos: bool = False,
+                           elastic: bool = False) -> Callable:
     """Build the batched-runs whole-schedule runner: the round body vmapped
     over a leading `runs` axis, scanned over a per-run selection schedule.
 
     fn(states [R, N, ...], data, ver_x, ver_m, sel_schedule [K, R, S],
        sel_masks [K, R, N], agg_count [R, N], keys [K, R],
-       round_indices [K], active [K, R][, chaos_masks])
+       round_indices [K], active [K, R][, chaos_masks=][, elastic_masks=])
       -> (states, agg_count, FusedRoundOut stacked on leading [K, R] axes)
 
     With `chaos=True`, `chaos_masks` carries [K, R, N] / [K, R] fault
@@ -359,6 +455,8 @@ def make_batched_runs_scan(*args, chaos: bool = False) -> Callable:
     domain-separated chaos key — chaos/masks.py make_batched_chaos_masks);
     the scan slices the round axis and the run vmap slices the runs axis,
     so each lane sees exactly the masks its sequential federation would.
+    `elastic=True` threads [K, R, N] per-run membership tensors
+    (federation/elastic.py make_batched_membership_masks) identically.
 
     R independent federations — each with its own PRNG stream, client
     states, selection masks, elections and quota counters — execute as ONE
@@ -381,31 +479,31 @@ def make_batched_runs_scan(*args, chaos: bool = False) -> Callable:
     identical to the first pass and the host keeps its first-pass
     bookkeeping.
     """
-    round_body = make_round_body(*args, chaos=chaos)
+    round_body = make_round_body(*args, chaos=chaos, elastic=elastic)
 
     @partial(jax.jit, donate_argnums=(0,))
     def run_all(states: ClientStates, data, ver_x, ver_m, sel_schedule,
                 sel_masks, agg_count, keys, round_indices, active,
-                chaos_masks=None):
+                chaos_masks=None, elastic_masks=None):
         def one_run(run_states, sel_indices, sel_mask, count, key,
-                    round_index, ch=None):
+                    round_index, ch, el):
             return round_body(run_states, data, ver_x, ver_m, sel_indices,
-                              sel_mask, count, key, round_index, ch)
+                              sel_mask, count, key, round_index, ch, el)
+
+        # per-run fault/membership tensors map their runs axis; a disabled
+        # axis passes None through an unmapped argument
+        in_axes = (0, 0, 0, 0, 0, None,
+                   0 if chaos else None, 0 if elastic else None)
 
         def step(carry, xs):
             states, agg_count = carry
-            if chaos:
-                sel_indices, sel_mask, key, round_index, act, ch = xs
-                new_states, new_count, out = jax.vmap(
-                    one_run, in_axes=(0, 0, 0, 0, 0, None, 0))(
-                        states, sel_indices, sel_mask, agg_count, key,
-                        round_index, ch)
-            else:
-                sel_indices, sel_mask, key, round_index, act = xs
-                new_states, new_count, out = jax.vmap(
-                    one_run, in_axes=(0, 0, 0, 0, 0, None))(
-                        states, sel_indices, sel_mask, agg_count, key,
-                        round_index)
+            sel_indices, sel_mask, key, round_index, act = xs[:5]
+            rest = list(xs[5:])
+            ch = rest.pop(0) if chaos else None
+            el = rest.pop(0) if elastic else None
+            new_states, new_count, out = jax.vmap(one_run, in_axes=in_axes)(
+                states, sel_indices, sel_mask, agg_count, key, round_index,
+                ch, el)
             # early stop as a mask: stopped runs' federations are frozen
             states = tree_select_clients(act, new_states, states)
             agg_count = jnp.where(act[:, None], new_count, agg_count)
@@ -414,6 +512,8 @@ def make_batched_runs_scan(*args, chaos: bool = False) -> Callable:
         xs = (sel_schedule, sel_masks, keys, round_indices, active)
         if chaos:
             xs = xs + (chaos_masks,)
+        if elastic:
+            xs = xs + (elastic_masks,)
         (states, agg_count), outs = jax.lax.scan(step, (states, agg_count),
                                                  xs)
         return states, agg_count, outs

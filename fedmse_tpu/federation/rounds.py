@@ -37,6 +37,7 @@ import optax
 # hoisted to module scope so per-chunk dispatch prep pays no import lookup
 from fedmse_tpu.chaos.masks import make_chaos_masks
 from fedmse_tpu.config import ExperimentConfig
+from fedmse_tpu.federation.elastic import make_membership_masks
 from fedmse_tpu.data.stacking import FederatedData
 from fedmse_tpu.evaluation.evaluator import make_evaluate_all
 from fedmse_tpu.federation.aggregation import make_aggregate_fn
@@ -73,6 +74,12 @@ class RoundResult:
     effective: Optional[List[int]] = None
     crashed_aggregator: Optional[int] = None
     divergence: Optional[np.ndarray] = None
+    # elastic-membership observability (federation/elastic.py; populated
+    # only under an ElasticSpec): the real slots occupied this round, and
+    # each slot's tenant generation (0 = founding tenant; a recycled slot
+    # increments — the slot-pool roster the serving front mirrors)
+    members: Optional[List[int]] = None
+    generations: Optional[np.ndarray] = None
 
 
 def split_metric_columns(metrics: np.ndarray):
@@ -170,7 +177,8 @@ def verification_tensors(cfg: ExperimentConfig, data: FederatedData,
 
 def absorb_fused_out(out, round_index: int, selected: List[int], n_real: int,
                      host: HostState, max_rejected_updates: int,
-                     chaos: bool = False) -> RoundResult:
+                     chaos: bool = False, elastic: bool = False
+                     ) -> RoundResult:
     """Host bookkeeping + RoundResult from ONE host-fetched FusedRoundOut
     bundle: quota/vote counters, reference verification rows, attack
     flagging. Shared by the per-run fused path (RoundEngine._fused_result)
@@ -181,7 +189,10 @@ def absorb_fused_out(out, round_index: int, selected: List[int], n_real: int,
     then is `divergence` a measured quantity (the clean program emits a
     zeros placeholder, which must surface as None — "not measured", not
     "measured and zero" — so resilience metrics can't mistake an
-    unmeasured baseline for a perfectly converged one)."""
+    unmeasured baseline for a perfectly converged one). `elastic` does the
+    same for the membership observables: `members`/`generations` surface
+    only from an elastic program (the static program's placeholders are
+    not a measured roster)."""
     aggregator = int(out.aggregator)
     rejected = np.asarray(out.rejected)
     verification_rows: List[Dict] = []
@@ -222,6 +233,11 @@ def absorb_fused_out(out, round_index: int, selected: List[int], n_real: int,
         effective=[i for i in selected if eff[i] > 0],
         crashed_aggregator=None if crashed < 0 else crashed,
         divergence=np.asarray(out.divergence)[:n_real] if chaos else None,
+        members=(np.flatnonzero(
+            np.asarray(out.member)[:n_real] > 0).tolist()
+            if elastic else None),
+        generations=(np.asarray(out.generation)[:n_real].astype(np.int64)
+                     if elastic else None),
     )
 
 
@@ -244,7 +260,7 @@ class RoundEngine:
                  n_real: int, rngs: ExperimentRngs, model_type: str,
                  update_type: str, profile: bool = False,
                  fused: bool = False, poison_fn=None, chaos=None,
-                 mesh=None):
+                 elastic=None, mesh=None):
         self.model = model
         self.cfg = cfg
         self.data = data
@@ -303,6 +319,22 @@ class RoundEngine:
         # sliced per chunk — keeps mask generation off the dispatch path
         self._chaos_premade = None
         self._chaos_horizon = 0
+        # elastic membership (federation/elastic.py): an ElasticSpec
+        # compiled into the fused program as per-round [T, N] membership
+        # tensors — same fused-only discipline as chaos
+        self.elastic = elastic
+        if elastic is not None and (not fused or profile):
+            raise ValueError(
+                "elastic membership is compiled into the fused round "
+                "program; construct the engine with fused=True (and "
+                "profile=False)")
+        self._elastic_key = rngs.elastic_key() if elastic is not None else None
+        # whole-schedule membership cache (see _elastic_masks): the
+        # timeline is a Markov chain, so it MUST expand from round 0 —
+        # the hoisted whole-schedule expansion is correctness here, not
+        # just a dispatch-path optimization
+        self._elastic_premade = None
+        self._elastic_horizon = 0
         self._fused_round = None
         self._fused_scan = None
         self._fused_compact = None  # compact value baked into the programs
@@ -328,17 +360,21 @@ class RoundEngine:
                 self.evaluate_all, self.cfg.max_aggregation_threshold,
                 self._fused_compact, self.poison_fn)
         with_chaos = self.chaos is not None  # program depends on the BOOL
+        with_elastic = self.elastic is not None  # ... and on this one
         # same sharing rationale as _engine_programs; the builders are keyed
         # by the already-cached phase callables, so identity works — except
         # with an attack poison_fn (arbitrary callable, not cache-keyable)
-        key = ("fused",) + args[:-1] + (with_chaos, divergence_fn)
+        key = ("fused",) + args[:-1] + (with_chaos, with_elastic,
+                                        divergence_fn)
         if self.poison_fn is None and key in _PROGRAM_CACHE:
             self._fused_round, self._fused_scan = _PROGRAM_CACHE[key]
             return
         self._fused_round = make_fused_round(*args, chaos=with_chaos,
+                                             elastic=with_elastic,
                                              divergence_fn=divergence_fn)
         self._fused_scan = make_fused_rounds_scan(
-            *args, chaos=with_chaos, divergence_fn=divergence_fn)
+            *args, chaos=with_chaos, elastic=with_elastic,
+            divergence_fn=divergence_fn)
         if self.poison_fn is None:
             _cache_put(key, (self._fused_round, self._fused_scan))
 
@@ -458,7 +494,8 @@ class RoundEngine:
         out = host_fetch(out)  # multi-process-safe (parallel/mesh.py)
         return absorb_fused_out(out, round_index, selected, self.n_real,
                                 self.host, self.cfg.max_rejected_updates,
-                                chaos=self.chaos is not None)
+                                chaos=self.chaos is not None,
+                                elastic=self.elastic is not None)
 
     def _selection_arrays(self, selected: List[int]):
         sel_mask = np.zeros(self.n_pad, dtype=np.float32)
@@ -488,6 +525,10 @@ class RoundEngine:
             # the key — and the premade mask tensors — can change here
             self._chaos_premade = None
             self._chaos_horizon = 0
+        if self.elastic is not None:
+            self._elastic_key = self.rngs.elastic_key()
+            self._elastic_premade = None
+            self._elastic_horizon = 0
 
     def _chaos_masks(self, start_round: int, n_rounds: int):
         """[n_rounds]-stacked fault tensors for the chunk — a pure function
@@ -509,6 +550,62 @@ class RoundEngine:
         return jax.tree.map(lambda t: t[start_round:end],
                             self._chaos_premade)
 
+    def _elastic_masks(self, start_round: int, n_rounds: int):
+        """[n_rounds]-stacked membership tensors for the chunk. The
+        membership timeline is a Markov chain, so it is ALWAYS expanded
+        from round 0 (one whole-schedule dispatch, federation/elastic.py)
+        and sliced per chunk — which simultaneously makes chunked,
+        replayed, pipelined and per-round dispatches see identical
+        membership (the absolute-round keying extends the timeline without
+        changing its prefix when the horizon regrows)."""
+        end = start_round + n_rounds
+        if self._elastic_premade is None or end > self._elastic_horizon:
+            self._elastic_horizon = max(end, self.cfg.num_rounds)
+            self._elastic_premade = make_membership_masks(
+                self.elastic, self._elastic_key, self._elastic_horizon,
+                self.n_pad)
+        return jax.tree.map(lambda t: t[start_round:end],
+                            self._elastic_premade)
+
+    def generation_at(self, round_index: int) -> Optional[np.ndarray]:
+        """Host [n_real] generation counters AFTER `round_index` rounds —
+        the roster snapshot the checkpoint `extra` persists and the
+        serving front's roster swap consumes. None without an ElasticSpec."""
+        if self.elastic is None:
+            return None
+        if round_index <= 0:
+            return np.zeros(self.n_real, np.int64)
+        from fedmse_tpu.federation.elastic import membership_at
+        self._elastic_masks(round_index - 1, 1)  # ensure the horizon covers
+        _, gen = membership_at(self._elastic_premade, round_index,
+                               self.n_real)
+        return gen
+
+    def members_at(self, round_index: int) -> Optional[np.ndarray]:
+        """Host [n_real] bool occupancy AFTER `round_index` rounds — the
+        mask the final evaluation applies so a retired slot reports NaN
+        (its frozen params belong to a departed tenant, not a gateway).
+        None without an ElasticSpec."""
+        if self.elastic is None:
+            return None
+        if round_index <= 0:
+            return np.ones(self.n_real, bool)
+        from fedmse_tpu.federation.elastic import membership_at
+        self._elastic_masks(round_index - 1, 1)
+        member, _ = membership_at(self._elastic_premade, round_index,
+                                  self.n_real)
+        return member
+
+    def _mask_kwargs(self, start_round: int, n_rounds: int) -> dict:
+        """The fault/membership xs for one dispatch, as KEYWORDS — either
+        axis composes alone without positional ambiguity."""
+        kw = {}
+        if self.chaos is not None:
+            kw["chaos_masks"] = self._chaos_masks(start_round, n_rounds)
+        if self.elastic is not None:
+            kw["elastic_masks"] = self._elastic_masks(start_round, n_rounds)
+        return kw
+
     def run_round_fused(self, round_index: int,
                         selected: Optional[List[int]] = None,
                         key: Optional[jax.Array] = None) -> RoundResult:
@@ -525,15 +622,18 @@ class RoundEngine:
         if key is None:
             key = self.rngs.next_jax()
         sel_indices, sel_mask = self._selection_arrays(selected)
-        extra = ()
+        kw = {}
         if self.chaos is not None:
-            extra = (jax.tree.map(lambda t: t[0],
-                                  self._chaos_masks(round_index, 1)),)
+            kw["chaos_in"] = jax.tree.map(lambda t: t[0],
+                                          self._chaos_masks(round_index, 1))
+        if self.elastic is not None:
+            kw["elastic_in"] = jax.tree.map(
+                lambda t: t[0], self._elastic_masks(round_index, 1))
         self.states, _, out = self._fused_round(
             self.states, self.data, self._ver_x, self._ver_m,
             jnp.asarray(sel_indices), jnp.asarray(sel_mask),
             self._agg_count_padded(), key,
-            jnp.asarray(round_index, jnp.int32), *extra)
+            jnp.asarray(round_index, jnp.int32), **kw)
         return self._fused_result(round_index, selected, out)
 
     def dispatch_schedule_chunk(self, start_round: int, n_rounds: int,
@@ -567,9 +667,6 @@ class RoundEngine:
         arrays = [self._selection_arrays(sel) for sel in schedule]
         sel_idx = jnp.asarray(np.stack([a[0] for a in arrays]))
         masks = jnp.asarray(np.stack([a[1] for a in arrays]))
-        extra = ()
-        if self.chaos is not None:
-            extra = (self._chaos_masks(start_round, n_rounds),)
         if agg_count is None:
             agg_count = self._agg_count_padded()
         t0 = time.time()
@@ -577,7 +674,7 @@ class RoundEngine:
             self.states, self.data, self._ver_x, self._ver_m, sel_idx, masks,
             agg_count, keys,
             jnp.arange(start_round, start_round + n_rounds, dtype=jnp.int32),
-            *extra)
+            **self._mask_kwargs(start_round, n_rounds))
         return InFlightChunk(start_round=start_round, n_rounds=n_rounds,
                              schedule=schedule, keys=keys, outs=outs,
                              agg_count=out_agg,

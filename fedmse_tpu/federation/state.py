@@ -86,8 +86,14 @@ def client_states_sharding(states_shape, mesh, axis_name: str = "clients"):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     def spec(leaf):
-        ndim = len(leaf.shape)
-        return NamedSharding(mesh, P(axis_name, *([None] * (ndim - 1))))
+        # no trailing Nones: P('clients') already means "shard axis 0,
+        # replicate the rest", and it is the spec jit RECONSTRUCTS for its
+        # outputs — trailing-None specs hash differently (jax 0.4.37), so
+        # they made chunk 2 of every meshed schedule retrace against the
+        # chunk-1 output states (one spurious extra executable, caught by
+        # the churn sweep's zero-recompile pin)
+        del leaf
+        return NamedSharding(mesh, P(axis_name))
 
     return jax.tree.map(spec, states_shape)
 

@@ -126,15 +126,18 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
                     mesh=None,
                     resume: Optional[CheckpointManager] = None,
                     save_checkpoints: bool = False,
-                    attack=None, chaos=None) -> Dict:
+                    attack=None, chaos=None, elastic=None) -> Dict:
     """One (model_type, update_type, run): the reference round loop
     (src/main.py:267-365) + final evaluation (src/main.py:368-374).
     `attack` (an AttackSpec) simulates a malicious aggregator tampering
     with the broadcast (federation/attack.py) — the adversary the
     verification subsystem defends against. `chaos` (a ChaosSpec,
     fedmse_tpu/chaos/) injects client churn / stragglers / aggregator
-    crashes / broadcast loss into the fused schedule; the two compose —
-    Byzantine peers PLUS churn is the paper's actual threat model."""
+    crashes / broadcast loss into the fused schedule. `elastic` (an
+    ElasticSpec, federation/elastic.py) makes membership itself dynamic —
+    joins recycle retired client slots, leaves retire them. All three
+    compose — Byzantine peers PLUS transient faults PLUS a fleet that is
+    never the same twice is the deployment's actual threat model."""
     rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed,
                           run_seed_stride=cfg.run_seed_stride)
     model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
@@ -157,7 +160,7 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     engine = RoundEngine(model, cfg, data, n_real=n_real, rngs=rngs,
                          model_type=model_type, update_type=update_type,
                          fused=cfg.fused_rounds, poison_fn=poison_fn,
-                         chaos=chaos, mesh=mesh)
+                         chaos=chaos, elastic=elastic, mesh=mesh)
     if mesh is not None:
         # states were born sharded (state.init_client_states out_shardings);
         # shard_federation re-places them with the same canonical layout
@@ -173,11 +176,32 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
 
     tag = f"{model_type}_{update_type}_run{run}"
     start_round = 0
+    # membership-compat guard: a snapshot written under one membership
+    # timeline must not resume under another (the generation tensors are
+    # recomputed from the spec + key on resume, so a silent mismatch would
+    # re-tenant different slots than the states were trained under);
+    # pre-PR-10 snapshots carry no "elastic" key and compare against the
+    # None default — resuming them under churn fails with a clear message
+    # instead of deep-Orbax confusion (checkpointing/io.py extra_defaults)
+    elastic_sig = None if elastic is None else elastic.signature()
+    resume_expected = {"flatten_optimizer": cfg.flatten_optimizer,
+                       "elastic": elastic_sig}
+    resume_defaults = {"flatten_optimizer": False, "elastic": None}
+
+    def resume_extra(next_round: int) -> Dict:
+        gen = engine.generation_at(next_round)
+        return {"flatten_optimizer": cfg.flatten_optimizer,
+                "elastic": elastic_sig,
+                # the slot-pool roster at the snapshot round — what a
+                # serving front (or a post-mortem) reads as the fleet's
+                # state without re-expanding the membership timeline
+                "elastic_generation": None if gen is None else gen.tolist()}
+
     if resume is not None and resume.exists(tag):
         engine.states, engine.host, start_round, prev_tracking = \
-            resume.restore(tag, engine.states, expected_extra={
-                "flatten_optimizer": cfg.flatten_optimizer},
-                extra_defaults={"flatten_optimizer": False})
+            resume.restore(tag, engine.states,
+                           expected_extra=resume_expected,
+                           extra_defaults=resume_defaults)
         if prev_tracking is not None:  # keep the pre-kill part of the curve
             all_tracking.append(prev_tracking)
         logger.info("resumed %s at round %d", tag, start_round)
@@ -262,8 +286,7 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
             if resume is not None:
                 resume.save(tag, engine.states, engine.host,
                             round_index + done,
-                            extra={"flatten_optimizer":
-                                   cfg.flatten_optimizer},
+                            extra=resume_extra(round_index + done),
                             tracking=np.concatenate(all_tracking, axis=1)
                             if all_tracking else None)
             round_index += k
@@ -275,8 +298,7 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
             fired = bookkeep(result, sec)
             if resume is not None:
                 resume.save(tag, engine.states, engine.host, round_index + 1,
-                            extra={"flatten_optimizer":
-                                   cfg.flatten_optimizer},
+                            extra=resume_extra(round_index + 1),
                             tracking=np.concatenate(all_tracking, axis=1)
                             if all_tracking else None)
             if fired:
@@ -290,6 +312,19 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
             engine.states.params, engine.data.test_x, engine.data.test_m,
             engine.data.test_y, engine.data.train_xb,
             engine.data.train_mb)))[:n_real])
+    if elastic is not None:
+        # a retired slot's frozen params belong to a departed tenant —
+        # scoring them would report a gateway that no longer exists (and
+        # let a stale leaver win best_final / pollute the incumbent cohort
+        # in the churn artifacts), so the final roster masks them to NaN
+        # exactly like the per-round metric stream does
+        member = engine.members_at(
+            last_result.round_index + 1 if last_result is not None
+            else start_round)
+        final_metrics = np.where(member, final_metrics, np.nan)
+        if final_metrics_full is not None:
+            final_metrics_full = np.where(member[:, None],
+                                          final_metrics_full, np.nan)
 
     if writer is not None and save_checkpoints and device_names:
         save_client_models(writer, run, model_type, update_type, device_names,
@@ -322,7 +357,8 @@ def run_batched_combination(cfg: ExperimentConfig, data, n_real: int,
                             writer: Optional[ResultsWriter] = None,
                             device_names: Optional[List[str]] = None,
                             save_checkpoints: bool = False,
-                            attack=None, chaos=None) -> List[Dict]:
+                            attack=None, chaos=None,
+                            elastic=None) -> List[Dict]:
     """All `cfg.num_runs` seeds of one (model_type, update_type) as ONE
     runs-axis-batched program (federation/batched.py): R federations advance
     chunk-by-chunk in single XLA dispatches, and the per-run results are
@@ -355,7 +391,8 @@ def run_batched_combination(cfg: ExperimentConfig, data, n_real: int,
         poison_fn = make_poison_fn(attack)
     engine = BatchedRunEngine(model, cfg, data, n_real=n_real, runs=runs,
                               model_type=model_type, update_type=update_type,
-                              poison_fn=poison_fn, chaos=chaos)
+                              poison_fn=poison_fn, chaos=chaos,
+                              elastic=elastic)
     early = [GlobalEarlyStop(inverted=cfg.compat.inverted_global_early_stop,
                              patience=cfg.global_patience)
              for _ in range(runs)]
@@ -444,6 +481,14 @@ def run_batched_combination(cfg: ExperimentConfig, data, n_real: int,
     results: List[Dict] = []
     for r in range(runs):
         final_metrics, final_metrics_full = split_metric_columns(finals[r])
+        if engine.elastic is not None:
+            # same retired-slot NaN rule as the serial driver (see
+            # run_combination): run r's roster after its last executed round
+            member = engine.members_at(len(round_times[r]), r)
+            final_metrics = np.where(member, final_metrics, np.nan)
+            if final_metrics_full is not None:
+                final_metrics_full = np.where(member[:, None],
+                                              final_metrics_full, np.nan)
         if writer is not None and save_checkpoints and device_names:
             params_r = engine.run_params(r)
             save_client_models(writer, r, model_type, update_type,
@@ -473,7 +518,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                    use_mesh: bool = False,
                    save_checkpoints: bool = True,
                    resume_dir: Optional[str] = None,
-                   attack=None, chaos=None, batch_runs: bool = False,
+                   attack=None, chaos=None, elastic=None,
+                   batch_runs: bool = False,
                    serve: bool = False, serve_rows: int = 2048,
                    serve_warmup: bool = False,
                    serve_continuous: bool = False) -> Dict:
@@ -499,6 +545,18 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                            cfg.experiment_name, cfg.scen_name, cfg.metric,
                            cfg.num_participants)
     resume = CheckpointManager(resume_dir) if resume_dir else None
+    if resume is not None and cfg.fused_pipeline and cfg.fused_rounds \
+            and cfg.fused_schedule:
+        # the fallback is silent otherwise: the pipelined loop needs a
+        # synchronous consistent state at every chunk boundary for its
+        # per-chunk checkpoint, so --resume-dir forces the serial chunk
+        # loop — name BOTH flags so nobody hunts for the missing overlap
+        logger.warning(
+            "--resume-dir disables fused_pipeline: per-chunk checkpoints "
+            "need a non-speculative state at every chunk boundary, so the "
+            "schedule runs the serial chunk loop (pass --no-pipeline to "
+            "silence this, or drop --resume-dir to keep the pipelined "
+            "executor)")
 
     early_stop = GlobalEarlyStop(
         inverted=cfg.compat.inverted_global_early_stop,
@@ -538,7 +596,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                     cfg, data, n_real, model_type, update_type,
                     writer=writer, device_names=device_names,
                     save_checkpoints=save_checkpoints, attack=attack,
-                    chaos=chaos)
+                    chaos=chaos, elastic=elastic)
                 for run, out in enumerate(run_outs):
                     best_metrics[model_type][update_type] = max(
                         best_metrics[model_type][update_type],
@@ -556,7 +614,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                     writer=writer, early_stop=early_stop,
                     device_names=device_names, mesh=mesh, resume=resume,
                     save_checkpoints=save_checkpoints, attack=attack,
-                    chaos=chaos)
+                    chaos=chaos, elastic=elastic)
                 best_metrics[model_type][update_type] = max(
                     best_metrics[model_type][update_type], out["best_final"])
                 all_results[f"{model_type}/{update_type}/run{run}"] = {
@@ -572,6 +630,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
         out["attack"] = dataclasses.asdict(attack)
     if chaos is not None:  # ... and the fault scenario (fedmse_tpu/chaos/)
         out["chaos"] = dataclasses.asdict(chaos)
+    if elastic is not None:  # ... and the membership timeline (elastic.py)
+        out["elastic"] = dataclasses.asdict(elastic)
     if serve:
         if not save_checkpoints:
             logger.warning("--serve needs the checkpointed ClientModel tree"
@@ -670,6 +730,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--chaos-stop", type=int, default=None,
                    help="first round chaos stops (finite fault burst; "
                         "default None: chaos to the end)")
+    # elastic membership (federation/elastic.py): any nonzero rate compiles
+    # the client-slot pool into the fused schedule — joins recycle retired
+    # slots (generation counters, global-model inheritance, fresh Adam
+    # moments), leaves retire them. Composes with --chaos-* and
+    # --attack-kind: churn x faults x Byzantine peers.
+    p.add_argument("--elastic-leave", type=float, default=0.0,
+                   help="per-slot per-round probability an occupied slot's "
+                        "tenant LEAVES (slot retired: no train/vote/weight/"
+                        "broadcast, moments invalidated, metric NaN)")
+    p.add_argument("--elastic-join", type=float, default=0.0,
+                   help="per-slot per-round probability a retired slot is "
+                        "recycled by a JOINING tenant (generation += 1, "
+                        "params from the incumbent-mean global model, Adam "
+                        "moments zeroed, verifier history cleared)")
+    p.add_argument("--elastic-preempt", type=float, default=0.0,
+                   help="per-slot per-round probability an occupied slot is "
+                        "PREEMPTED (leave+join in one round: same tenant "
+                        "slot, fresh state from the global model, "
+                        "generation += 1)")
+    p.add_argument("--elastic-start", type=int, default=0,
+                   help="first round membership may change")
+    p.add_argument("--elastic-stop", type=int, default=None,
+                   help="first round membership freezes again (finite churn "
+                        "burst; default None: churn to the end)")
+    p.add_argument("--elastic-initial-members", type=float, default=1.0,
+                   help="fraction of slots occupied at round 0 (< 1 leaves "
+                        "headroom for joins from the start)")
     add_cli_overrides(p)
     return p
 
@@ -725,13 +812,36 @@ def main(argv: Optional[List[str]] = None) -> Dict:
             f"{cfg.experiment_name}_chaos-d{chaos.dropout_p:g}"
             f"g{chaos.straggler_p:g}c{chaos.crash_p:g}"
             f"b{chaos.broadcast_loss_p:g}s{chaos.start_round}{stop_tag}"))
+    elastic = None
+    # nonzero (NOT "> 0") for the same reason as chaos: a negative typo
+    # must reach ElasticSpec's eager validation and fail loudly
+    if any(p != 0 for p in (args.elastic_leave, args.elastic_join,
+                            args.elastic_preempt)) \
+            or args.elastic_initial_members != 1.0:
+        from fedmse_tpu.federation import ElasticSpec
+        elastic = ElasticSpec(leave_p=args.elastic_leave,
+                              join_p=args.elastic_join,
+                              preempt_p=args.elastic_preempt,
+                              start_round=args.elastic_start,
+                              stop_round=args.elastic_stop,
+                              initial_member_frac=args.elastic_initial_members)
+        # same isolation rule as attacked/chaotic artifacts: elastic runs
+        # get their own ResultsWriter/checkpoint tree
+        stop_tag = ("" if elastic.stop_round is None
+                    else f"e{elastic.stop_round}")
+        cfg = cfg.replace(experiment_name=(
+            f"{cfg.experiment_name}_elastic-l{elastic.leave_p:g}"
+            f"j{elastic.join_p:g}p{elastic.preempt_p:g}"
+            f"s{elastic.start_round}{stop_tag}"))
     # dataset IO comes AFTER the eager spec validation above: a malformed
-    # --attack-*/--chaos-* flag fails loudly before any file is touched
+    # --attack-*/--chaos-*/--elastic-* flag fails loudly before any file
+    # is touched
     dataset = DatasetConfig.from_json(args.dataset_config, args.data_root)
     return run_experiment(cfg, dataset, use_mesh=args.use_mesh,
                           save_checkpoints=not args.no_save,
                           resume_dir=args.resume_dir, attack=attack,
-                          chaos=chaos, batch_runs=args.batch_runs,
+                          chaos=chaos, elastic=elastic,
+                          batch_runs=args.batch_runs,
                           serve=args.serve, serve_rows=args.serve_rows,
                           serve_warmup=args.serve_warmup,
                           serve_continuous=args.serve_continuous)

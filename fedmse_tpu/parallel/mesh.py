@@ -114,9 +114,10 @@ def shard_clients_local(tree: Any, mesh: Mesh, global_clients: int,
 
     def place(leaf):
         leaf = np.asarray(leaf)
-        spec = P(axis_name, *([None] * (leaf.ndim - 1)))
+        # P(axis_name) with no trailing Nones — the jit-output fixed point
+        # (see state.client_states_sharding)
         return jax.make_array_from_process_local_data(
-            NamedSharding(mesh, spec), leaf,
+            NamedSharding(mesh, P(axis_name)), leaf,
             global_shape=(global_clients,) + leaf.shape[1:])
 
     return jax.tree.map(place, tree)
@@ -126,8 +127,9 @@ def shard_clients(tree: Any, mesh: Mesh, axis_name: str = "clients") -> Any:
     """Place a stacked pytree with its leading axis sharded over the mesh
     (the mesh may span multiple hosts — see parallel/multihost.py)."""
     def place(leaf):
-        spec = P(axis_name, *([None] * (jnp.ndim(leaf) - 1)))
-        return _place(leaf, NamedSharding(mesh, spec))
+        # no trailing Nones (the jit-output fixed point; see
+        # state.client_states_sharding)
+        return _place(leaf, NamedSharding(mesh, P(axis_name)))
     return jax.tree.map(place, tree)
 
 

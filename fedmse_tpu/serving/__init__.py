@@ -34,7 +34,9 @@ from fedmse_tpu.serving.batcher import MicroBatcher
 from fedmse_tpu.serving.calibration import ServingCalibration, fit_calibration
 from fedmse_tpu.serving.continuous import ContinuousBatcher
 from fedmse_tpu.serving.drift import DriftMonitor
-from fedmse_tpu.serving.engine import ServingEngine, fit_gateway_centroids
+from fedmse_tpu.serving.engine import (ServingEngine, ServingRoster,
+                                       UnknownGatewayError,
+                                       fit_gateway_centroids)
 from fedmse_tpu.serving.smoke import run_serve_smoke
 
 __all__ = [
@@ -44,6 +46,8 @@ __all__ = [
     "fit_calibration",
     "DriftMonitor",
     "ServingEngine",
+    "ServingRoster",
+    "UnknownGatewayError",
     "fit_gateway_centroids",
     "run_serve_smoke",
 ]

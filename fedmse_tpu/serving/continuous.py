@@ -54,6 +54,8 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from fedmse_tpu.serving.engine import UnknownGatewayError
+
 
 class BatchRecord:
     """One batch's shared result arrays (filled at harvest time)."""
@@ -300,6 +302,16 @@ class ContinuousBatcher:
         start_window = self._start_window
         flush = self.flush
         new, ticket = _new_ticket, StreamTicket
+        # roster validation at INTAKE, not dispatch: a retired-slot row
+        # admitted into the forming bucket would poison the whole batch's
+        # dispatch later — reject it before it joins the window. The
+        # roster is read LIVE from the engine (one attribute load per row,
+        # like submit_many): a roster installed directly via
+        # ServingEngine.swap_state(roster=...) — the documented hot-swap
+        # path — must reach intake even when the caller never touches
+        # ContinuousBatcher.swap.
+        engine = self.engine
+        unknown = UnknownGatewayError
 
         def submit(x, gateway_id: int = 0) -> StreamTicket:
             """Admit one row into the forming bucket; returns its ticket.
@@ -308,6 +320,12 @@ class ContinuousBatcher:
             later than the sync batcher (the in-flight batch is harvested
             right after its successor dispatches), or on
             `poll()`/`drain()`."""
+            roster = getattr(engine, "roster", None)
+            if roster is not None and not roster.member[gateway_id]:
+                raise unknown(
+                    f"UNKNOWN_GATEWAY: gateway slot {gateway_id} is "
+                    f"retired; swap in an updated roster if it was "
+                    f"recycled (ContinuousBatcher.swap(roster=...))")
             now = clock()
             buf = hot[0]
             if buf:
@@ -354,6 +372,19 @@ class ContinuousBatcher:
             gw = np.broadcast_to(gw, (n,)).copy()
         elif gw is gw_in:
             gw = gw.copy()  # same aliasing hazard as the rows
+        roster = getattr(self.engine, "roster", None)
+        if roster is not None and n:
+            bad = ~roster.member[gw]
+            if bad.any():
+                # reject the burst BEFORE any row is admitted: a partial
+                # admit would leave the caller holding tickets for half
+                # its rows (same intake-validation rule as submit)
+                slots = sorted(set(int(g) for g in gw[bad]))
+                raise UnknownGatewayError(
+                    f"UNKNOWN_GATEWAY: burst routes rows to retired "
+                    f"gateway slot(s) {slots[:5]}"
+                    f"{'...' if len(slots) > 5 else ''}; swap in an "
+                    f"updated roster if they were recycled")
         now = self.clock()
         hot = self._hot
         segs = []
@@ -493,7 +524,7 @@ class ContinuousBatcher:
     # ----------------------------- hot swap ------------------------------ #
 
     def swap(self, *, params=None, centroids=None, banks=None,
-             calibration=None) -> Dict:
+             calibration=None, roster=None) -> Dict:
         """Atomically install new serving state between dispatches.
 
         `params` (a newer checkpoint's stacked tree), `centroids`, and
@@ -501,17 +532,38 @@ class ContinuousBatcher:
         swap through `engine.swap_state` (zero retrace — engine.py);
         `calibration` replaces the threshold set used for every batch
         dispatched from now on AND rebaselines the drift monitor (its
-        streaming moments restart against the new reference). Batches
+        streaming moments restart against the new reference). `roster`
+        (a ServingRoster) propagates an elastic federation's membership
+        change — joined slots admit traffic again, left slots start
+        rejecting at intake with UNKNOWN_GATEWAY; pair it with the
+        recycled slots' params/banks/calibration rows in the SAME call so
+        a re-tenanted slot never serves its predecessor's model. Batches
         already dispatched keep the state/calibration they captured, so
         every in-flight ticket is scored exactly once under the regime
         that admitted it — zero drops, zero re-scores (pinned by
-        tests/test_continuous.py). Returns the swap event (also appended
+        tests/test_continuous.py; roster swaps included —
+        tests/test_elastic.py). Returns the swap event (also appended
         to `self.swaps`)."""
         kinds: List[str] = []
-        if params is not None or centroids is not None or banks is not None:
+        roster_delta = None
+        if roster is not None:
+            # membership changes are ADMISSION-boundary events: rows in
+            # the forming bucket were validated under the outgoing roster,
+            # so they must dispatch under it (engine.dispatch re-validates
+            # at flush) — close their batch before the roster flips. The
+            # other swap kinds keep the existing boundary (forming rows
+            # score under the incoming state).
+            self.flush()
+        if params is not None or centroids is not None or banks is not None \
+                or roster is not None:
             info = self.engine.swap_state(params=params, centroids=centroids,
-                                          banks=banks)
+                                          banks=banks, roster=roster)
             kinds.extend(info["swapped"])
+            roster_delta = info.get("roster_delta")
+            # intake reads the roster live from the engine, so the new
+            # roster takes effect at the very next submit with no rebind
+            # (in-flight batches are untouched: their rows were validated
+            # under the roster that admitted them)
         if calibration is not None:
             if calibration.num_gateways != self.engine.num_gateways:
                 raise ValueError(
@@ -535,6 +587,8 @@ class ContinuousBatcher:
             "at_rows_submitted": self.rows_submitted,
             "at_dispatches": self.dispatch_count,
         }
+        if roster_delta is not None:
+            event["roster_delta"] = roster_delta
         self.swaps.append(event)
         return event
 

@@ -41,6 +41,7 @@ shape, requests padded up to the nearest bucket.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import time
 from typing import Any, Dict, Optional, Union
 
@@ -54,6 +55,52 @@ from fedmse_tpu.ops.precision import PrecisionPolicy, get_policy
 from fedmse_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+class UnknownGatewayError(ValueError):
+    """A request routed to a gateway slot that is not currently a member
+    of the federation (left, or never joined). Raised at DISPATCH
+    validation — the generation-aware extension of the banks.num_gateways
+    check — because inside jit the per-row gathers clamp out-of-range /
+    stale indices silently and would score the row against a recycled
+    slot's model: finite, plausible-looking, wrong. The serving verdict
+    for such a row is UNKNOWN_GATEWAY, not a score."""
+
+    verdict = "UNKNOWN_GATEWAY"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingRoster:
+    """The slot-pool membership view the serving front mirrors from the
+    elastic federation (federation/elastic.py): which gateway slots are
+    occupied, and by which tenant generation. Installed at engine build
+    (`roster=`) or hot-swapped between dispatches
+    (`swap_state(roster=...)` / `ContinuousBatcher.swap(roster=...)`) —
+    host-side metadata, so a roster change never touches the jit cache."""
+
+    member: np.ndarray      # [N] bool — slot currently serves a tenant
+    generation: np.ndarray  # [N] int64 — tenant generation per slot
+
+    def __post_init__(self):
+        object.__setattr__(self, "member",
+                           np.ascontiguousarray(self.member, dtype=bool))
+        object.__setattr__(self, "generation",
+                           np.ascontiguousarray(self.generation,
+                                                dtype=np.int64))
+        if self.member.shape != self.generation.shape:
+            raise ValueError(
+                f"roster member {self.member.shape} and generation "
+                f"{self.generation.shape} must describe the same slots")
+
+    @property
+    def num_gateways(self) -> int:
+        return len(self.member)
+
+    @staticmethod
+    def full(n: int) -> "ServingRoster":
+        """The static federation's roster: every slot a founding tenant."""
+        return ServingRoster(member=np.ones(n, bool),
+                             generation=np.zeros(n, np.int64))
 
 
 class PendingScores:
@@ -169,6 +216,14 @@ class ServingEngine:
         redundancy must lose (the 500-gateway regime). Score parity
         between the two is float-level, not bitwise (GEMM vs per-row
         reduction order), within the serving suite's 1e-5 pin.
+    roster : optional ServingRoster mirroring an elastic federation's
+        slot-pool membership (federation/elastic.py). With a roster
+        installed, every dispatch validates that each row's gateway slot
+        is currently OCCUPIED — a left gateway's rows fail loudly with
+        `UnknownGatewayError` (verdict UNKNOWN_GATEWAY) instead of
+        silently scoring against whatever model the recycled slot now
+        holds. Roster changes ride the hot-swap path
+        (`swap_state(roster=...)`): host-side metadata, zero retrace.
     mesh : optional 1-D jax Mesh (parallel.client_mesh). When set, the
         serving state and the dispatched row buffers are placed with
         explicit shardings so multi-device serving uses every device: the
@@ -192,7 +247,8 @@ class ServingEngine:
                  knn_topk: str = "exact", multi_tenant: bool = True,
                  max_bucket: int = 1024,
                  precision: Union[str, PrecisionPolicy] = "f32",
-                 mesh: Any = None, routing: str = "auto"):
+                 mesh: Any = None, routing: str = "auto",
+                 roster: Optional[ServingRoster] = None):
         from fedmse_tpu.evaluation.evaluator import resolve_score_kind
         if model_type not in ("autoencoder", "hybrid"):
             raise ValueError(f"unknown model_type {model_type!r}")
@@ -258,6 +314,15 @@ class ServingEngine:
                 f"{'multi-tenant' if multi_tenant else 'single-tenant'} "
                 f"engine serves {self.num_gateways}; was the bank "
                 f"persisted from a different federation?")
+        # generation-aware roster (federation/elastic.py): None = static
+        # federation, every slot serves. With a roster, dispatch validation
+        # rejects rows routed to retired slots (UnknownGatewayError) —
+        # see _check_roster.
+        if roster is not None and roster.num_gateways != self.num_gateways:
+            raise ValueError(
+                f"roster describes {roster.num_gateways} gateway slots but "
+                f"this engine serves {self.num_gateways}")
+        self.roster = roster
         self.dim = int(model.input_dim)
         self._score_fn: Optional[Any] = None
         self.dispatches: collections.Counter = collections.Counter()
@@ -320,8 +385,31 @@ class ServingEngine:
 
     # ----------------------------- hot swap ------------------------------ #
 
-    def swap_state(self, *, params=None, centroids=None, banks=None) -> Dict:
-        """Atomically install a new checkpoint / centroids / kNN banks.
+    def _check_roster(self, gw: np.ndarray) -> None:
+        """Generation-aware roster check at dispatch (the elastic
+        extension of the banks.num_gateways load-time check): rows routed
+        to a retired slot must fail loudly HERE — inside jit the gathers
+        clamp silently, and the recycled slot's resident model belongs to
+        a DIFFERENT tenant."""
+        if self.roster is None or not len(gw):
+            return
+        bad = ~self.roster.member[gw]
+        if bad.any():
+            slots = sorted(set(int(g) for g in gw[bad]))
+            shown = slots[:5]
+            gens = {s: int(self.roster.generation[s]) for s in shown}
+            raise UnknownGatewayError(
+                f"UNKNOWN_GATEWAY: rows route to retired gateway slot(s) "
+                f"{shown}{'...' if len(slots) > 5 else ''} (last tenant "
+                f"generation {gens}); the tenant left the federation — "
+                f"install the updated roster (swap_state(roster=...)) "
+                f"alongside the recycled slot's params/banks/calibration "
+                f"if the slot was re-tenanted")
+
+    def swap_state(self, *, params=None, centroids=None, banks=None,
+                   roster=None) -> Dict:
+        """Atomically install a new checkpoint / centroids / kNN banks /
+        membership roster.
 
         The replacement becomes the operand of the NEXT dispatch; batches
         already in flight captured the old state dict and are unaffected
@@ -372,11 +460,43 @@ class ServingEngine:
                             banks.bank_size)
             new["banks"] = self._place_state(banks)
             swapped.append("banks")
+        roster_delta = None
+        if roster is not None:
+            if roster.num_gateways != self.num_gateways:
+                raise ValueError(
+                    f"swap roster describes {roster.num_gateways} gateway "
+                    f"slots, engine serves {self.num_gateways}")
+            old = self.roster
+            if old is not None:
+                joined = np.flatnonzero(roster.member & ~old.member)
+                left = np.flatnonzero(old.member & ~roster.member)
+                recycled = np.flatnonzero(roster.generation > old.generation)
+                roster_delta = {"joined": joined.tolist(),
+                                "left": left.tolist(),
+                                "recycled": recycled.tolist()}
+                if len(recycled) and params is None:
+                    # a recycled slot's resident model still belongs to
+                    # the PREVIOUS tenant; the roster alone re-opens the
+                    # slot without replacing what it serves
+                    logger.warning(
+                        "roster swap recycles slot(s) %s (generation "
+                        "advanced) without a params swap in the same call; "
+                        "those slots keep serving the previous tenant's "
+                        "model until new params/banks/calibration arrive",
+                        recycled.tolist()[:8])
+            swapped.append("roster")
         if not swapped:
             raise ValueError("swap_state: nothing to swap")
         self._state = new  # one atomic rebind; next dispatch sees it whole
+        if roster is not None:
+            # host-side metadata: validated at dispatch, never traced —
+            # a roster change can never retrace or recompile anything
+            self.roster = roster
         self.swap_count += 1
-        return {"swapped": swapped, "swap_count": self.swap_count}
+        out = {"swapped": swapped, "swap_count": self.swap_count}
+        if roster_delta is not None:
+            out["roster_delta"] = roster_delta
+        return out
 
     @staticmethod
     def _check_swap(name: str, old, new):
@@ -555,6 +675,7 @@ class ServingEngine:
                 raise ValueError(
                     f"gateway ids must be in [0, {self.num_gateways}); "
                     f"got range [{gw.min()}, {gw.max()}]")
+        self._check_roster(gw)
         out = np.empty(n, np.float32)
         start = 0
         while start < n:
@@ -603,6 +724,7 @@ class ServingEngine:
                 raise ValueError(
                     f"gateway ids must be in [0, {self.num_gateways}); "
                     f"got range [{gw.min()}, {gw.max()}]")
+        self._check_roster(gw)
         return self._dispatch_chunk(x, gw)
 
     def _dispatch_chunk(self, x: np.ndarray, gw: np.ndarray) -> PendingScores:

@@ -34,6 +34,14 @@ import numpy as np
 # zero-probability ChaosSpec) leaves every other draw bit-identical.
 CHAOS_STREAM_TAG = 0x4348414F  # "CHAO"
 
+# Domain tag for the elastic-membership stream (federation/elastic.py):
+# join/leave/preempt draws come from fold_in(jax_root, ELASTIC_STREAM_TAG),
+# a branch separated from training/eval/selection AND from the chaos stream
+# — enabling churn perturbs no other draw, and composing churn with chaos
+# leaves both fault streams bit-identical to running either alone
+# (tests/test_elastic.py pins the separation like test_chaos.py does).
+ELASTIC_STREAM_TAG = 0x454C4153  # "ELAS"
+
 
 def fold_in_keys(key: jax.Array, n: int) -> jax.Array:
     """[n] per-index keys `fold_in(key, i)` — the ONE home of the
@@ -88,6 +96,15 @@ class ExperimentRngs:
         the run roots themselves (the batched-runs axis reuses this
         per run — chaos/masks.py make_batched_chaos_masks)."""
         return jax.random.fold_in(self.jax_root, CHAOS_STREAM_TAG)
+
+    def elastic_key(self) -> jax.Array:
+        """Root of this run's domain-separated membership stream (see
+        ELASTIC_STREAM_TAG). Same contract as `chaos_key`: a pure fold of
+        the run root — calling it consumes nothing, so dynamic membership
+        cannot perturb model-init / tie-break / selection / chaos draws,
+        and per-run membership streams are independent across the batched
+        runs axis (federation/elastic.py make_batched_membership_masks)."""
+        return jax.random.fold_in(self.jax_root, ELASTIC_STREAM_TAG)
 
     def next_jax_batch(self, n: int) -> jax.Array:
         """A [n]-stacked key array identical to n successive `next_jax()`

@@ -69,7 +69,8 @@ def plot_results(results_dir: str, out_dir: str) -> List[str]:
     fig, ax = plt.subplots(figsize=(10, 4.5))
     width = 0.8 / max(len(combos), 1)
     for i, (name, rows) in enumerate(combos.items()):
-        final = np.asarray(rows[-1]["client_metrics"])
+        # elastic artifacts write a retired slot's metric as null
+        final = np.asarray(rows[-1]["client_metrics"], dtype=float)
         x = np.arange(len(final)) + i * width
         ax.bar(x, final * 100, width=width, label=name)
     ax.set_xlabel("gateway")
@@ -84,7 +85,8 @@ def plot_results(results_dir: str, out_dir: str) -> List[str]:
     # per-round mean curves
     fig, ax = plt.subplots(figsize=(7, 4.5))
     for name, rows in combos.items():
-        means = [float(np.mean(r["client_metrics"])) for r in rows]
+        means = [float(np.nanmean(np.asarray(r["client_metrics"],
+                                             dtype=float))) for r in rows]
         ax.plot(np.arange(1, len(means) + 1), means, marker="o", label=name)
     ax.set_xlabel("round"); ax.set_ylabel("mean client metric")
     ax.legend(fontsize=7); ax.set_title("Convergence per aggregation method")

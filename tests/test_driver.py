@@ -94,6 +94,54 @@ def test_resume_continues_rounds(dataset_dir, tmp_path):
     assert len(times) == 2  # rounds 2..3 only — round 1 was resumed, not re-run
 
 
+def test_resume_dir_pipeline_fallback_warns_and_runs_serial(
+        dataset_dir, tmp_path, monkeypatch):
+    """--resume-dir silently forced the serial chunk loop; now it must
+    WARN naming both flags, and the fallback itself is pinned: the
+    pipelined executor is replaced with a tripwire, so the run completing
+    proves the serial path ran."""
+    import logging
+
+    from fedmse_tpu.federation import pipeline as pipeline_mod
+
+    def tripwire(*a, **k):
+        raise AssertionError(
+            "run_pipelined_schedule must not run under --resume-dir")
+
+    monkeypatch.setattr(pipeline_mod, "run_pipelined_schedule", tripwire)
+
+    class Capture(logging.Handler):
+        # package logger is propagate=False (utils/logging.py): caplog
+        # never sees it, attach directly (test_shard_native idiom)
+        def __init__(self):
+            super().__init__(logging.WARNING)
+            self.records = []
+
+        def emit(self, record):
+            self.records.append(record)
+
+    root, cfg_path = dataset_dir
+    pkg = logging.getLogger("fedmse_tpu")
+    handler = Capture()
+    pkg.addHandler(handler)
+    try:
+        out = cli_main([
+            "--dataset-config", cfg_path,
+            "--model-types", "hybrid", "--update-types", "avg",
+            "--network-size", "4", "--dim-features", str(DIM),
+            "--epochs", "1", "--num-rounds", "2", "--batch-size", "8",
+            "--no-save", "--checkpoint-dir", str(tmp_path / "c"),
+            "--resume-dir", str(tmp_path / "r"),
+            "--experiment-name", "tw",
+        ])
+    finally:
+        pkg.removeHandler(handler)
+    assert out["results"]["hybrid/avg/run0"]["round_times"]
+    warnings = [r.getMessage() for r in handler.records]
+    assert any("--resume-dir" in w and "fused_pipeline" in w
+               for w in warnings), warnings
+
+
 def test_global_early_stop_inverted_compat(dataset_dir, tmp_path):
     """Compat quirk 10: with AUC improving, min(metrics) rarely decreases, so
     the inverted comparison stops after patience+1 stagnant rounds."""

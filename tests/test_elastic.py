@@ -1,0 +1,771 @@
+"""Elastic federation (federation/elastic.py): dynamic membership compiled
+into the fused schedule as per-round [T, N] tensors, with the acceptance
+contracts pinned:
+
+  * null-ElasticSpec equivalence — all rates zero, pool full => states,
+    metrics and host counters bit-identical to the static federation on
+    CPU (the PR 3 zero-probability idiom);
+  * membership timelines reproduce from seed, respect per-event windows,
+    and obey the slot-pool chain invariants;
+  * the elastic key stream is domain-separated (enabling churn perturbs
+    no training/eval/selection/chaos draw);
+  * a leave retires the slot: no train/vote/weight/broadcast, Adam
+    moments invalidated, metric NaN;
+  * a join recycles the slot: params + prev_global inherited from the
+    incumbent-mean global model, moments zeroed, verifier history
+    cleared, rejected reset — no state leaks from the previous tenant;
+  * churn x chaos x batched-runs composition equivalence;
+  * ZERO recompiles across churning chunks (the PR 8 _cache_size idiom);
+  * checkpoint round-trip of the generation counters (+ the pre-PR-10 /
+    mismatched-spec clear-error guards) across a chunked schedule;
+  * serving roster: a left gateway's rows fail loudly with
+    UNKNOWN_GATEWAY at dispatch AND at continuous-front intake, and a
+    roster change is a zero-retrace hot-swap payload.
+"""
+
+import glob
+import json
+import logging
+import types
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedmse_tpu.chaos import (ChaosSpec, joiner_incumbent_gap,
+                              membership_metrics)
+from fedmse_tpu.config import CompatConfig, DatasetConfig, ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+from fedmse_tpu.federation import (BatchedRunEngine, ElasticSpec, RoundEngine,
+                                   make_membership_masks, membership_at)
+from fedmse_tpu.models import make_model
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+pytestmark = pytest.mark.elastic
+
+DIM = 12
+N = 4
+RUNS = 2
+
+
+def build_cfg(**kw):
+    return ExperimentConfig(
+        dim_features=DIM, network_size=N, epochs=2, batch_size=8,
+        compat=CompatConfig(vote_tie_break=False), **kw)
+
+
+def build_data(cfg):
+    clients = synthetic_clients(n_clients=N, dim=DIM, n_normal=120,
+                                n_abnormal=60)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(run=0).data_rng)
+    return stack_clients(clients, dev_x, cfg.batch_size)
+
+
+def build_engine(cfg, data, elastic=None, chaos=None, run=0,
+                 update_type="avg"):
+    m = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+    return RoundEngine(m, cfg, data, n_real=N, rngs=ExperimentRngs(run=run),
+                       model_type="hybrid", update_type=update_type,
+                       fused=True, elastic=elastic, chaos=chaos)
+
+
+# ---------------------------------------------------------------- spec ----
+
+def test_spec_validation():
+    for field in ("leave_p", "join_p", "preempt_p"):
+        with pytest.raises(ValueError, match=field):
+            ElasticSpec(**{field: 1.5})
+        with pytest.raises(ValueError, match=field):
+            ElasticSpec(**{field: -0.1})
+    with pytest.raises(ValueError, match="initial_member_frac"):
+        ElasticSpec(initial_member_frac=0.0)
+    with pytest.raises(ValueError, match="stop_round"):
+        ElasticSpec(leave_p=0.5, start_round=3, stop_round=3)
+    with pytest.raises(ValueError, match="leave_window"):
+        ElasticSpec(leave_p=0.5, leave_window=(4, 4))
+    with pytest.raises(ValueError, match="join_window"):
+        ElasticSpec(join_p=0.5, join_window=(-1, 3))
+    assert ElasticSpec().is_null
+    assert not ElasticSpec(join_p=0.1).is_null
+    assert not ElasticSpec(initial_member_frac=0.5).is_null
+    # the checkpoint-compat signature distinguishes distinct timelines
+    a = ElasticSpec(leave_p=0.3, join_p=0.6, leave_window=(4, 6))
+    b = ElasticSpec(leave_p=0.3, join_p=0.6)
+    assert a.signature() != b.signature()
+    assert a.signature() == ElasticSpec(
+        leave_p=0.3, join_p=0.6, leave_window=(4, 6)).signature()
+
+
+def test_elastic_requires_fused_engine():
+    cfg = build_cfg()
+    data = build_data(cfg)
+    m = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+    with pytest.raises(ValueError, match="fused"):
+        RoundEngine(m, cfg, data, n_real=N, rngs=ExperimentRngs(run=0),
+                    model_type="hybrid", update_type="avg", fused=False,
+                    elastic=ElasticSpec(leave_p=0.5))
+
+
+# --------------------------------------------------- membership masks ----
+
+def test_masks_reproduce_and_obey_chain_invariants():
+    spec = ElasticSpec(leave_p=0.4, join_p=0.5, preempt_p=0.2)
+    key = ExperimentRngs(run=0).elastic_key()
+    a = make_membership_masks(spec, key, 10, N)
+    b = make_membership_masks(spec, key, 10, N)
+    for la, lb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    # regrowing the horizon extends the timeline without changing its
+    # prefix (the engine cache's correctness contract)
+    c = make_membership_masks(spec, key, 16, N)
+    for la, lc in zip(a, c):
+        np.testing.assert_array_equal(np.asarray(la),
+                                      np.asarray(lc)[:10])
+    member = np.asarray(a.member)
+    joined = np.asarray(a.joined)
+    left = np.asarray(a.left)
+    gen = np.asarray(a.generation)
+    prev_m = np.ones(N)
+    prev_g = np.zeros(N, int)
+    for t in range(10):
+        # a just-joined/preempted slot is a member; a left slot is not
+        assert (member[t][joined[t] > 0] == 1).all()
+        assert (member[t][left[t] > 0] == 0).all()
+        # generation increments exactly on recycles
+        np.testing.assert_array_equal(gen[t] - prev_g,
+                                      (joined[t] > 0).astype(int))
+        # joins only fill retired slots; leaves only empty occupied ones
+        # (a joined=1 on an occupied slot is a preemption: member stays 1)
+        assert (prev_m[left[t] > 0] == 1).all()
+        new_joins = (joined[t] > 0) & (prev_m == 0)
+        np.testing.assert_array_equal(
+            member[t], ((prev_m > 0) & (left[t] == 0)) | new_joins)
+        prev_m, prev_g = member[t], gen[t]
+    # a different run's elastic key gives a different timeline
+    other = make_membership_masks(
+        spec, ExperimentRngs(run=1).elastic_key(), 10, N)
+    assert any(not np.array_equal(np.asarray(la), np.asarray(lo))
+               for la, lo in zip(a, other))
+
+
+def test_masks_respect_per_event_windows():
+    # leaves only in [2, 4); joins only from 4 — the burst construction
+    spec = ElasticSpec(leave_p=1.0, join_p=1.0,
+                       leave_window=(2, 4), join_window=(4, None))
+    key = ExperimentRngs(run=0).elastic_key()
+    m = np.asarray(make_membership_masks(spec, key, 8, N).member)
+    assert (m[:2] == 1).all()       # before the burst: everyone present
+    assert (m[2:4] == 0).all()      # leave_p=1 empties the pool
+    assert (m[4:] == 1).all()       # join_p=1 refills it from round 4
+    left = np.asarray(make_membership_masks(spec, key, 8, N).left)
+    assert left[2].sum() == N and left[3].sum() == 0  # all left at once
+
+
+def test_masks_are_padding_invariant():
+    """The real slots' timeline must not depend on the pad width: the
+    engines draw masks over n_pad (mesh-dependent), but the checkpoint
+    membership signature encodes only (spec, key) — so an 8-device resume
+    of a 1-device snapshot must recompute the identical roster
+    (fold_in-per-slot, PARITY.md §8; a shaped bernoulli would re-tenant
+    different slots per mesh size)."""
+    spec = ElasticSpec(leave_p=0.4, join_p=0.5, preempt_p=0.2,
+                       initial_member_frac=0.7)
+    key = ExperimentRngs(run=0).elastic_key()
+    narrow = make_membership_masks(spec, key, 10, N)
+    for pad in (N + 1, 2 * N, 16):
+        wide = make_membership_masks(spec, key, 10, pad)
+        for ln, lw in zip(narrow, wide):
+            np.testing.assert_array_equal(np.asarray(ln),
+                                          np.asarray(lw)[:, :N])
+
+
+def test_elastic_key_is_domain_separated():
+    """Building membership must consume NOTHING from any other stream —
+    and the elastic branch is distinct from the chaos branch, so the two
+    fault axes compose without perturbing each other."""
+    rngs = ExperimentRngs(run=0)
+    fold_before = rngs._fold
+    state_before = rngs.select_rng.getstate()
+    k1 = rngs.elastic_key()
+    make_membership_masks(ElasticSpec(leave_p=0.5, join_p=0.5), k1, 4, N)
+    k2 = rngs.elastic_key()
+    assert rngs._fold == fold_before
+    assert rngs.select_rng.getstate() == state_before
+    np.testing.assert_array_equal(jax.random.key_data(k1),
+                                  jax.random.key_data(k2))
+    assert not np.array_equal(jax.random.key_data(k1),
+                              jax.random.key_data(rngs.chaos_key()))
+    for _ in range(16):
+        assert not np.array_equal(jax.random.key_data(rngs.next_jax()),
+                                  jax.random.key_data(k1))
+
+
+# ----------------------------------------------- null-spec identity ----
+
+def test_null_elastic_bit_identical_schedule():
+    """The acceptance contract: an all-zero-rates ElasticSpec ==> the
+    fused schedule's states, metrics and host streams are bit-identical
+    to an elastic-free run on CPU."""
+    cfg = build_cfg()
+    data = build_data(cfg)
+    base = build_engine(cfg, data)
+    base_res = base.run_rounds(0, 3)
+    null = build_engine(cfg, data, elastic=ElasticSpec())
+    null_res = null.run_rounds(0, 3)
+
+    for rb, rz in zip(base_res, null_res):
+        assert rb.selected == rz.selected          # host stream untouched
+        assert rb.aggregator == rz.aggregator
+        # membership observability: measured (full) under the null spec,
+        # None ("not measured") on the static program
+        assert rb.members is None and rb.generations is None
+        assert rz.members == list(range(N))
+        assert (rz.generations == 0).all()
+        np.testing.assert_array_equal(rb.client_metrics, rz.client_metrics)
+        np.testing.assert_array_equal(rb.min_valid, rz.min_valid)
+        np.testing.assert_array_equal(rb.tracking, rz.tracking)
+    for lb, lz in zip(jax.tree.leaves(jax.device_get(base.states)),
+                      jax.tree.leaves(jax.device_get(null.states))):
+        np.testing.assert_array_equal(np.asarray(lb), np.asarray(lz))
+    assert base.host.aggregation_count.tolist() == \
+        null.host.aggregation_count.tolist()
+
+
+# ------------------------------------------------- slot-pool semantics ----
+
+def test_leave_retires_slots():
+    """leave_p=1 in [1, 2): every tenant departs at round 1 — from then on
+    nobody trains or votes (no_aggregate), Adam moments are invalidated,
+    and every metric reads NaN (nobody there), until nobody ever rejoins."""
+    cfg = build_cfg()
+    data = build_data(cfg)
+    eng = build_engine(cfg, data,
+                       elastic=ElasticSpec(leave_p=1.0, leave_window=(1, 2)))
+    results = eng.run_rounds(0, 3)
+    assert results[0].members == list(range(N))
+    assert results[0].aggregator is not None
+    for r in results[1:]:
+        assert r.members == []
+        assert r.aggregator is None
+        assert r.effective == []
+        assert np.isnan(r.client_metrics).all()
+    st = jax.device_get(eng.states)
+    for leaf in jax.tree.leaves(st.opt_state):
+        np.testing.assert_array_equal(np.asarray(leaf),
+                                      np.zeros_like(np.asarray(leaf)))
+    mets = membership_metrics(results)
+    assert mets["elastic"] and mets["leaves"] == N and mets["joins"] == 0
+    assert mets["final_members"] == 0
+
+
+def test_join_inherits_global_and_zeroes_moments():
+    """Round-body unit test with a crafted membership slice: a recycled
+    slot must enter the round holding the INCUMBENT-MEAN params (and
+    prev_global), zero Adam moments, cleared verifier history and a zero
+    rejected counter — nothing of the previous tenant survives."""
+    from fedmse_tpu.federation.fused import make_round_body
+    from fedmse_tpu.federation.elastic import MembershipMasks
+
+    cfg = build_cfg()
+    data = build_data(cfg)
+    eng = build_engine(cfg, data, elastic=ElasticSpec())  # programs only
+    # jit WITHOUT donation: run eagerly, the inner train_all would donate
+    # the very buffers the rest of the body (and the test) still reads
+    body = jax.jit(make_round_body(
+        eng.train_all, eng.scores_fn, eng.aggregate, eng.verify,
+        eng.evaluate_all, cfg.max_aggregation_threshold, elastic=True))
+    j = 2  # the recycled slot; NOT selected, so training never touches it
+
+    # poison slot j with a previous tenant's residue
+    def poison(leaf, value):
+        arr = np.asarray(leaf).copy()
+        arr[j] = value
+        return jax.numpy.asarray(arr)
+
+    st = eng.states
+    st = type(st)(
+        params=jax.tree.map(lambda t: poison(t, 99.0), st.params),
+        opt_state=jax.tree.map(lambda t: poison(t, 1), st.opt_state),
+        prev_global=st.prev_global,
+        hist_params=jax.tree.map(lambda t: poison(t, 3.0), st.hist_params),
+        hist_perf=poison(st.hist_perf, 5.0),
+        hist_seen=poison(st.hist_seen, True),
+        rejected=poison(st.rejected, 7))
+    incumbent_means = [np.asarray(t)[[i for i in range(N) if i != j]].mean(0)
+                       for t in jax.tree.leaves(st.params)]
+
+    el = MembershipMasks(
+        member=jax.numpy.ones(N, jax.numpy.float32),
+        joined=jax.numpy.asarray(
+            (np.arange(N) == j).astype(np.float32)),
+        left=jax.numpy.zeros(N, jax.numpy.float32),
+        generation=jax.numpy.asarray(
+            (np.arange(N) == j).astype(np.int32)))
+    sel = jax.numpy.asarray([0], jax.numpy.int32)  # single voter => no
+    mask = jax.numpy.asarray(                      # candidates => no merge
+        (np.arange(N) == 0).astype(np.float32))
+    new_states, _, out = body(st, data, eng._ver_x, eng._ver_m, sel, mask,
+                              jax.numpy.zeros(N, jax.numpy.int32),
+                              jax.random.key(0),
+                              jax.numpy.asarray(0, jax.numpy.int32),
+                              None, el)
+    assert int(out.aggregator) == -1  # isolate the join from the merge
+    new = jax.device_get(new_states)
+    for leaf, want in zip(jax.tree.leaves(new.params), incumbent_means):
+        np.testing.assert_allclose(np.asarray(leaf)[j], want,
+                                   rtol=1e-5, atol=1e-7)
+    for leaf, want in zip(jax.tree.leaves(new.prev_global),
+                          incumbent_means):
+        np.testing.assert_allclose(np.asarray(leaf)[j], want,
+                                   rtol=1e-5, atol=1e-7)
+    for leaf in jax.tree.leaves(new.opt_state):
+        np.testing.assert_array_equal(np.asarray(leaf)[j],
+                                      np.zeros_like(np.asarray(leaf)[j]))
+    for leaf in jax.tree.leaves(new.hist_params):
+        np.testing.assert_array_equal(np.asarray(leaf)[j],
+                                      np.zeros_like(np.asarray(leaf)[j]))
+    assert np.asarray(new.hist_perf)[j] == 0
+    assert not np.asarray(new.hist_seen)[j]
+    assert np.asarray(new.rejected)[j] == 0
+    # incumbents (unselected, non-joining) pass through untouched
+    for leaf, before in zip(jax.tree.leaves(new.params),
+                            jax.tree.leaves(jax.device_get(st.params))):
+        np.testing.assert_array_equal(np.asarray(leaf)[3],
+                                      np.asarray(before)[3])
+
+
+def test_leave_zeroes_moments_only():
+    """A leave (without a join) invalidates the departing tenant's Adam
+    moments but leaves its params in place (the slot is dark, not
+    scrubbed — the scrub happens at recycle time)."""
+    from fedmse_tpu.federation.fused import make_round_body
+    from fedmse_tpu.federation.elastic import MembershipMasks
+
+    cfg = build_cfg()
+    data = build_data(cfg)
+    eng = build_engine(cfg, data, elastic=ElasticSpec())
+    body = jax.jit(make_round_body(  # no donation: see the join test
+        eng.train_all, eng.scores_fn, eng.aggregate, eng.verify,
+        eng.evaluate_all, cfg.max_aggregation_threshold, elastic=True))
+    leaver = 1
+    st = eng.states
+    ones_opt = jax.tree.map(
+        lambda t: jax.numpy.ones_like(t), st.opt_state)
+    st = type(st)(params=st.params, opt_state=ones_opt,
+                  prev_global=st.prev_global, hist_params=st.hist_params,
+                  hist_perf=st.hist_perf, hist_seen=st.hist_seen,
+                  rejected=st.rejected)
+    el = MembershipMasks(
+        member=jax.numpy.asarray(
+            (np.arange(N) != leaver).astype(np.float32)),
+        joined=jax.numpy.zeros(N, jax.numpy.float32),
+        left=jax.numpy.asarray(
+            (np.arange(N) == leaver).astype(np.float32)),
+        generation=jax.numpy.zeros(N, jax.numpy.int32))
+    sel = jax.numpy.asarray([0], jax.numpy.int32)
+    mask = jax.numpy.asarray((np.arange(N) == 0).astype(np.float32))
+    new_states, _, out = body(st, data, eng._ver_x, eng._ver_m, sel, mask,
+                              jax.numpy.zeros(N, jax.numpy.int32),
+                              jax.random.key(0),
+                              jax.numpy.asarray(0, jax.numpy.int32),
+                              None, el)
+    new = jax.device_get(new_states)
+    for leaf in jax.tree.leaves(new.opt_state):
+        arr = np.asarray(leaf)
+        np.testing.assert_array_equal(arr[leaver],
+                                      np.zeros_like(arr[leaver]))
+        # a staying, unselected incumbent's moments are untouched
+        np.testing.assert_array_equal(arr[3], np.ones_like(arr[3]))
+    for leaf, before in zip(jax.tree.leaves(new.params),
+                            jax.tree.leaves(jax.device_get(st.params))):
+        np.testing.assert_array_equal(np.asarray(leaf)[leaver],
+                                      np.asarray(before)[leaver])
+    # the retired slot's metric reads NaN
+    assert np.isnan(np.asarray(out.metrics)[leaver])
+
+
+# --------------------------------------------------------- equivalence ----
+
+def test_elastic_chunking_invariant():
+    """Membership keys on the ABSOLUTE round index (whole-schedule
+    expansion + slicing), so the chunked scan and the per-round replay
+    path see identical rosters: 3 chunks of 2 == 6 single-round
+    dispatches."""
+    cfg = build_cfg()
+    data = build_data(cfg)
+    spec = ElasticSpec(leave_p=0.3, join_p=0.5, preempt_p=0.1)
+    a = build_engine(cfg, data, elastic=spec, update_type="mse_avg")
+    res_a = a.run_rounds(0, 2) + a.run_rounds(2, 2) + a.run_rounds(4, 2)
+    b = build_engine(cfg, data, elastic=spec, update_type="mse_avg")
+    res_b = [b.run_round_fused(i) for i in range(6)]
+    churn_seen = False
+    for ra, rb in zip(res_a, res_b):
+        assert ra.selected == rb.selected
+        assert ra.aggregator == rb.aggregator
+        assert ra.members == rb.members
+        np.testing.assert_array_equal(ra.generations, rb.generations)
+        np.testing.assert_allclose(ra.client_metrics, rb.client_metrics,
+                                   rtol=1e-5, atol=1e-6)
+        churn_seen = churn_seen or ra.members != list(range(N))
+    assert churn_seen  # the spec actually churned
+
+
+def test_elastic_composes_with_chaos_and_batched_runs():
+    """R batched churning+faulting runs == R sequential ones: same
+    membership timelines (per-run domain-separated elastic streams), same
+    fault masks, same elections, same rosters and generations."""
+    cfg = build_cfg(num_rounds=3, num_runs=RUNS)
+    data = build_data(cfg)
+    el = ElasticSpec(leave_p=0.3, join_p=0.5)
+    ch = ChaosSpec(dropout_p=0.3, broadcast_loss_p=0.2)
+    m = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+
+    seq = {}
+    for r in range(RUNS):
+        eng = RoundEngine(m, cfg, data, n_real=N, rngs=ExperimentRngs(run=r),
+                          model_type="hybrid", update_type="mse_avg",
+                          fused=True, elastic=el, chaos=ch)
+        seq[r] = eng.run_rounds(0, cfg.num_rounds)
+
+    bat = BatchedRunEngine(m, cfg, data, n_real=N, runs=RUNS,
+                           model_type="hybrid", update_type="mse_avg",
+                           elastic=el, chaos=ch)
+    outs, schedule, _ = bat.run_schedule_chunk(0, cfg.num_rounds,
+                                               np.ones(RUNS, bool))
+    churn_seen = False
+    for i in range(cfg.num_rounds):
+        for r in range(RUNS):
+            res = bat.process_round(r, i, schedule[i][r], outs, i)
+            ref = seq[r][i]
+            assert res.selected == ref.selected
+            assert res.aggregator == ref.aggregator
+            assert res.members == ref.members
+            assert res.effective == ref.effective
+            np.testing.assert_array_equal(res.generations, ref.generations)
+            np.testing.assert_allclose(res.client_metrics,
+                                       ref.client_metrics,
+                                       rtol=1e-5, atol=1e-6, equal_nan=True)
+            churn_seen = churn_seen or res.members != list(range(N))
+    assert churn_seen
+
+
+def test_zero_recompiles_across_churning_chunks():
+    """Membership is a scan INPUT: after the warmup chunk compiles, chunks
+    whose rosters differ round-to-round must hit the same executable (the
+    PR 8 _cache_size idiom — the 10k-scale row lives in churn_sweep.py)."""
+    cfg = build_cfg(num_rounds=6)
+    data = build_data(cfg)
+    eng = build_engine(cfg, data,
+                       elastic=ElasticSpec(leave_p=0.4, join_p=0.5),
+                       update_type="mse_avg")
+    eng.run_schedule_chunk(0, 2)                   # warmup chunk compiles
+    cache = eng._fused_scan._cache_size()
+    eng.run_schedule_chunk(2, 2)                   # different rosters...
+    eng.run_schedule_chunk(4, 2)
+    assert eng._fused_scan._cache_size() == cache  # ...same program
+
+
+# -------------------------------------------------------------- metrics ----
+
+def _fake_result(t, members, generations):
+    return types.SimpleNamespace(round_index=t, members=members,
+                                 generations=np.asarray(generations))
+
+
+def test_membership_metrics_staleness_and_recycles():
+    # slot 1 leaves at round 1, rejoins at round 3 (staleness 2);
+    # slot 0 is preempted at round 2 (generation bump, never absent)
+    results = [
+        _fake_result(0, [0, 1, 2], [0, 0, 0]),
+        _fake_result(1, [0, 2], [0, 0, 0]),
+        _fake_result(2, [0, 2], [1, 0, 0]),
+        _fake_result(3, [0, 1, 2], [1, 1, 0]),
+    ]
+    mets = membership_metrics(results)
+    assert mets["elastic"]
+    assert mets["joins"] == 2 and mets["leaves"] == 1
+    assert mets["slot_recycle_counts"] == [1, 1, 0]
+    assert mets["recycled_slots"] == 2
+    assert sorted(mets["staleness_at_rejoin"]) == [0, 2]
+    assert mets["final_members"] == 3
+    # a static stream reports not-measured
+    static = [types.SimpleNamespace(round_index=0, members=None,
+                                    generations=None)]
+    assert membership_metrics(static) == {"elastic": False}
+    # initial_member_frac < 1: an initially-empty slot is NOT a leave, and
+    # its first tenant's staleness measures from the schedule start
+    partial = [
+        _fake_result(0, [0, 2], [0, 0, 0]),       # slot 1 starts empty
+        _fake_result(2, [0, 1, 2], [0, 1, 0]),    # first tenant at round 2
+    ]
+    m = membership_metrics(partial,
+                           initial_members=np.asarray([True, False, True]))
+    assert m["leaves"] == 0
+    assert m["joins"] == 1
+    assert m["staleness_at_rejoin"] == [2]
+    # without the initial mask the empty slot is miscounted as a leave
+    assert membership_metrics(partial)["leaves"] == 1
+
+
+def test_joiner_incumbent_gap():
+    final = np.asarray([0.9, 0.8, 0.95, np.nan])
+    gen = np.asarray([0, 1, 2, 0])
+    base = np.asarray([0.92, 0.81, 0.94, 0.9])
+    out = joiner_incumbent_gap(final, gen, baseline_metrics=base)
+    assert out["joiners"] == 2 and out["incumbents"] == 2
+    assert out["joiner_mean_auc"] == pytest.approx(0.875)
+    assert out["incumbent_mean_auc"] == pytest.approx(0.9)
+    assert out["mean_gap"] == pytest.approx(0.025)
+    # per-slot vs baseline: max(0.81-0.8, 0.94-0.95) = 0.01
+    assert out["per_slot_gap_vs_baseline"] == pytest.approx(0.01)
+
+
+# -------------------------------------------- checkpoint + driver wiring ----
+
+@pytest.fixture(scope="module")
+def dataset_dir(tmp_path_factory):
+    from tests.test_data import _write_client_csvs
+
+    root = tmp_path_factory.mktemp("elastic_shards")
+    _write_client_csvs(str(root), N, dim=DIM, n_normal=80, n_abnormal=30)
+    cfg_path = root / "config.json"
+    ds = DatasetConfig.for_client_dirs(str(root), N)
+    with open(cfg_path, "w") as f:
+        json.dump(ds.to_json(), f)
+    return str(root), str(cfg_path)
+
+
+def _elastic_cli(cfg_path, tmp_path, sub, extra):
+    from fedmse_tpu.main import main as cli_main
+
+    return cli_main([
+        "--dataset-config", cfg_path,
+        "--model-types", "hybrid", "--update-types", "avg",
+        "--network-size", str(N), "--dim-features", str(DIM),
+        "--epochs", "1", "--batch-size", "8", "--no-save",
+        "--global-patience", "99",  # churn NaNs would trip the inverted
+        "--fused-schedule-chunk", "2",  # early stop mid-schedule otherwise
+        "--checkpoint-dir", str(tmp_path / ("c" + sub)),
+        "--experiment-name", "el" + sub,
+    ] + extra)
+
+
+def test_checkpoint_roundtrip_generation_counters(dataset_dir, tmp_path):
+    """Kill/resume across a chunked elastic schedule: the checkpoint
+    `extra` records the membership signature + generation counters, the
+    resumed run continues (recomputing the identical timeline from the
+    spec + key), and the guards fire with CLEAR messages for a
+    mismatched spec and for a pre-PR-10 snapshot."""
+    root, cfg_path = dataset_dir
+    flags = ["--elastic-leave", "0.3", "--elastic-join", "0.6",
+             "--resume-dir", str(tmp_path / "r")]
+    _elastic_cli(cfg_path, tmp_path, "1", flags + ["--num-rounds", "3"])
+
+    # the host.json carries signature + generation counters
+    host_files = glob.glob(str(tmp_path / "r" / "*.host.json"))
+    assert len(host_files) == 1
+    extra = json.load(open(host_files[0]))["extra"]
+    spec = ElasticSpec(leave_p=0.3, join_p=0.6)
+    assert extra["elastic"] == spec.signature()
+    assert isinstance(extra["elastic_generation"], list)
+    assert len(extra["elastic_generation"]) == N
+    # ... and they match the pure recompute of the timeline
+    masks = make_membership_masks(
+        spec, ExperimentRngs(run=0).elastic_key(), 3, N)
+    _, want_gen = membership_at(masks, 3, N)
+    assert extra["elastic_generation"] == want_gen.tolist()
+
+    # resume continues rounds 4..5 only
+    out = _elastic_cli(cfg_path, tmp_path, "1",
+                       flags + ["--num-rounds", "5"])
+    assert len(out["results"]["hybrid/avg/run0"]["round_times"]) == 2
+    assert out["elastic"]["leave_p"] == 0.3
+
+    # a DIFFERENT membership timeline refuses with a clear message
+    with pytest.raises(ValueError, match="elastic"):
+        _elastic_cli(cfg_path, tmp_path, "1",
+                     ["--elastic-leave", "0.1", "--elastic-join", "0.6",
+                      "--resume-dir", str(tmp_path / "r"),
+                      "--num-rounds", "6"])
+
+    # pre-PR-10 snapshot (no "elastic" key recorded): resuming under churn
+    # must fail naming the flag, not fall through to an Orbax tree error
+    doctored = json.load(open(host_files[0]))
+    doctored["extra"].pop("elastic")
+    doctored["extra"].pop("elastic_generation")
+    json.dump(doctored, open(host_files[0], "w"))
+    with pytest.raises(ValueError, match="elastic"):
+        _elastic_cli(cfg_path, tmp_path, "1",
+                     flags + ["--num-rounds", "6"])
+    # ... while a NON-elastic run resumes a non-elastic-keyed snapshot
+    # (the pre-PR-10 shape) without complaint
+    _elastic_cli(cfg_path, tmp_path, "1",
+                 ["--resume-dir", str(tmp_path / "r"),
+                  "--num-rounds", "4"])
+
+
+def test_cli_elastic_end_to_end(dataset_dir, tmp_path):
+    root, cfg_path = dataset_dir
+    out = _elastic_cli(cfg_path, tmp_path, "2",
+                       ["--elastic-leave", "0.3", "--elastic-join", "0.5",
+                        "--num-rounds", "3"])
+    assert out["elastic"]["join_p"] == 0.5
+    # elastic artifacts land in their own tagged experiment tree
+    assert glob.glob(str(tmp_path / "c2" / "Results" / "Update" / str(N) /
+                         "el2_elastic-l0.3j0.5p0s0" / "**" / "*.json"),
+                     recursive=True), "tagged experiment tree missing"
+    with pytest.raises(ValueError, match="leave_p"):
+        _elastic_cli(cfg_path, tmp_path, "3",
+                     ["--elastic-leave", "-0.5", "--num-rounds", "2"])
+
+
+# ------------------------------------------------------- serving roster ----
+
+def _serving_setup(**kw):
+    from fedmse_tpu.models import init_stacked_params
+    from fedmse_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(0)
+    model = make_model("hybrid", DIM, shrink_lambda=1.0)
+    params = init_stacked_params(model, jax.random.key(0), N)
+    train_x = rng.normal(size=(N, 60, DIM)).astype(np.float32)
+    eng = ServingEngine.from_federation(model, "hybrid", params,
+                                        train_x=train_x, max_bucket=32,
+                                        **kw)
+    rows = rng.normal(size=(64, DIM)).astype(np.float32)
+    return model, params, train_x, eng, rows
+
+
+def test_unknown_gateway_fails_loudly_at_dispatch():
+    from fedmse_tpu.serving import ServingRoster, UnknownGatewayError
+
+    roster = ServingRoster(member=np.asarray([True, True, False, True]),
+                           generation=np.zeros(N, np.int64))
+    model, params, train_x, eng, rows = _serving_setup(roster=roster)
+    # member gateways score fine
+    eng.score(rows[:4], np.asarray([0, 1, 3, 0], np.int32))
+    # a left gateway's rows fail loudly with the UNKNOWN_GATEWAY verdict
+    with pytest.raises(UnknownGatewayError, match="UNKNOWN_GATEWAY"):
+        eng.score(rows[:4], np.asarray([0, 2, 3, 0], np.int32))
+    with pytest.raises(UnknownGatewayError, match="UNKNOWN_GATEWAY"):
+        eng.dispatch(rows[:2], np.asarray([2, 2], np.int32))
+    assert UnknownGatewayError.verdict == "UNKNOWN_GATEWAY"
+    # rosterless engines keep the pre-elastic behavior
+    _, _, _, open_eng, _ = _serving_setup()
+    open_eng.score(rows[:2], np.asarray([2, 2], np.int32))
+
+
+def test_roster_swap_zero_retrace_and_recycle():
+    """A roster change is a hot-swap payload: zero retrace, atomic with
+    the recycled slot's params, and the continuous front's intake starts
+    rejecting/admitting at the very next submit. Rows admitted under the
+    outgoing roster dispatch under it (the swap closes their batch), so
+    every pre-swap ticket is still scored exactly once."""
+    from fedmse_tpu.models import init_stacked_params
+    from fedmse_tpu.serving import (ContinuousBatcher, ServingEngine,
+                                    ServingRoster, UnknownGatewayError,
+                                    fit_gateway_centroids)
+
+    model, params, train_x, eng, rows = _serving_setup(
+        roster=ServingRoster.full(N))
+    eng.warmup()  # compile every bucket so the cache pin sees them all
+    gws_pre = np.asarray([i % N for i in range(8)], np.int32)
+    want_old = eng.score(rows[:8], gws_pre)  # old params, full roster
+    front = ContinuousBatcher(eng, max_batch=16, latency_budget_ms=1e9)
+    pre = [front.submit(rows[i], int(gws_pre[i])) for i in range(8)]
+    cache = eng._score_fn._cache_size()
+
+    # slot 2's tenant leaves: the swap closes the forming batch (admitted
+    # under the old roster — including its gateway-2 rows), then intake
+    # rejects slot 2 from the very next submit
+    left = ServingRoster(member=np.asarray([True, True, False, True]),
+                         generation=np.zeros(N, np.int64))
+    event = front.swap(roster=left)
+    assert event["kinds"] == ["roster"]
+    assert event["roster_delta"]["left"] == [2]
+    assert front.forming_rows == 0 and front.in_flight_rows == 8
+    with pytest.raises(UnknownGatewayError, match="UNKNOWN_GATEWAY"):
+        front.submit(rows[8], 2)
+    with pytest.raises(UnknownGatewayError, match="UNKNOWN_GATEWAY"):
+        front.submit_many(rows[8:12], np.asarray([0, 1, 2, 3], np.int32))
+    assert front.forming_rows == 0  # the rejected burst admitted nothing
+
+    # slot 2 recycled (generation 1) with the new tenant's checkpoint in
+    # the SAME swap: admitted again, scored under the new params
+    params2 = init_stacked_params(model, jax.random.key(7), N)
+    cens2 = fit_gateway_centroids(model, params2, train_x)
+    recycled = ServingRoster(member=np.ones(N, bool),
+                             generation=np.asarray([0, 0, 1, 0]))
+    event = front.swap(params=params2, centroids=cens2, roster=recycled)
+    assert event["roster_delta"]["recycled"] == [2]
+    post = [front.submit(rows[i], 2) for i in range(8, 16)]
+    front.drain()
+    assert eng._score_fn._cache_size() == cache  # zero retrace throughout
+    assert all(t.done for t in pre + post)
+    np.testing.assert_allclose([t.score for t in pre], want_old, atol=1e-5)
+    eng2 = ServingEngine.from_federation(model, "hybrid", params2,
+                                         train_x=train_x, max_bucket=32)
+    np.testing.assert_allclose(
+        [t.score for t in post],
+        eng2.score(rows[8:16], np.full(8, 2, np.int32)), atol=1e-5)
+    st = front.stats()
+    assert st["rows_served"] == 16  # zero drops across both swaps
+
+
+def test_direct_swap_state_roster_reaches_intake():
+    """The documented engine-level hot-swap path (`ServingEngine.
+    swap_state(roster=...)`, no ContinuousBatcher.swap) must reach the
+    continuous front's intake check: submit reads the roster LIVE, so a
+    slot retired behind the batcher's back is rejected at the very next
+    submit and a rejoined slot is admitted again."""
+    from fedmse_tpu.serving import (ContinuousBatcher, ServingRoster,
+                                    UnknownGatewayError)
+
+    _, _, _, eng, rows = _serving_setup(roster=ServingRoster.full(N))
+    front = ContinuousBatcher(eng, max_batch=16, latency_budget_ms=1e9)
+    front.submit(rows[0], 2)  # full roster admits slot 2
+    eng.swap_state(roster=ServingRoster(
+        member=np.asarray([True, True, False, True]),
+        generation=np.zeros(N, np.int64)))
+    with pytest.raises(UnknownGatewayError, match="UNKNOWN_GATEWAY"):
+        front.submit(rows[1], 2)
+    eng.swap_state(roster=ServingRoster(
+        member=np.ones(N, bool),
+        generation=np.asarray([0, 0, 1, 0])))
+    front.submit(rows[2], 2)  # rejoined: admitted again
+    front.drain()
+    assert front.stats()["rows_served"] == 2
+
+
+class _PkgLogCapture(logging.Handler):
+    """The package logger is propagate=False with its own stderr handler
+    (utils/logging.py), so pytest's caplog never sees it; attach directly
+    (the test_shard_native idiom)."""
+
+    def __init__(self):
+        super().__init__(logging.DEBUG)
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+def test_roster_swap_warns_on_recycle_without_params():
+    from fedmse_tpu.serving import ServingRoster
+
+    _, _, _, eng, _ = _serving_setup(roster=ServingRoster.full(N))
+    recycled = ServingRoster(member=np.ones(N, bool),
+                             generation=np.asarray([0, 1, 0, 0]))
+    root = logging.getLogger("fedmse_tpu")
+    handler = _PkgLogCapture()
+    root.addHandler(handler)
+    try:
+        info = eng.swap_state(roster=recycled)
+    finally:
+        root.removeHandler(handler)
+    assert info["roster_delta"]["recycled"] == [1]
+    assert any("previous tenant" in r.getMessage()
+               for r in handler.records)
+    with pytest.raises(ValueError, match="slots"):
+        eng.swap_state(roster=ServingRoster.full(N + 1))

@@ -146,7 +146,10 @@ def test_multihost_helpers_single_process():
 
     x = np.arange(16, dtype=np.float32).reshape(8, 2)
     gx = shard_clients({"x": x}, mesh)["x"]
-    assert gx.sharding.spec == P("clients", None)
+    # canonical layout carries NO trailing Nones: P('clients') is the spec
+    # jit reconstructs for its outputs, so chunked schedules reach their
+    # sharding fixed point at chunk 0 instead of retracing at chunk 1
+    assert gx.sharding.spec == P("clients")
     np.testing.assert_array_equal(np.asarray(gx), x)
 
     r = replicate(np.ones(3, np.float32), mesh)
