@@ -9,7 +9,7 @@ LIB := fedmse_tpu/native/libfedmse_io.so
 
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
         serve-bench chaos-sweep churn-sweep pipeline-bench precision-bench \
-        shard-bench knn-bench tpu-check
+        shard-bench knn-bench cohort-bench tpu-check
 
 native: $(LIB)
 
@@ -88,6 +88,15 @@ shard-bench:
 knn-bench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		python bench.py --knn-bench --out BENCH_KNN_r09_cpu.json
+
+# cohort-compacted tiered client state (federation/tiered.py, DESIGN.md
+# §16): dense vs tiered device-resident bytes + sec/round at N in
+# {10k, 100k} x C in {64, 512}, small-N bit-parity echo and prefetch-gap
+# overlap telemetry (writes BENCH_COHORT_r11_cpu.json; bench.py pins
+# hermetic CPU itself — the acceptance axis is memory residency, and the
+# H2D overlap targets the TPU DMA engines)
+cohort-bench:
+	python bench.py --cohort-bench --out BENCH_COHORT_r11_cpu.json
 
 tpu-check:
 	python tpu_check.py

@@ -32,6 +32,11 @@ repo-grown axes):
      (churn must not de-fuse or recompile the dispatch), recovery rounds
      after a 50% leave burst, membership/staleness metrics (full
      protocol: make churn-sweep -> CHURN_r10.json)
+ 14. cohort-compacted tiered client state (federation/tiered.py, DESIGN.md
+     §16): dense vs host-tiered residency on a reduced 2k-client grid —
+     device-resident bytes must scale with the cohort width (reduction
+     guard), small-N bit-parity echo, prefetch overlap telemetry (full
+     protocol: make cohort-bench -> BENCH_COHORT_r11_cpu.json)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -329,6 +334,44 @@ def scen_elastic_churn(cfg):
             "joiner_mean_gap": gap.get("mean_gap")}
 
 
+def scen_cohort(cfg):
+    """Scenario 14: cohort-compacted tiered client state (ISSUE 11,
+    federation/tiered.py) — a reduced 2k-client grid guarding the
+    residency win: tiered device bytes must stay >= 5x under the dense
+    layout's at C=256 (and >= the bar at C=64 by construction), the
+    small-N bit-parity echo must hold, and the prefetch must have been
+    issued before each harvest. The committed standalone artifact
+    (make cohort-bench -> BENCH_COHORT_r11_cpu.json) runs the
+    {10k, 100k} x {64, 512} protocol."""
+    from bench import measure_cohort
+
+    res = measure_cohort(cfg, grid=((2000, (64, 256)),), rounds=3,
+                         dense_at=(2000,))
+    rows = res["rows"]["2000"]
+    return {"scenario": "tiered cohort state: 2k clients, C in {64, 256}, "
+                        "dense vs host-tiered residency",
+            "dense_sec_per_round": rows["dense"]["sec_per_round_warm"],
+            "dense_device_bytes": rows["dense"]["device_total_bytes"],
+            "tiered_sec_per_round_C256":
+                rows["tiered_C256"]["sec_per_round_warm"],
+            "tiered_device_bytes_C256":
+                rows["tiered_C256"]["device_total_bytes"],
+            "bytes_reduction_C64":
+                rows["tiered_C64"]["device_bytes_reduction_vs_dense"],
+            "bytes_reduction_C256":
+                rows["tiered_C256"]["device_bytes_reduction_vs_dense"],
+            "bit_parity_small_n":
+                res["bit_parity_small_n"]["states_bitwise"],
+            "prefetch_overlapped":
+                rows["tiered_C256"]["prefetch"]["overlapped"],
+            # the >= 5x acceptance point is N=100k/C=512 (the committed
+            # BENCH_COHORT artifact); this reduced 2k grid guards the
+            # MECHANISM at its most demanding local point, C=64
+            "acceptance_met": bool(
+                rows["tiered_C64"]["device_bytes_reduction_vs_dense"] >= 5
+                and res["bit_parity_small_n"]["states_bitwise"])}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -351,9 +394,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-13")
-        if not 1 <= only <= 13:
-            sys.exit(f"--only expects a scenario number 1-13, got {only}")
+            sys.exit("--only expects a scenario number 1-14")
+        if not 1 <= only <= 14:
+            sys.exit(f"--only expects a scenario number 1-14, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -441,6 +484,9 @@ def main():
 
     if only in (None, 13):
         emit(scen_elastic_churn(ExperimentConfig()))
+
+    if only in (None, 14):
+        emit(scen_cohort(ExperimentConfig()))
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

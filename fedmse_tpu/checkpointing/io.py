@@ -246,11 +246,23 @@ class CheckpointManager:
 
     def restore(self, tag: str, states_like: ClientStates,
                 expected_extra: Optional[Dict] = None,
-                extra_defaults: Optional[Dict] = None):
+                extra_defaults: Optional[Dict] = None,
+                layout: str = "dense"):
         """Returns (states, host, round_index, tracking). `states_like`
         provides the pytree structure/shapes (build it with
-        init_client_states); `tracking` is the accumulated [n_real, E, 3]
+        init_client_states, or a TieredClientStore's host tree — numpy
+        leaves work); `tracking` is the accumulated [n_real, E, 3]
         loss curve up to the checkpointed round (None if not saved).
+
+        `layout='tiered'` returns HOST-OWNED numpy leaves instead of
+        device arrays — the tiered engine adopts them straight into its
+        TieredClientStore without ever materializing a dense device tree
+        (federation/tiered.py). The on-disk format is IDENTICAL either
+        way (the tier pads itself to the dense snapshot width before
+        saving), so pre-PR-11 dense snapshots restore into a tier and
+        tiered snapshots restore into a dense engine. np.array copies
+        also satisfy the anti-aliasing rule below for free: the returned
+        leaves never share memory with TensorStore's chunk cache.
 
         `expected_extra` keys are validated against the checkpoint's
         recorded `extra` BEFORE the Orbax restore: layout-changing config
@@ -281,6 +293,9 @@ class CheckpointManager:
             "states": dataclasses.asdict(states_like),
             "round_index": np.asarray(0),
         }
+        if layout not in ("dense", "tiered"):
+            raise ValueError(f"unknown restore layout {layout!r} "
+                             "(dense | tiered)")
         payload = self._ckpt.restore(self._path(tag), target)
         # The mirror of save()'s host-copy rule: TensorStore's restore can
         # alias its chunk-cache host buffers straight into the returned
@@ -288,8 +303,11 @@ class CheckpointManager:
         # engine lets the donated fused scan scribble on memory TensorStore
         # still references, so the NEXT save of this tag flushes poisoned
         # bytes to disk. jnp.copy rehomes each leaf into a fresh XLA-owned
-        # buffer (keeping its sharding) before anything can donate it.
-        payload = jax.tree.map(jnp.copy, payload)
+        # buffer (keeping its sharding) before anything can donate it; the
+        # tiered layout's np.array copies are host-owned and satisfy the
+        # same rule without the device round-trip.
+        rehome = (lambda t: np.array(t)) if layout == "tiered" else jnp.copy
+        payload = jax.tree.map(rehome, payload)
         states = ClientStates(**payload["states"])
         with open(self._path(tag) + ".host.json") as f:
             meta = json.load(f)

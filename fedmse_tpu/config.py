@@ -292,6 +292,25 @@ class ExperimentConfig:
     # while throughput tracks the offered load).
     serve_max_batch: int = 256
     serve_latency_budget_ms: float = 2.0
+    # Client-state residency layout (DESIGN.md §16; ROADMAP item 2):
+    #   'dense'  — the pre-PR-11 layout: every client's params + f32 Adam
+    #              moments device-resident as [N, ...] stacked trees; the
+    #              whole-schedule scan applies. The default, and the right
+    #              call wherever the dense state fits on device (it is the
+    #              only layout that amortizes dispatches across a chunk).
+    #   'tiered' — cohort-compacted host tiering (federation/tiered.py):
+    #              the fleet lives in host RAM (TieredClientStore), each
+    #              round gathers only the selected cohort into [C, ...]
+    #              device tensors (C ≪ N), runs the SAME fused round body
+    #              at cohort width, and scatters back — with round k+1's
+    #              cohort prefetched (async H2D) while round k computes.
+    #              Device bytes scale with the cohort, never with N — the
+    #              100k+ gateway regime's switch. Semantics: the broadcast
+    #              /verify/evaluate reach the cohort only (the
+    #              communication-realistic narrowing; non-cohort metrics
+    #              read NaN that round); at num_participants=1.0 the two
+    #              layouts are bit-identical (tests/test_tiered.py).
+    state_layout: str = "dense"
     # optax.flatten around Adam: folds the per-leaf update (12 small
     # elementwise ops per step across the param tree; the training loop
     # runs ~275 serial steps per round inside the fused program) into ONE

@@ -1,4 +1,7 @@
-from fedmse_tpu.federation.state import ClientStates, init_client_states
+from fedmse_tpu.federation.state import (ClientStates, TieredClientStore,
+                                         init_client_states)
+from fedmse_tpu.federation.tiered import (TieredRoundEngine,
+                                          run_tiered_combination)
 from fedmse_tpu.federation.elastic import (ElasticSpec, MembershipMasks,
                                            all_member_masks,
                                            make_batched_membership_masks,
@@ -29,6 +32,9 @@ __all__ = [
     "PipelineStats",
     "RoundEngine",
     "RoundResult",
+    "TieredClientStore",
+    "TieredRoundEngine",
+    "run_tiered_combination",
     "run_pipelined_batched",
     "run_pipelined_schedule",
     "elect_aggregator",

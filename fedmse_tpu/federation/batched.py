@@ -78,6 +78,13 @@ class BatchedRunEngine:
             raise ValueError(
                 "metric='time' is host-side wall-clock and cannot be traced "
                 "into the batched-runs program; use sequential mode")
+        if cfg.state_layout == "tiered":
+            # the runs axis vmaps one DENSE [N, ...] state tree per run; a
+            # host-tiered cohort gather cannot ride inside the batched scan
+            # (the driver falls back to sequential tiered runs instead)
+            raise ValueError(
+                "state_layout='tiered' is dense-layout only for batched "
+                "runs; run runs sequentially (federation/tiered.py)")
         self.model = model
         self.cfg = cfg
         self.data = data

@@ -266,6 +266,52 @@ def make_batched_membership_masks(spec: ElasticSpec, elastic_keys,
     return jax.tree.map(lambda leaf: jnp.moveaxis(leaf, 0, 1), per_run)
 
 
+def apply_membership_transitions(store, member: np.ndarray,
+                                 joined: np.ndarray,
+                                 left: np.ndarray) -> None:
+    """Apply one round's slot-pool ENTRY transitions to a host tier
+    (federation/state.TieredClientStore; DESIGN.md §16): under the tiered
+    layout joins and leaves mutate host rows directly instead of riding
+    the dense program's masked selects — the cold majority is not on
+    device to select over.
+
+    Same semantics as the dense round body's elastic block (fused.py):
+    a joining slot inherits the incumbent-mean model (uniform average of
+    every member slot that is not itself joining, f32 accumulation) with
+    Adam moments zeroed and verifier history cleared; a leaving slot has
+    its moments invalidated. Unlike the dense in-program mean — which
+    under the tiered layout would only see the round's cohort — the host
+    tier holds EVERY slot, so the incumbent mean here is the full-fleet
+    one (closer to the dense program's semantics, not bitwise: numpy and
+    XLA order the f32 reduction differently)."""
+    member = np.asarray(member) > 0
+    joined_b = np.asarray(joined) > 0
+    left_b = np.asarray(left) > 0
+    host = store.host
+    if joined_b.any():
+        incumbents = (member & ~joined_b).astype(np.float32)
+        w = incumbents / max(float(incumbents.sum()), 1.0)
+        rows = np.flatnonzero(joined_b)
+        # the joiner's model AND its prev_global are the incumbent mean of
+        # the PARAMS (fused.py sets both from mean_params)
+        for p_leaf, g_leaf in zip(jax.tree.leaves(host.params),
+                                  jax.tree.leaves(host.prev_global)):
+            mean = np.einsum("n,n...->...", w,
+                             p_leaf.astype(np.float32)).astype(p_leaf.dtype)
+            p_leaf[rows] = mean
+            g_leaf[rows] = mean
+        for leaf in jax.tree.leaves(host.hist_params):
+            leaf[rows] = 0
+        host.hist_perf[rows] = 0.0
+        host.hist_seen[rows] = False
+        host.rejected[rows] = 0
+    reset_opt = joined_b | left_b
+    if reset_opt.any():
+        rows = np.flatnonzero(reset_opt)
+        for leaf in jax.tree.leaves(host.opt_state):
+            leaf[rows] = 0
+
+
 def membership_at(masks: MembershipMasks, round_index: int,
                   n_real: Optional[int] = None):
     """Host-side (member, generation) numpy snapshot AFTER `round_index`

@@ -185,6 +185,17 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
     observable — the engine passes the explicit shard_map + psum reduction
     (parallel/collectives.py::make_shardmap_divergence) when a non-einsum
     aggregation backend is selected on a sharded mesh (DESIGN.md §12).
+
+    WIDTH-POLYMORPHISM CONTRACT (DESIGN.md §16): nothing in this body
+    depends on N being the full fleet — every shape derives from the
+    arguments' leading axis. The tiered layout (federation/tiered.py)
+    exploits this by calling the SAME body at cohort width C ≪ N: states
+    slab, data slices, selection indices, chaos/elastic columns and
+    verification tensors all arrive cohort-gathered, and the program
+    compiled for width C is byte-for-byte this one specialized to a
+    smaller axis (at C == N it IS the dense executable — the bit-parity
+    pin). Keep new round-body features width-agnostic: derive widths from
+    inputs, never from a closed-over fleet size.
     """
 
     def round_body(states: ClientStates, data, ver_x, ver_m, sel_indices,

@@ -123,6 +123,62 @@ class PipelineStats:
         }
 
 
+@dataclasses.dataclass
+class PrefetchedCohort:
+    """One round's cohort, prefetched while the previous round computes —
+    the tiered layout's prefetch SLOT beside the chunk double-buffer above
+    (DESIGN.md §16; the dispatch/harvest idiom pointed at data movement).
+
+    Built by `TieredRoundEngine._prefetch` (federation/tiered.py): the
+    host-side gather of round k+1's cohort rows (state slab + data slices
+    + verification tensors) and their H2D placement are ISSUED while round
+    k's program runs on device. Slab rows that round k is mutating are
+    stale at prefetch time; `_patch_slab` overwrites them on device from
+    round k's output before dispatch (so the prefetch never waits on the
+    in-flight round)."""
+
+    plan: Any                      # CohortPlan (federation/tiered.py)
+    slab: Any                      # ClientStates [C] device (None when the
+                                   # gather must serialize — elastic tiers)
+    data: Any                      # FederatedData at cohort width
+    ver: Any                       # (ver_x, ver_m) at cohort width
+    t_issue_start: float = 0.0     # host clock: gather+put began
+    t_issue_end: float = 0.0       # host clock: all puts enqueued
+
+
+@dataclasses.dataclass
+class TieredStats:
+    """Per-run telemetry of the tiered cohort executor — the prefetch-gap
+    numbers the cohort bench persists (BENCH_COHORT acceptance: H2D
+    prefetch overlap demonstrated)."""
+
+    rounds: int = 0
+    prefetch_issue_s: List[float] = dataclasses.field(default_factory=list)
+    prefetch_wait_s: List[float] = dataclasses.field(default_factory=list)
+    overlapped_issue: List[bool] = dataclasses.field(default_factory=list)
+
+    def summary(self) -> dict:
+        waits = self.prefetch_wait_s
+        return {
+            "rounds": self.rounds,
+            "prefetch_issue_s": [round(g, 5) for g in self.prefetch_issue_s],
+            # the PREFETCH GAP: host time the next dispatch spent blocked on
+            # the prefetched slab/data still being in flight (H2D not yet
+            # landed). ~0 everywhere = the transfers fully overlapped the
+            # previous round's compute.
+            "prefetch_gap_s": [round(g, 5) for g in waits],
+            "prefetch_gap_mean_s": (round(float(np.mean(waits)), 5)
+                                    if waits else None),
+            # HOST-side issue ordering: every prefetch was enqueued before
+            # the previous round's harvest completed (the structural overlap
+            # guard, same contract as PipelineStats.overlapped — it cannot
+            # see a backend that went synchronous; that shows up in the
+            # dense-vs-tiered sec/round comparison instead)
+            "overlapped": bool(self.overlapped_issue) and
+            all(self.overlapped_issue),
+        }
+
+
 def run_pipelined_schedule(engine, start_round: int, num_rounds: int,
                            chunk_size: int,
                            consume: Callable[[list, float], Optional[int]],

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -177,8 +177,8 @@ def verification_tensors(cfg: ExperimentConfig, data: FederatedData,
 
 def absorb_fused_out(out, round_index: int, selected: List[int], n_real: int,
                      host: HostState, max_rejected_updates: int,
-                     chaos: bool = False, elastic: bool = False
-                     ) -> RoundResult:
+                     chaos: bool = False, elastic: bool = False,
+                     row_ids: Optional[Sequence[int]] = None) -> RoundResult:
     """Host bookkeeping + RoundResult from ONE host-fetched FusedRoundOut
     bundle: quota/vote counters, reference verification rows, attack
     flagging. Shared by the per-run fused path (RoundEngine._fused_result)
@@ -192,7 +192,16 @@ def absorb_fused_out(out, round_index: int, selected: List[int], n_real: int,
     unmeasured baseline for a perfectly converged one). `elastic` does the
     same for the membership observables: `members`/`generations` surface
     only from an elastic program (the static program's placeholders are
-    not a measured roster)."""
+    not a measured roster).
+
+    `row_ids` restricts the reference verification rows to those clients
+    (ascending; default every real client — the dense program's
+    broadcast-to-ALL semantics). The tiered layout passes its cohort:
+    only cohort clients verified this round, and at 100k+ gateways the
+    dense per-client Python row loop would itself be a host hot-path
+    cost (~100k dict builds per aggregated round). At C == N the cohort
+    IS range(n_real), so the dense artifact is unchanged there (the
+    bit-parity pin)."""
     aggregator = int(out.aggregator)
     rejected = np.asarray(out.rejected)
     verification_rows: List[Dict] = []
@@ -200,7 +209,8 @@ def absorb_fused_out(out, round_index: int, selected: List[int], n_real: int,
         host.aggregation_count[aggregator] += 1
         host.votes_received[aggregator] += 1
         host.rounds_aggregated.append((round_index, aggregator))
-        for i in range(n_real):
+        for i in (range(n_real) if row_ids is None else row_ids):
+            i = int(i)
             if i != aggregator:
                 verification_rows.append({
                     "client_id": i,
@@ -276,6 +286,18 @@ class RoundEngine:
         # backends have their mesh without waiting for a data swap
         self.mesh = mesh
 
+        if cfg.state_layout not in ("dense", "tiered"):
+            raise ValueError(f"unknown state_layout {cfg.state_layout!r} "
+                             "(dense | tiered)")
+        if cfg.state_layout == "tiered":
+            # this engine IS the dense layout — the cohort-compacted tier
+            # runs through federation/tiered.TieredRoundEngine (the driver
+            # dispatches on cfg.state_layout; --state-layout tiered)
+            raise ValueError(
+                "RoundEngine holds dense [N, ...] device state; "
+                "state_layout='tiered' runs through "
+                "federation.tiered.TieredRoundEngine (main.py "
+                "run_combination dispatches automatically)")
         if cfg.metric == "time" and fused:
             # latency is a host-side wall-clock measurement; it cannot run
             # inside the fused single-dispatch round program. The per-phase

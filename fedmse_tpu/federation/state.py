@@ -173,6 +173,158 @@ def init_batched_client_states(model, tx: optax.GradientTransformation,
     )
 
 
+class TieredClientStore:
+    """Host-tiered client state: the cold majority of the federation lives
+    in host RAM, and only the round's active cohort is ever device-resident
+    (DESIGN.md §16; the weight-update-sharding insight of arxiv 2004.13336
+    carried across a host/device tier).
+
+    The dense layout keeps `[N, ...]` params AND f32 Adam moments resident
+    in device memory for every client, every round — at 100k+ gateways the
+    optimizer tree alone is the wall (ROADMAP item 2), even though a round
+    only touches the selected cohort. Here the full `[N, ...]` tree exists
+    only as host numpy (`self.host`), and the round program runs at cohort
+    width: `gather(ids)` materializes a `[C, ...]` device slab for the
+    cohort, the fused round body executes on it unchanged (it is
+    width-polymorphic — federation/tiered.py), and `scatter(ids, slab)`
+    writes the results back into the tier.
+
+    Contracts:
+      * rows are keyed by ABSOLUTE client id (PARITY.md §8): the gather
+        indices come from the host selection over real clients, so padding
+        or mesh size can never re-tenant a cohort row;
+      * `create` initializes the tier in bounded device chunks with the
+        same `fold_in(rng, absolute_index)` keys as the dense
+        `init_client_states`, so row i of the tier is bitwise row i of the
+        dense init — a 100k-client init never materializes a dense
+        `[N, ...]` device tree (params or moments);
+      * negative ids gather as zero rows (the cohort slab's pad lanes,
+        carrying client_mask 0 everywhere downstream).
+    """
+
+    def __init__(self, host: ClientStates, n_clients: int):
+        self.host = host          # numpy leaves [N, ...]
+        self.n_clients = n_clients
+
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def create(model, tx: optax.GradientTransformation, rng: jax.Array,
+               n_clients: int, init_chunk: int = 4096) -> "TieredClientStore":
+        """Initialize N clients straight into the host tier, `init_chunk`
+        clients per device dispatch. Draws are `fold_in(rng, i)` per
+        ABSOLUTE index i — identical to `init_stacked_params`, so the tier
+        is bitwise the dense init without ever holding it on device."""
+        from fedmse_tpu.models.autoencoder import init_client_params
+
+        def chunk_init(idx: jax.Array) -> ClientStates:
+            keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(idx)
+            params = jax.vmap(lambda r: init_client_params(model, r))(keys)
+            opt_state = jax.vmap(tx.init)(params)
+            c = idx.shape[0]
+            return ClientStates(
+                params=params, opt_state=opt_state,
+                prev_global=jax.tree.map(lambda t: t.copy(), params),
+                hist_params=jax.tree.map(jnp.zeros_like, params),
+                hist_perf=jnp.zeros((c,), jnp.float32),
+                hist_seen=jnp.zeros((c,), bool),
+                rejected=jnp.zeros((c,), jnp.int32))
+
+        chunk_init = jax.jit(chunk_init)
+        chunk = min(init_chunk, n_clients)
+        shapes = jax.eval_shape(chunk_init,
+                                jax.ShapeDtypeStruct((chunk,), jnp.int32))
+        host = jax.tree.map(
+            lambda s: np.zeros((n_clients,) + s.shape[1:], s.dtype), shapes)
+        host_leaves = jax.tree.leaves(host)
+        for start in range(0, n_clients, chunk):
+            stop = min(start + chunk, n_clients)
+            # fixed-width dispatch (one executable): the tail chunk pads
+            # with repeated ids and drops the surplus rows on the host side
+            idx = np.arange(start, start + chunk, dtype=np.int32)
+            idx[stop - start:] = start
+            slab = jax.device_get(chunk_init(jnp.asarray(idx)))
+            for h, s in zip(host_leaves, jax.tree.leaves(slab)):
+                h[start:stop] = s[: stop - start]
+        return TieredClientStore(host, n_clients)
+
+    @staticmethod
+    def from_dense(states: ClientStates) -> "TieredClientStore":
+        """Adopt a dense (device or host) `[N, ...]` tree into the tier —
+        the pre-PR-11 checkpoint-restore path: a dense snapshot's rows ARE
+        the tier's rows."""
+        host = jax.tree.map(lambda t: np.array(t), states)
+        n = host.hist_perf.shape[0]
+        return TieredClientStore(host, n)
+
+    # ------------------------------------------------------------------ #
+
+    def gather(self, ids: np.ndarray, place=None) -> ClientStates:
+        """Device `[C, ...]` slab for cohort `ids` (absolute client ids;
+        entries < 0 gather as zero pad rows). `place` maps a host leaf to
+        its device placement (default: a device-OWNED copy; pass
+        `parallel.mesh.place_cohort`'s leaf fn to shard the slab over the
+        client mesh axis).
+
+        The slab MUST own its device buffers (`copy=True`, never
+        `jnp.asarray`): host-sourced placements can zero-copy-alias
+        numpy memory on the CPU backend, and any consumer that donates
+        such a buffer invites the use-after-free documented in
+        federation/tiered.py (the tiered round program therefore does
+        not donate at all; `place_cohort` applies the same owned-copy
+        rule)."""
+        return jax.tree.map(
+            lambda leaf: gather_rows(leaf, ids, place), self.host)
+
+    def scatter(self, ids: np.ndarray, slab: ClientStates) -> None:
+        """Write a round's output slab back into the tier (pad lanes are
+        dropped). Blocks on the slab's device→host copies — callers start
+        them early with `copy_to_host_async` so the scatter lands on
+        already-transferred bytes."""
+        ids = np.asarray(ids)
+        real = ids >= 0
+        rows = ids[real]
+        for h, s in zip(jax.tree.leaves(self.host),
+                        jax.tree.leaves(jax.device_get(slab))):
+            h[rows] = s[real]
+
+    # ------------------------------------------------------------------ #
+
+    def host_bytes(self) -> int:
+        return int(sum(l.nbytes for l in jax.tree.leaves(self.host)))
+
+    def slab_bytes(self, cohort: int) -> int:
+        """Device-resident bytes of one `[C, ...]` cohort slab — the
+        state's contribution to the memory-accounting acceptance (device
+        bytes scale with C, not N)."""
+        per_client = sum(
+            l.nbytes // max(1, l.shape[0]) for l in jax.tree.leaves(self.host))
+        return int(cohort * per_client)
+
+
+def gather_rows(leaf: np.ndarray, ids: np.ndarray, place=None):
+    """The ONE home of the padded cohort-row gather invariant
+    (federation/tiered.py state/data/verification slices all route
+    through here): absolute ids select host rows, negative ids produce
+    zeroed pad lanes, and the default placement is a device-OWNED copy
+    (see TieredClientStore.gather for why `jnp.asarray` is forbidden)."""
+    ids = np.asarray(ids)
+    rows = np.maximum(ids, 0)
+    pad = ids < 0
+    sub = leaf[rows]
+    if pad.any():
+        sub[pad] = 0
+    return (place or (lambda a: jnp.array(a, copy=True)))(sub)
+
+
+def dense_state_bytes(states_shape) -> int:
+    """Bytes of a dense ClientStates tree from its eval_shape (the
+    never-materialized comparison point of the cohort bench)."""
+    return int(sum(
+        int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+        for l in jax.tree.leaves(states_shape)))
+
+
 def tree_select(cond: jax.Array, a, b):
     """Elementwise pytree select on a scalar (or broadcastable) condition."""
     return jax.tree.map(lambda x, y: jnp.where(cond, x, y), a, b)

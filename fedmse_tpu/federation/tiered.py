@@ -1,0 +1,785 @@
+"""Cohort-compacted, host-tiered federation rounds: break the dense-axis
+ceiling at 100k+ gateways (DESIGN.md §16; ROADMAP item 2).
+
+Since PR 6 the stack trains 10k clients sharded, but client state is still
+dense `[N, ...]` resident in device memory — params AND f32 Adam moments
+for every client, every round — even though a round only ever touches the
+selected cohort. At 100k–1M gateways that layout is the wall. This module
+is the weight-update-sharding insight of arxiv 2004.13336 (keep only what
+the step needs on device, gather the rest on demand) carried across a
+host/device tier, with the PR 4 dispatch/harvest idiom pointed at data
+movement instead of bookkeeping:
+
+  * the full federation lives in HOST RAM (`state.TieredClientStore`:
+    numpy rows keyed by absolute client id);
+  * each round, the selected cohort is gathered into `[C, ...]` device
+    tensors (C = the selection size ≪ N) — state slab, data slices,
+    verification tensors — and the EXISTING fused round body runs on them
+    unchanged: `make_round_body` is width-polymorphic, so training,
+    voting, aggregation, verification, attack injection, chaos masks and
+    elastic membership all execute at cohort width with zero new device
+    code;
+  * results scatter back into the tier, and round k+1's cohort is
+    prefetched (host gather + async H2D) WHILE round k computes — rows
+    both rounds touch are patched on device from round k's output, so the
+    prefetch never waits on the in-flight round
+    (pipeline.PrefetchedCohort; prefetch-gap telemetry in TieredStats).
+
+Semantics vs the dense program (`state_layout="dense"`), by design:
+
+  * train / vote / merge / verify are cohort-only in BOTH layouts (the
+    dense program masks the rest away) — identical math;
+  * the dense program broadcasts the aggregated model to ALL N clients
+    (reference quirk 4) and evaluates ALL N each round. The tiered
+    program broadcasts/verifies/evaluates the COHORT only — the
+    communication-realistic semantics (pushing a model to 100k gateways
+    per round is exactly what does not scale); a non-cohort client's
+    round metric reads NaN ("not measured this round"), which every
+    consumer is already nan-aware for (the PR 10 elastic idiom);
+  * when the cohort covers the fleet (num_participants=1.0, C == N) the
+    two layouts are BIT-IDENTICAL — same jitted executable (shared via
+    the rounds.py program cache), same inputs — pinned by
+    tests/test_tiered.py over states, metrics and artifacts;
+  * the tiered layout runs one dispatch per ROUND (the gather/scatter is
+    host-mediated), not one per chunk — at small N where the whole dense
+    state fits comfortably on device, the dense scanned schedule stays
+    faster. Dense remains the default; `--state-layout tiered` is the
+    100k+ regime's switch (DESIGN.md §16 "when dense still wins").
+
+Padding-invariance (PARITY.md §8): cohort gather/scatter indices are
+ABSOLUTE client ids drawn from the host selection over the n_real axis —
+the tier has no pad rows at all, and the cohort slab's own pad lanes
+(mesh-divisibility only) carry id -1 / mask 0. Mesh size therefore can
+never re-tenant a cohort row (pinned by tests/test_tiered.py alongside
+the fold_in init pins).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedmse_tpu.chaos.masks import ChaosMasks, make_chaos_masks
+from fedmse_tpu.config import ExperimentConfig
+from fedmse_tpu.data.stacking import FederatedData
+from fedmse_tpu.federation.elastic import (MembershipMasks,
+                                           apply_membership_transitions,
+                                           make_membership_masks)
+from fedmse_tpu.federation.fused import FusedRoundOut
+from fedmse_tpu.federation.pipeline import PrefetchedCohort, TieredStats
+from fedmse_tpu.federation.rounds import (RoundResult, _PROGRAM_CACHE,
+                                          _cache_put, _engine_programs,
+                                          absorb_fused_out,
+                                          split_metric_columns)
+from fedmse_tpu.federation.state import (ClientStates, HostState,
+                                         TieredClientStore, gather_rows)
+from fedmse_tpu.parallel.mesh import (host_fetch_async, pad_to_multiple,
+                                      place_cohort)
+from fedmse_tpu.utils.logging import get_logger
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+logger = get_logger(__name__)
+
+# per-client FederatedData leaves a cohort gathers (dev_x is shared and
+# stays replicated; client_mask is rebuilt from the plan)
+_COHORT_DATA_FIELDS = ("train_xb", "train_mb", "valid_xb", "valid_mb",
+                       "valid_x", "valid_m", "test_x", "test_m", "test_y")
+
+
+@dataclasses.dataclass
+class CohortPlan:
+    """One round's host-side cohort plan. `ids` are SORTED absolute client
+    ids padded with -1 to the fixed cohort width (sorted so that the
+    C == N cohort is the identity layout — the bit-parity pin's
+    construction); `sel_pos` maps the selection ORDER onto cohort
+    positions, preserving first-voter-wins election order."""
+
+    round_index: int
+    selected: List[int]      # host-drawn selection (absolute, sel order)
+    ids: np.ndarray          # [C] sorted absolute ids, -1 pad tail
+    sel_pos: np.ndarray      # [S] cohort positions in selection order
+    mask: np.ndarray         # [C] f32 1 = real cohort row
+    key: jax.Array           # the round's PRNG key (host stream order
+                             # identical to the dense per-round path)
+
+
+@jax.jit
+def _patch_slab(prefetched: ClientStates, fresh: ClientStates,
+                src_pos: jax.Array, take: jax.Array) -> ClientStates:
+    """Overwrite the prefetched slab's stale rows from the in-flight
+    round's output slab: row j takes `fresh[src_pos[j]]` where `take[j]`
+    (j's client was in the previous cohort), else keeps the prefetched
+    host row. Fixed shapes — one executable for the whole schedule."""
+    def sel(p, f):
+        rows = jnp.take(f, src_pos, axis=0)
+        m = take.reshape((-1,) + (1,) * (p.ndim - 1))
+        return jnp.where(m, rows, p)
+    return jax.tree.map(sel, prefetched, fresh)
+
+
+class TieredRoundEngine:
+    """One (model_type, update_type) federation over the host tier.
+
+    Mirrors RoundEngine's bookkeeping surface (host counters, RoundResult
+    stream, chaos/elastic/attack support) but replaces the dense device
+    state with a TieredClientStore + per-round cohort gather/scatter and
+    double-buffered prefetch. Device-resident bytes scale with the cohort
+    width C, never with N (tests/test_tiered.py memory accounting)."""
+
+    def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
+                 n_real: int, rngs: ExperimentRngs, model_type: str,
+                 update_type: str, poison_fn=None, chaos=None, elastic=None,
+                 mesh=None, init_chunk: int = 4096):
+        if cfg.metric == "time":
+            raise ValueError("metric='time' is host-side wall-clock and "
+                             "cannot run inside the fused cohort program")
+        self.model = model
+        self.cfg = cfg
+        self.n_real = n_real
+        self.rngs = rngs
+        self.model_type = model_type
+        self.update_type = update_type
+        self.poison_fn = poison_fn
+        self.chaos = chaos
+        self.elastic = elastic
+        self.mesh = mesh
+        if cfg.aggregation_backend != "einsum":
+            # the explicit collectives are written against the full dense
+            # client axis; the cohort merge is a [C]-wide einsum that jit
+            # auto-partitions over the slab sharding when a mesh is set
+            logger.debug("state_layout=tiered uses the einsum merge; "
+                         "aggregation_backend=%s is inert here",
+                         cfg.aggregation_backend)
+
+        programs = _engine_programs(model, cfg, model_type, update_type)
+        self.tx = programs["tx"]
+        self._programs = programs
+        self.evaluate_all = programs["evaluate_all"]
+
+        # ---- host tier: data + state, keyed by absolute client id ----
+        # (the incoming FederatedData may be device arrays — small-N driver
+        # path — or host numpy; either way the tier owns host copies and
+        # only cohort slices ever go back to device)
+        self.host_data = FederatedData(**{
+            f.name: (getattr(data, f.name) if f.name == "dev_x"
+                     else np.asarray(jax.device_get(getattr(data, f.name)))
+                     [:n_real])
+            for f in dataclasses.fields(FederatedData)})
+        self._dev_x = jnp.asarray(data.dev_x)
+        self.store = TieredClientStore.create(
+            model, self.tx, rngs.next_jax(), n_real, init_chunk=init_chunk)
+        self.host = HostState.create(n_real)
+
+        # ---- fixed cohort width: the selection size, padded to the mesh ----
+        n_sel = max(1, int(cfg.num_participants * n_real))
+        self.cohort = (pad_to_multiple(n_sel, mesh.devices.size)
+                       if mesh is not None else n_sel)
+        self._place = place_cohort(mesh, self.cohort,
+                                   cfg.client_axis_name)
+        # constant-across-rounds verification tensors (dev / quirk-6 modes
+        # broadcast ONE tensor to every cohort lane) are built once
+        self._const_ver = self._constant_ver()
+
+        # ---- fault / membership timelines at n_real width (host numpy) ----
+        self._chaos_np = None
+        if chaos is not None:
+            self._chaos_np = jax.device_get(make_chaos_masks(
+                chaos, rngs.chaos_key(), 0, cfg.num_rounds, n_real))
+        self._elastic_np = None
+        if elastic is not None:
+            self._elastic_np = jax.device_get(make_membership_masks(
+                elastic, rngs.elastic_key(), cfg.num_rounds, n_real))
+        # membership transitions mutate HOST rows at round entry, so the
+        # state gather cannot run ahead of the previous round's scatter —
+        # elastic tiers keep the data prefetch but serialize the slab
+        self._sync_gather = elastic is not None
+
+        self._fused_round = None
+        self.stats = TieredStats()
+
+    # ------------------------------------------------------------------ #
+
+    def _build_fused(self):
+        """The cohort round program — the SAME `make_round_body` the dense
+        engine scans, jitted WITHOUT buffer donation.
+
+        No-donation is a correctness rule here, not a tuning choice: the
+        slab arrives through host-sourced placements (numpy gathers,
+        device_put resharding), and on the CPU backend those can
+        zero-copy-alias memory the jax.Array does not own. Donating such
+        a buffer lets XLA alias the round's OUTPUT into memory that is
+        freed when the gather's temporaries die — an alignment- and
+        allocator-state-dependent use-after-free that corrupted patched
+        rows under suite-order heap churn (found the hard way). Without
+        donation every input is read-only (the universally safe path for
+        aliased buffers) and the output slab always owns fresh XLA
+        buffers, which also makes it safe to keep as the next round's
+        patch source. Cost: one extra [C]-slab allocation per round —
+        O(cohort), the same order as the prefetch buffers."""
+        args = (self._programs["train_all"], self._programs["scores_fn"],
+                self._programs["aggregate"], self._programs["verify"],
+                self._programs["evaluate_all"],
+                self.cfg.max_aggregation_threshold, False, self.poison_fn)
+        with_chaos = self.chaos is not None
+        with_elastic = self.elastic is not None
+        key = ("tiered_fused",) + args[:-1] + (with_chaos, with_elastic)
+        if self.poison_fn is None and key in _PROGRAM_CACHE:
+            self._fused_round = _PROGRAM_CACHE[key]
+            return
+        from fedmse_tpu.federation.fused import make_round_body
+        fused = jax.jit(make_round_body(*args, chaos=with_chaos,
+                                        elastic=with_elastic))
+        if self.poison_fn is None:
+            _cache_put(key, fused)
+        self._fused_round = fused
+
+    def _constant_ver(self):
+        """Cohort verification tensors for the round-invariant modes
+        (verification_method='dev', or quirk-6 shared_last_client_val:
+        every lane verifies on ONE shared tensor); None for per-client
+        'val' mode, which gathers rows per cohort."""
+        cfg, c = self.cfg, self.cohort
+        if cfg.verification_method == "dev":
+            ver_x = np.broadcast_to(np.asarray(self.host_data.dev_x),
+                                    (c,) + self.host_data.dev_x.shape)
+            ver_m = np.ones((c, ver_x.shape[1]), np.float32)
+        elif cfg.compat.shared_last_client_val:
+            last_x = self.host_data.valid_x[self.n_real - 1]
+            last_m = self.host_data.valid_m[self.n_real - 1]
+            ver_x = np.broadcast_to(last_x, (c,) + last_x.shape)
+            ver_m = np.broadcast_to(last_m, (c,) + last_m.shape)
+        else:
+            return None
+        return (self._place(np.ascontiguousarray(ver_x)),
+                self._place(np.ascontiguousarray(ver_m)))
+
+    # ------------------------------------------------------------------ #
+
+    def select_clients(self) -> List[int]:
+        """Identical draw (same host stream, same order) as the dense
+        engine's (src/main.py:270-273)."""
+        n_sel = max(1, int(self.cfg.num_participants * self.n_real))
+        return self.rngs.select_rng.sample(range(self.n_real), n_sel)
+
+    def _plan(self, round_index: int,
+              selected: Optional[List[int]] = None,
+              key: Optional[jax.Array] = None) -> CohortPlan:
+        if selected is None:
+            selected = self.select_clients()
+        if key is None:
+            key = self.rngs.next_jax()
+        ids = np.full(self.cohort, -1, np.int32)
+        srt = np.sort(np.asarray(selected, np.int32))
+        ids[: len(srt)] = srt
+        sel_pos = np.searchsorted(srt, np.asarray(selected, np.int32)
+                                  ).astype(np.int32)
+        mask = (ids >= 0).astype(np.float32)
+        return CohortPlan(round_index=round_index, selected=list(selected),
+                          ids=ids, sel_pos=sel_pos, mask=mask, key=key)
+
+    def _gather_data(self, plan: CohortPlan) -> FederatedData:
+        kw = {name: gather_rows(getattr(self.host_data, name), plan.ids,
+                                self._place)
+              for name in _COHORT_DATA_FIELDS}
+        return FederatedData(dev_x=self._dev_x,
+                             client_mask=self._place(plan.mask), **kw)
+
+    def _gather_ver(self, plan: CohortPlan):
+        if self._const_ver is not None:
+            return self._const_ver
+        return (gather_rows(self.host_data.valid_x, plan.ids, self._place),
+                gather_rows(self.host_data.valid_m, plan.ids, self._place))
+
+    def _prefetch(self, plan: CohortPlan) -> PrefetchedCohort:
+        """Issue round `plan.round_index`'s cohort gather + H2D NOW (while
+        the previous round computes). The slab's rows are the tier's
+        CURRENT values — rows the in-flight round is mutating get patched
+        on device at dispatch (`_patch_slab`)."""
+        t0 = time.time()
+        slab = (None if self._sync_gather
+                else self.store.gather(plan.ids, place=self._place))
+        data = self._gather_data(plan)
+        ver = self._gather_ver(plan)
+        return PrefetchedCohort(plan=plan, slab=slab, data=data, ver=ver,
+                                t_issue_start=t0, t_issue_end=time.time())
+
+    def _mask_kwargs(self, plan: CohortPlan) -> dict:
+        """Per-round chaos/elastic tensors at cohort width: columns of the
+        precomputed [T, n_real] timelines gathered at the cohort's ABSOLUTE
+        ids.
+
+        The ELASTIC timeline is fold_in-per-slot (PARITY.md §8), so the
+        gather preserves each slot's stream exactly and it matches the
+        dense program's at any padding. The CHAOS masks are SHAPED
+        bernoulli draws over their width (a PR 3 vintage predating the
+        §8 rule): this engine draws them at n_real — padding-invariant
+        for tiered runs by construction — which matches the dense
+        program only when the dense run is unpadded (n_pad == n_real).
+        A dense run that pads its client axis draws a DIFFERENT chaos
+        stream for the same seed, dense-vs-dense across paddings
+        included; making make_chaos_masks fold_in-per-client like the
+        elastic draws is the standing fix (ROADMAP)."""
+        t = plan.round_index
+        rows = np.maximum(plan.ids, 0)
+        pad = plan.ids < 0
+        kw = {}
+        if self._chaos_np is not None:
+            av = self._chaos_np.available[t][rows].copy()
+            st = self._chaos_np.straggler[t][rows].copy()
+            bd = self._chaos_np.bcast_drop[t][rows].copy()
+            av[pad], st[pad], bd[pad] = 1.0, 0.0, 0.0  # pad lanes inert
+            kw["chaos_in"] = ChaosMasks(
+                available=jnp.asarray(av), straggler=jnp.asarray(st),
+                crash=jnp.asarray(self._chaos_np.crash[t]),
+                bcast_drop=jnp.asarray(bd))
+        if self._elastic_np is not None:
+            member = self._elastic_np.member[t][rows].copy()
+            member[pad] = 0.0
+            gen = self._elastic_np.generation[t][rows].copy()
+            gen[pad] = 0
+            # joins/leaves were already applied to the HOST tier at round
+            # entry (elastic.apply_membership_transitions); the in-program
+            # entry transitions must be the identity or they would apply
+            # twice — member still gates cohort/broadcast/metrics
+            zeros = np.zeros(self.cohort, np.float32)
+            kw["elastic_in"] = MembershipMasks(
+                member=jnp.asarray(member), joined=jnp.asarray(zeros),
+                left=jnp.asarray(zeros), generation=jnp.asarray(gen))
+        return kw
+
+    # ------------------------------------------------------------------ #
+
+    def _absorb(self, out, plan: CohortPlan) -> RoundResult:
+        """Scatter the cohort-width output bundle to fleet width and run
+        the SHARED host bookkeeping (rounds.absorb_fused_out) on it — the
+        tiered RoundResult is then constructed by the exact dense code
+        path (the C == N parity pin's bookkeeping half)."""
+        n = self.n_real
+        ids = plan.ids
+        real = ids >= 0
+        rows = ids[real]
+
+        def scatter(vals, fill, extra_shape=()):
+            full = np.full((n,) + extra_shape, fill, np.float32)
+            full[rows] = np.asarray(vals)[real]
+            return full
+
+        agg_c = int(out.aggregator)
+        crashed_c = int(out.crashed)
+        metrics_c = np.asarray(out.metrics)
+        metrics = (scatter(metrics_c, np.nan, metrics_c.shape[1:])
+                   if metrics_c.ndim > 1 else scatter(metrics_c, np.nan))
+        if self._elastic_np is not None:
+            member_full = self._elastic_np.member[plan.round_index][:n]
+            gen_full = self._elastic_np.generation[plan.round_index][:n]
+        else:
+            member_full = np.ones(n, np.float32)
+            gen_full = np.zeros(n, np.int32)
+        full = FusedRoundOut(
+            aggregator=np.int32(ids[agg_c] if agg_c >= 0 else -1),
+            metrics=metrics,
+            scores=scatter(out.scores, np.nan),
+            weights=scatter(out.weights, 0.0),
+            # the tier holds every client's CURRENT rejected counter (the
+            # scatter below already landed this round's cohort updates)
+            rejected=self.store.host.rejected[:n],
+            min_valid=scatter(out.min_valid, np.nan),
+            tracking=scatter(out.tracking, np.nan,
+                             np.asarray(out.tracking).shape[1:]),
+            eff_mask=scatter(out.eff_mask, 0.0),
+            crashed=np.int32(ids[crashed_c] if crashed_c >= 0 else -1),
+            divergence=scatter(out.divergence, 0.0),
+            member=member_full, generation=gen_full)
+        # verification rows restricted to the cohort: only cohort clients
+        # verified this round, and the dense all-clients Python row loop
+        # is itself a 100k-scale host cost (absorb_fused_out docstring);
+        # C == N degenerates to the dense range(n_real)
+        return absorb_fused_out(full, plan.round_index, plan.selected, n,
+                                self.host, self.cfg.max_rejected_updates,
+                                chaos=self.chaos is not None,
+                                elastic=self.elastic is not None,
+                                row_ids=rows)
+
+    def _dispatch(self, pf: PrefetchedCohort, slab: ClientStates):
+        plan = pf.plan
+        agg = np.zeros(self.cohort, np.int32)
+        real = plan.ids >= 0
+        agg[real] = self.host.aggregation_count[plan.ids[real]]
+        ver_x, ver_m = pf.ver
+        return self._fused_round(
+            slab, pf.data, ver_x, ver_m, jnp.asarray(plan.sel_pos),
+            self._place(plan.mask), jnp.asarray(agg), plan.key,
+            jnp.asarray(plan.round_index, jnp.int32),
+            **self._mask_kwargs(plan))
+
+    def run_round(self, round_index: int,
+                  selected: Optional[List[int]] = None,
+                  key: Optional[jax.Array] = None) -> RoundResult:
+        """One tiered round, no prefetch overlap (the serial oracle the
+        prefetched loop is pinned against; also the replay entry point)."""
+        if self._fused_round is None:
+            self._build_fused()
+        plan = self._plan(round_index, selected, key)
+        self._entry_transitions(round_index)
+        pf = self._prefetch(plan)
+        slab = pf.slab if pf.slab is not None else \
+            self.store.gather(plan.ids, place=self._place)
+        new_slab, _, out = self._dispatch(pf, slab)
+        out = jax.device_get(out)
+        self.store.scatter(plan.ids, new_slab)
+        return self._absorb(out, plan)
+
+    def _entry_transitions(self, round_index: int) -> None:
+        if self._elastic_np is None:
+            return
+        apply_membership_transitions(
+            self.store,
+            self._elastic_np.member[round_index][: self.n_real],
+            self._elastic_np.joined[round_index][: self.n_real],
+            self._elastic_np.left[round_index][: self.n_real])
+
+    def run_rounds(self, start_round: int, num_rounds: int,
+                   consume) -> TieredStats:
+        """The double-buffered cohort loop: dispatch round k, ISSUE round
+        k+1's cohort prefetch while k computes, harvest + scatter +
+        bookkeep k, patch k+1's slab from k's output, repeat.
+
+        `consume(result, sec)` absorbs one RoundResult (logging, writer
+        IO, early-stop evaluation) and returns True to stop — per-round
+        granularity, so stopping needs no rewind: the speculative
+        prefetch is simply dropped (its selection/key draws advanced the
+        host streams one round past the stop, which nothing observes —
+        the same contract as the pipelined chunk executor's)."""
+        if self._fused_round is None:
+            self._build_fused()
+        stats = self.stats
+        end = start_round + num_rounds
+        if num_rounds <= 0:
+            return stats
+        self._entry_transitions(start_round)
+        pf = self._prefetch(self._plan(start_round))
+        prev_slab = None    # previous round's OUTPUT slab (device)
+        prev_plan = None
+        k = start_round
+        while k < end:
+            plan = pf.plan
+            # wait-for-prefetch telemetry: ~0 when the H2D overlapped the
+            # previous round's compute (the acceptance's prefetch gap)
+            t0 = time.time()
+            if pf.slab is not None:
+                slab = pf.slab
+                if prev_slab is not None:
+                    # rows the previous round mutated are stale in the
+                    # prefetched slab — patch them from its output (the
+                    # sorted REAL prefix of the previous cohort; pad lanes
+                    # sit behind it and match nothing)
+                    s = len(prev_plan.selected)
+                    base = prev_plan.ids[:s]
+                    src = np.searchsorted(base, plan.ids).clip(
+                        0, s - 1).astype(np.int32)
+                    take = (base[src] == plan.ids) & (plan.ids >= 0)
+                    src = np.where(take, src, 0).astype(np.int32)
+                    slab = _patch_slab(slab, prev_slab, jnp.asarray(src),
+                                       jnp.asarray(take))
+                    # the previous round's output has served its last
+                    # purpose (its rows live on in the patch and in the
+                    # host tier) — release it NOW so the steady state
+                    # holds exactly the THREE slabs cohort_bytes accounts
+                    # for (patched input + this round's output + the next
+                    # prefetch), not four
+                    prev_slab = None
+                jax.block_until_ready(slab)
+            else:  # elastic: transitions already applied; gather serialized
+                slab = self.store.gather(plan.ids, place=self._place)
+            stats.prefetch_wait_s.append(time.time() - t0)
+
+            new_slab, _, out = self._dispatch(pf, slab)
+            harvest = host_fetch_async(out)
+            for leaf in jax.tree.leaves(new_slab):
+                copy = getattr(leaf, "copy_to_host_async", None)
+                if copy is not None:
+                    copy()
+            # ---- overlap window: issue round k+1's prefetch while the
+            # device executes round k ----
+            next_pf = None
+            if k + 1 < end:
+                next_pf = self._prefetch(self._plan(k + 1))
+                stats.prefetch_issue_s.append(
+                    next_pf.t_issue_end - next_pf.t_issue_start)
+            out = harvest()
+            t_harvest_done = time.time()
+            if next_pf is not None:
+                # structural order guard (the PipelineStats contract): the
+                # prefetch must have been fully ISSUED before the in-flight
+                # round's harvest completed — a refactor that serializes
+                # (harvest-then-prefetch) flips this False; an actually-
+                # blocking H2D shows up in prefetch_gap_s, not here
+                stats.overlapped_issue.append(
+                    next_pf.t_issue_end <= t_harvest_done)
+            self.store.scatter(plan.ids, new_slab)
+            result = self._absorb(out, plan)
+            stats.rounds += 1
+            sec = time.time() - t0
+            if consume(result, sec):
+                break
+            if next_pf is None:
+                break
+            # elastic entry transitions for k+1 run AFTER k's scatter (the
+            # incumbent mean must see this round's results — the reason
+            # elastic serializes the slab gather)
+            self._entry_transitions(k + 1)
+            prev_slab, prev_plan = new_slab, plan
+            pf = next_pf
+            k += 1
+        return stats
+
+    # ------------------------------------------------------------------ #
+
+    def evaluate_final_streamed(self) -> np.ndarray:
+        """Final evaluation of EVERY client in cohort-width device chunks —
+        the dense driver's full-fleet `evaluate_all` without materializing
+        a `[N, ...]` device tree. One executable (fixed chunk width; the
+        tail chunk pads with repeated rows and drops the surplus)."""
+        c = self.cohort
+        n = self.n_real
+        outs = []
+        for start in range(0, n, c):
+            stop = min(start + c, n)
+            ids = np.arange(start, start + c, dtype=np.int32)
+            ids[stop - start:] = start
+            slab = self.store.gather(ids, place=self._place)
+            rows = np.minimum(ids, n - 1)
+            hd = self.host_data
+            m = np.asarray(jax.device_get(self.evaluate_all(
+                slab.params, self._place(hd.test_x[rows]),
+                self._place(hd.test_m[rows]), self._place(hd.test_y[rows]),
+                self._place(hd.train_xb[rows]),
+                self._place(hd.train_mb[rows]))))
+            outs.append(m[: stop - start])
+        return np.concatenate(outs, axis=0)
+
+    def cohort_bytes(self) -> Dict[str, int]:
+        """Device-resident byte accounting of the steady-state cohort loop
+        (the cohort bench's acceptance numbers — BENCH_COHORT): per-slab
+        figures plus the worst-case live total: THREE state slabs (the
+        in-flight round's input + its output + the prefetched next
+        cohort) and TWO data/verification slabs (in-flight + prefetched).
+        Every term scales with the cohort width C — N appears nowhere."""
+        state_slab = self.store.slab_bytes(self.cohort)
+        per_client_data = sum(
+            l.nbytes // max(1, l.shape[0])
+            for name in _COHORT_DATA_FIELDS
+            for l in [getattr(self.host_data, name)])
+        data_slab = self.cohort * per_client_data \
+            + int(np.asarray(self.host_data.dev_x).nbytes) + 4 * self.cohort
+        if self._const_ver is not None:
+            ver_slab = int(sum(np.asarray(v).nbytes
+                               for v in self._const_ver))
+        else:
+            ver_slab = self.cohort * int(
+                self.host_data.valid_x.nbytes // max(1, self.n_real)
+                + self.host_data.valid_m.nbytes // max(1, self.n_real))
+        return {
+            "cohort": self.cohort,
+            "state_slab_bytes": state_slab,
+            "data_slab_bytes": data_slab,
+            "ver_slab_bytes": ver_slab,
+            "device_total_bytes": 3 * state_slab
+            + 2 * (data_slab + ver_slab),
+        }
+
+    def members_at(self, round_index: int) -> Optional[np.ndarray]:
+        if self._elastic_np is None:
+            return None
+        if round_index <= 0:
+            return np.ones(self.n_real, bool)
+        return np.asarray(
+            self._elastic_np.member[round_index - 1][: self.n_real]) > 0
+
+    def generation_at(self, round_index: int) -> Optional[np.ndarray]:
+        if self._elastic_np is None:
+            return None
+        if round_index <= 0:
+            return np.zeros(self.n_real, np.int64)
+        return np.asarray(self._elastic_np.generation[round_index - 1]
+                          [: self.n_real]).astype(np.int64)
+
+    def states_for_checkpoint(self, n_pad: int) -> ClientStates:
+        """Host-resident states padded to the DENSE snapshot width, so
+        tiered and dense runs write interchangeable checkpoints (a
+        pre-PR-11 dense snapshot restores into the tier, and a tiered
+        snapshot restores into a dense engine — checkpointing/io.py)."""
+        if n_pad == self.n_real:
+            return self.store.host
+        def grow(leaf):
+            pad = np.zeros((n_pad - self.n_real,) + leaf.shape[1:],
+                           leaf.dtype)
+            return np.concatenate([leaf, pad], axis=0)
+        return jax.tree.map(grow, self.store.host)
+
+    def restore_states(self, states: ClientStates) -> None:
+        """Adopt a restored (dense-width) snapshot into the tier."""
+        self.store = TieredClientStore.from_dense(
+            jax.tree.map(lambda t: np.asarray(t)[: self.n_real], states))
+
+
+def _save_hybrid_latents_streamed(cfg, model, engine: TieredRoundEngine,
+                                  run: int, update_type: str) -> None:
+    """The tiered counterpart of main._save_hybrid_latents (LatentData
+    pickles for the t-SNE notebook parity): latents computed in
+    cohort-width chunks over the host tier — same artifact, no [N, ...]
+    device materialization."""
+    import os
+
+    from fedmse_tpu.visualization import save_latent_data
+
+    c, n, hd = engine.cohort, engine.n_real, engine.host_data
+    fn = jax.jit(jax.vmap(lambda p, x: model.apply({"params": p}, x)[0]))
+    lat_parts, lab_parts = [], []
+    for start in range(0, n, c):
+        stop = min(start + c, n)
+        ids = np.arange(start, start + c, dtype=np.int32)
+        ids[stop - start:] = start
+        slab = engine.store.gather(ids, place=engine._place)
+        latents = np.asarray(jax.device_get(fn(
+            slab.params, gather_rows(hd.test_x, ids, engine._place)))
+        ).astype(np.float32)[: stop - start]
+        mask = np.asarray(hd.test_m[start:stop]) > 0
+        labels = np.asarray(hd.test_y[start:stop])
+        for i in range(stop - start):
+            lat_parts.append(latents[i][mask[i]])
+            lab_parts.append(labels[i][mask[i]])
+    save_latent_data(
+        os.path.join(cfg.checkpoint_dir, "LatentData",
+                     str(cfg.network_size), cfg.experiment_name,
+                     f"Run_{run}"),
+        update_type, np.concatenate(lat_parts), np.concatenate(lab_parts))
+
+
+def run_tiered_combination(cfg: ExperimentConfig, data, n_real: int,
+                           model_type: str, update_type: str, run: int,
+                           writer=None, early_stop=None,
+                           device_names: Optional[List[str]] = None,
+                           mesh=None, resume=None,
+                           save_checkpoints: bool = False,
+                           attack=None, chaos=None, elastic=None) -> Dict:
+    """`main.run_combination` for state_layout='tiered': same artifacts,
+    same bookkeeping order, same early-stop/resume semantics — the round
+    loop runs the cohort executor instead of the dense scanned schedule.
+    Returns the same result dict shape (plus the prefetch telemetry under
+    'tiered_stats')."""
+    from fedmse_tpu.checkpointing import (save_client_models,
+                                          save_training_tracking)
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.parallel import uniform_decision
+
+    rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed,
+                          run_seed_stride=cfg.run_seed_stride)
+    model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
+                       cfg.latent_dim, cfg.shrink_lambda,
+                       precision=cfg.precision)
+    poison_fn = None
+    if attack is not None:
+        from fedmse_tpu.federation.attack import make_poison_fn
+        poison_fn = make_poison_fn(attack)
+    engine = TieredRoundEngine(model, cfg, data, n_real=n_real, rngs=rngs,
+                               model_type=model_type,
+                               update_type=update_type, poison_fn=poison_fn,
+                               chaos=chaos, elastic=elastic, mesh=mesh)
+
+    n_pad = data.num_clients_padded
+    round_times: List[float] = []
+    all_tracking: List[np.ndarray] = []
+    last_result = None
+    tag = f"{model_type}_{update_type}_run{run}"
+    start_round = 0
+    elastic_sig = None if elastic is None else elastic.signature()
+    resume_expected = {"flatten_optimizer": cfg.flatten_optimizer,
+                       "elastic": elastic_sig}
+    resume_defaults = {"flatten_optimizer": False, "elastic": None}
+
+    def resume_extra(next_round: int) -> Dict:
+        gen = engine.generation_at(next_round)
+        return {"flatten_optimizer": cfg.flatten_optimizer,
+                "elastic": elastic_sig,
+                "elastic_generation": None if gen is None else gen.tolist()}
+
+    if resume is not None and resume.exists(tag):
+        states, engine.host, start_round, prev_tracking = resume.restore(
+            tag, engine.states_for_checkpoint(n_pad),
+            expected_extra=resume_expected, extra_defaults=resume_defaults,
+            layout="tiered")
+        engine.restore_states(states)
+        if prev_tracking is not None:
+            all_tracking.append(prev_tracking)
+        logger.info("resumed %s (tiered) at round %d", tag, start_round)
+
+    def bookkeep(result, sec: float) -> bool:
+        nonlocal last_result
+        round_times.append(sec)
+        last_result = result
+        all_tracking.append(result.tracking)
+        logger.info("[%s/%s run %d] round %d: agg=%s mean %s=%.4f (%.2fs)",
+                    model_type, update_type, run, result.round_index + 1,
+                    result.aggregator, cfg.metric,
+                    float(np.nanmean(result.client_metrics)), sec)
+        if writer is not None:
+            writer.append_round_metrics(run, result.round_index,
+                                        result.client_metrics,
+                                        model_type, update_type)
+            writer.append_verification(run, result.round_index,
+                                       result.verification_results)
+        if resume is not None:
+            resume.save(tag, engine.states_for_checkpoint(n_pad),
+                        engine.host, result.round_index + 1,
+                        extra=resume_extra(result.round_index + 1),
+                        tracking=np.concatenate(all_tracking, axis=1)
+                        if all_tracking else None)
+        if early_stop is not None and uniform_decision(
+                early_stop.should_stop(result.client_metrics)):
+            logger.info("Early stopping in global round!")
+            return True
+        return False
+
+    stats = engine.run_rounds(start_round, cfg.num_rounds - start_round,
+                              bookkeep)
+
+    final_metrics, final_metrics_full = split_metric_columns(
+        engine.evaluate_final_streamed())
+    if elastic is not None:
+        member = engine.members_at(
+            last_result.round_index + 1 if last_result is not None
+            else start_round)
+        final_metrics = np.where(member, final_metrics, np.nan)
+        if final_metrics_full is not None:
+            final_metrics_full = np.where(member[:, None],
+                                          final_metrics_full, np.nan)
+
+    if writer is not None and save_checkpoints and device_names:
+        save_client_models(writer, run, model_type, update_type,
+                           device_names, engine.store.host.params)
+        if all_tracking:
+            save_training_tracking(writer, run, model_type, update_type,
+                                   device_names,
+                                   np.concatenate(all_tracking, axis=1))
+        if model_type == "hybrid":
+            _save_hybrid_latents_streamed(cfg, model, engine, run,
+                                          update_type)
+
+    out = {
+        "final_metrics": final_metrics,
+        "best_final": float(np.nanmax(final_metrics)),
+        "round_times": round_times,
+        "rounds_run": len(round_times),
+        "aggregation_count": engine.host.aggregation_count.tolist(),
+        "votes_received": engine.host.votes_received.tolist(),
+        "tiered_stats": stats.summary(),
+    }
+    if final_metrics_full is not None:
+        out["final_metrics_full"] = final_metrics_full
+    return out

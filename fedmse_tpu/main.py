@@ -138,6 +138,17 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     joins recycle retired client slots, leaves retire them. All three
     compose — Byzantine peers PLUS transient faults PLUS a fleet that is
     never the same twice is the deployment's actual threat model."""
+    if cfg.state_layout == "tiered":
+        # cohort-compacted host tiering (federation/tiered.py, DESIGN.md
+        # §16): the fleet lives in host RAM and only the round's cohort is
+        # device-resident — same artifacts/bookkeeping, per-round cohort
+        # dispatches instead of the dense scanned schedule
+        from fedmse_tpu.federation.tiered import run_tiered_combination
+        return run_tiered_combination(
+            cfg, data, n_real, model_type, update_type, run, writer=writer,
+            early_stop=early_stop, device_names=device_names, mesh=mesh,
+            resume=resume, save_checkpoints=save_checkpoints, attack=attack,
+            chaos=chaos, elastic=elastic)
     rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed,
                           run_seed_stride=cfg.run_seed_stride)
     model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
@@ -572,6 +583,9 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
             reasons.append("--resume-dir (per-chunk resume is per-run)")
         if cfg.metric == "time":
             reasons.append("metric='time' (host-side wall clock)")
+        if cfg.state_layout == "tiered":
+            reasons.append("state_layout=tiered (runs-axis batching is "
+                           "dense-layout only)")
         if not (cfg.fused_rounds and cfg.fused_schedule):
             reasons.append("fused_rounds/fused_schedule disabled")
         if reasons:

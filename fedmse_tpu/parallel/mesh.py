@@ -133,6 +133,32 @@ def shard_clients(tree: Any, mesh: Mesh, axis_name: str = "clients") -> Any:
     return jax.tree.map(place, tree)
 
 
+def place_cohort(mesh: Optional[Mesh], cohort: int,
+                 axis_name: str = "clients"):
+    """Leaf-placement fn for a `[C, ...]` cohort slab (federation/tiered.py,
+    DESIGN.md §16): shard the cohort axis over the client mesh when the
+    width divides the mesh, else plain single-device placement. Returns a
+    host-leaf -> device-array callable for `TieredClientStore.gather` /
+    the cohort data assembly — the tiered layout's analog of
+    `shard_clients`, at cohort width instead of the full client axis
+    (same canonical P('clients') spec, same no-trailing-None fixed
+    point).
+
+    Placements always produce device-OWNED buffers (`copy=True` /
+    committed sharded placement), never `jnp.asarray`: on CPU `asarray`
+    zero-copies aligned numpy memory, and a buffer the jax.Array does
+    not own must never reach a donating consumer — XLA would alias the
+    program's output into memory that dies with the gather's
+    temporaries (use-after-free). The tiered round program is jitted
+    WITHOUT donation for exactly this reason (tiered._build_fused war
+    story); the owned-copy rule here is defense in depth so no future
+    consumer of a cohort placement can reintroduce the hazard."""
+    if mesh is None or cohort % mesh.devices.size != 0:
+        return lambda leaf: jnp.array(leaf, copy=True)
+    sharding = NamedSharding(mesh, P(axis_name))
+    return lambda leaf: jax.device_put(jnp.array(leaf, copy=True), sharding)
+
+
 def replicate(tree: Any, mesh: Mesh) -> Any:
     """Replicate a pytree across every device of the (possibly multi-host)
     mesh."""

@@ -43,6 +43,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
+import warnings
 from typing import Any, Dict, Optional, Union
 
 import jax
@@ -53,6 +54,18 @@ from fedmse_tpu.models.centroid import fit_centroid
 from fedmse_tpu.ops.losses import per_sample_mse
 from fedmse_tpu.ops.precision import PrecisionPolicy, get_policy
 from fedmse_tpu.utils.logging import get_logger
+
+_DONATION_FILTER_INSTALLED = False
+
+
+def _ignore_unusable_donation_once() -> None:
+    """Register the expected-unusable donation advisory filter ONCE (see
+    ServingEngine._build_scorer) instead of stacking one per engine."""
+    global _DONATION_FILTER_INSTALLED
+    if not _DONATION_FILTER_INSTALLED:
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        _DONATION_FILTER_INSTALLED = True
 
 logger = get_logger(__name__)
 
@@ -235,10 +248,24 @@ class ServingEngine:
         count buckets replicate and run as before.
 
     Input buffers are fresh numpy arrays per dispatch, so nothing host-side
-    retains them past the call. (Buffer DONATION was evaluated and dropped:
-    the output [b] scores cannot alias either input — [b, D] rows / [b]
-    int32 ids — so donate_argnums would only emit unusable-donation
-    warnings, never reclaim memory.)
+    retains them past the call. Under the bf16-resident policy the row
+    buffer is additionally DONATED into the scorer (PR 2 evaluated
+    donation and dropped it; PR 11 closes that note for the path where it
+    pays): the [b] f32 scores provably cannot alias the [b, D] bf16 rows
+    — different dtype, different byte size — so the harvested scores
+    never point into the donated buffer and donation is SAFE by
+    construction, while the runtime may release the row buffer's device
+    memory as soon as the executable has consumed it instead of holding
+    it to the end of the dispatch (at max_bucket x D bf16 per in-flight
+    batch, the continuous front's double-buffered steady state keeps two
+    of these alive — the standing PR 5/8 headroom). The provable
+    non-aliasing is also why XLA reports the donation "not usable" for
+    input-output aliasing — expected, and filtered below; scores parity
+    and the zero-retrace `_cache_size` pin ride in
+    tests/test_serving.py::test_bf16_row_buffer_donation. The f32 path
+    stays undonated: it is the bit-parity-pinned mode, and its row buffer
+    can in corner shapes (D == 1) legally alias the scores, which would
+    change nothing but makes the no-alias proof conditional.
     """
 
     def __init__(self, model, model_type: str, params: Any,
@@ -263,6 +290,9 @@ class ServingEngine:
             raise ValueError(f"max_bucket must be >= 1, got {max_bucket}")
         self.policy = get_policy(precision)
         cdt = self.policy.compute_dtype
+        # bf16-resident path donates the row buffer into the scorer (class
+        # docstring); f32 stays undonated — the bit-parity-pinned mode
+        self._donate_rows = cdt != jnp.float32
         if getattr(model, "compute_dtype", cdt) != cdt:
             # the flax module must apply in the engine's compute dtype, or
             # Dense's internal promote would silently undo the bf16 cast
@@ -381,6 +411,13 @@ class ServingEngine:
                 if xp.shape[0] % self.mesh.devices.size == 0
                 else PartitionSpec())
         sh = NamedSharding(self.mesh, spec)
+        if self._donate_rows:
+            # the bf16 scorer DONATES the row buffer, and on the CPU
+            # backend device_put can zero-copy-alias the numpy staging
+            # buffer — donating memory the jax.Array does not own is the
+            # use-after-free class documented in federation/tiered.py;
+            # jnp.array(copy=True) forces a device-owned buffer first
+            xp = jnp.array(xp, copy=True)
         return jax.device_put(xp, sh), jax.device_put(gp, sh)
 
     # ----------------------------- hot swap ------------------------------ #
@@ -610,6 +647,19 @@ class ServingEngine:
                     scores = state["centroids"].get_density(latent)
                 return jnp.nan_to_num(scores)
 
+        if self._donate_rows:
+            # bf16-resident path: donate the row buffer (class docstring).
+            # The donation is expected-unusable for input-output aliasing
+            # (the f32 scores cannot alias bf16 rows — that proof is what
+            # makes donating safe), so XLA's "not usable" advisory is
+            # noise here. The message filter is process-global (the
+            # advisory carries no location to scope on) but registered
+            # ONCE, and the only other donating programs in this codebase
+            # are the dense fused rounds, whose states donation is always
+            # usable — a genuinely broken future donation elsewhere still
+            # surfaces through its symptoms, not this advisory.
+            _ignore_unusable_donation_once()
+            return jax.jit(score_rows, donate_argnums=(1,))
         return jax.jit(score_rows)
 
     def _scorer(self):

@@ -135,6 +135,47 @@ def test_warmup_precompiles_every_bucket_and_reports_seconds():
     assert sum(eng.dispatches.values()) == 0
 
 
+def test_bf16_row_buffer_donation():
+    """The bf16-resident path donates the row buffer into score_rows
+    (PR 2's 'donation evaluated and dropped' note, closed where it pays):
+    scores must match the undonated f32 engine within the serving
+    tolerance, repeated dispatches must not retrace (_cache_size pin),
+    and the donation must never corrupt a harvested batch — the output
+    provably cannot alias the donated buffer (f32 [b] vs bf16 [b, D])."""
+    model, params, data, eng32 = _engine("autoencoder", max_bucket=8)
+    _, _, _, eng16 = _engine("autoencoder", max_bucket=8, precision="bf16")
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(8, DIM)).astype(np.float32)
+    gw = np.arange(8, dtype=np.int32) % N
+    ref = eng32.score(x, gw)
+    got = eng16.score(x, gw)
+    # bf16 compute quality bar (PARITY.md §7 — quality-pinned, not bitwise)
+    assert np.all(np.isfinite(got))
+    np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.05)
+    # donation is per-dispatch: the same bucket re-dispatches from fresh
+    # buffers with scores stable and ZERO retraces
+    cache = eng16._scorer()._cache_size()
+    for _ in range(3):
+        again = eng16.score(x, gw)
+        np.testing.assert_array_equal(again, got)
+    assert eng16._scorer()._cache_size() == cache
+    # async dispatch/harvest (the continuous front's path) sees intact
+    # scores too — the harvested copy never aliases the donated buffer
+    pend = eng16.dispatch(x, gw)
+    np.testing.assert_array_equal(pend.harvest(), got)
+    # the f32 engine stays undonated (the bit-parity-pinned mode)
+    assert eng32.score(x, gw) is not None
+    np.testing.assert_array_equal(eng32.score(x, gw), ref)
+    # mesh path: _place_rows must hand the donating scorer a device-OWNED
+    # buffer (device_put can zero-copy-alias the numpy staging buffer on
+    # CPU — the donation use-after-free class; federation/tiered.py)
+    if len(jax.devices()) >= 2:
+        from fedmse_tpu.parallel import client_mesh
+        _, _, _, eng16m = _engine("autoencoder", max_bucket=8,
+                                  precision="bf16", mesh=client_mesh(2))
+        np.testing.assert_array_equal(eng16m.score(x, gw), got)
+
+
 def test_engine_rejects_bad_gateway_and_missing_centroids():
     model, params, data, eng = _engine("autoencoder")
     with pytest.raises(ValueError, match="gateway ids"):

@@ -1,0 +1,380 @@
+"""Cohort-compacted, host-tiered client state (federation/tiered.py,
+state.TieredClientStore; DESIGN.md §16).
+
+Pins, in dependency order:
+  * the tier's chunked init is bitwise the dense init, row by row;
+  * bit-parity to the dense program at full participation (C == N): the
+    tiered executor shares the dense engine's jitted round body, so
+    states, per-round results AND the on-disk artifacts byte-match;
+  * the prefetched double-buffered loop (stale-row patch included) is
+    bit-identical to the serial per-round tiered path — the patch can
+    never leak a stale row;
+  * cohort gather/scatter is keyed to ABSOLUTE client ids (PARITY.md §8):
+    growing the padded client axis re-tenants nothing;
+  * memory accounting: device-resident bytes scale with the cohort width
+    C, not N — and a 100k-client init never materializes a dense
+    [N, ...] device tree (params or Adam moments);
+  * checkpoints are layout-interchangeable (dense snapshot -> tier,
+    tiered snapshot -> dense engine);
+  * chaos / elastic / mesh-sharded slabs compose at cohort width.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from fedmse_tpu.config import ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+from fedmse_tpu.data.stacking import pad_federated_data
+from fedmse_tpu.federation import (ElasticSpec, RoundEngine, TieredClientStore,
+                                   TieredRoundEngine, init_client_states)
+from fedmse_tpu.chaos import ChaosSpec
+from fedmse_tpu.models import make_model
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+pytestmark = pytest.mark.cohort
+
+DIM, HID, LAT = 8, 6, 3
+
+
+def _cfg(**kw):
+    base = dict(num_participants=0.5, num_rounds=3, epochs=2,
+                dim_features=DIM, hidden_neus=HID, latent_dim=LAT,
+                state_layout="tiered")
+    base.update(kw)
+    return ExperimentConfig(**base)
+
+
+def _federation(n=6, seed_cfg=None):
+    cfg = seed_cfg or _cfg()
+    rngs = ExperimentRngs(run=0, data_seed=cfg.data_seed)
+    clients = synthetic_clients(n_clients=n, dim=DIM, n_normal=60,
+                                n_abnormal=60)
+    dev_x = build_dev_dataset(clients, rngs.data_rng)
+    data = stack_clients(clients, dev_x, cfg.batch_size)
+    return clients, data
+
+
+def _model(cfg):
+    return make_model("hybrid", DIM, HID, LAT, cfg.shrink_lambda)
+
+
+def _tiered(cfg, data, n, **kw):
+    return TieredRoundEngine(
+        _model(cfg), cfg, data, n_real=n,
+        rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+        model_type="hybrid", update_type="mse_avg", **kw)
+
+
+def _run(engine, rounds):
+    out = []
+    engine.run_rounds(0, rounds, lambda r, s: out.append(r) or False)
+    return out
+
+
+def _assert_states_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------- init parity ------------------------------- #
+
+def test_tier_init_rows_bitwise_match_dense_init():
+    cfg = _cfg()
+    model = _model(cfg)
+    tx = optax.adam(cfg.lr_rate)
+    key = jax.random.key(7)
+    dense = jax.device_get(init_client_states(model, tx, key, 11))
+    # chunk smaller than N so the chunked path (incl. the padded tail
+    # dispatch) is actually exercised
+    tier = TieredClientStore.create(model, tx, key, 11, init_chunk=4)
+    _assert_states_equal(dense, tier.host)
+
+
+# ------------------- bit-parity at full participation ------------------ #
+
+def test_bit_parity_to_dense_at_full_participation(tmp_path):
+    """C == N: same executable, same inputs — states, round results and
+    on-disk artifacts are bit-identical to the dense program (the
+    acceptance pin; compact_cohort=False puts the dense engine on the
+    exact program the cohort executor compiles)."""
+    from fedmse_tpu.checkpointing import ResultsWriter
+    from fedmse_tpu.main import run_combination
+
+    cfg_t = _cfg(num_participants=1.0, compact_cohort=False, num_rounds=3)
+    cfg_d = cfg_t.replace(state_layout="dense", fused_pipeline=False)
+    clients, data = _federation(6, cfg_t)
+    names = [c.name for c in clients]
+
+    outs, writers = {}, {}
+    for tag, cfg in (("dense", cfg_d), ("tiered", cfg_t)):
+        writers[tag] = ResultsWriter(str(tmp_path / tag), 6, "exp", "scen",
+                                     "AUC", cfg.num_participants)
+        outs[tag] = run_combination(cfg, data, 6, "hybrid", "mse_avg", 0,
+                                    writer=writers[tag], device_names=names,
+                                    save_checkpoints=True)
+    np.testing.assert_array_equal(outs["dense"]["final_metrics"],
+                                  outs["tiered"]["final_metrics"])
+    assert outs["dense"]["aggregation_count"] == \
+        outs["tiered"]["aggregation_count"]
+    # artifact trees byte-compare (round JSON lines, verification rows,
+    # per-client model.npz + tracking)
+    d_files = sorted(glob.glob(str(tmp_path / "dense" / "**" / "*.*"),
+                               recursive=True))
+    t_files = sorted(glob.glob(str(tmp_path / "tiered" / "**" / "*.*"),
+                               recursive=True))
+    rel = [os.path.relpath(f, tmp_path / "dense") for f in d_files]
+    assert rel == [os.path.relpath(f, tmp_path / "tiered") for f in t_files]
+    assert rel  # non-empty artifact tree
+    for df, tf in zip(d_files, t_files):
+        with open(df, "rb") as f1, open(tf, "rb") as f2:
+            assert f1.read() == f2.read(), f"artifact differs: {df}"
+
+
+def test_partial_cohort_semantics_and_dense_agreement_on_cohort():
+    """C < N: cohort clients' training outputs match the dense program's
+    for the same selections (same per-lane math), and non-cohort clients
+    read NaN metrics ('not measured this round')."""
+    cfg_t = _cfg(num_participants=0.5, compact_cohort=False, num_rounds=1)
+    cfg_d = cfg_t.replace(state_layout="dense")
+    clients, data = _federation(6, cfg_t)
+    tier = _tiered(cfg_t, data, 6)
+    dense = RoundEngine(_model(cfg_d), cfg_d, data, n_real=6,
+                        rngs=ExperimentRngs(run=0, data_seed=cfg_d.data_seed),
+                        model_type="hybrid", update_type="mse_avg",
+                        fused=True)
+    rt = _run(tier, 1)[0]
+    rd = dense.run_round_fused(0)
+    assert rt.selected == rd.selected and rt.aggregator == rd.aggregator
+    sel = np.asarray(rt.selected)
+    # training curves are cohort-only in BOTH layouts — identical values
+    np.testing.assert_array_equal(rt.min_valid[sel], rd.min_valid[sel])
+    np.testing.assert_array_equal(rt.tracking[sel], rd.tracking[sel])
+    off = np.setdiff1d(np.arange(6), sel)
+    assert np.isnan(rt.client_metrics[off]).all()
+    assert np.isfinite(rt.client_metrics[sel]).all()
+
+
+# -------------------- prefetch / patch correctness --------------------- #
+
+def test_prefetched_loop_matches_serial_rounds_bitwise():
+    """The double-buffered loop (stale-row patch included) ends bitwise
+    where the serial per-round tiered path ends — overlapping cohorts
+    across rounds are exactly the case the patch exists for."""
+    cfg = _cfg(num_participants=0.5, num_rounds=4)
+    clients, data = _federation(6, cfg)
+    serial = _tiered(cfg, data, 6)
+    res_serial = [serial.run_round(r) for r in range(4)]
+    pre = _tiered(cfg, data, 6)
+    res_pre = _run(pre, 4)
+    for a, b in zip(res_serial, res_pre):
+        assert a.selected == b.selected and a.aggregator == b.aggregator
+        np.testing.assert_array_equal(a.client_metrics, b.client_metrics)
+    _assert_states_equal(serial.store.host, pre.store.host)
+    s = pre.stats.summary()
+    assert s["rounds"] == 4 and s["overlapped"]
+    assert len(s["prefetch_gap_s"]) == 4
+
+
+# ---------------- absolute-id keying / padding invariance --------------- #
+
+def test_cohort_gather_keyed_to_absolute_ids_padding_invariant():
+    """PARITY.md §8 for the cohort axis: growing the padded client axis
+    (what a bigger mesh forces) changes NOTHING — same cohorts, same
+    results, same tier. Rides alongside the fold_in init pins."""
+    cfg = _cfg(num_rounds=3)
+    clients, data = _federation(6, cfg)
+    a = _tiered(cfg, data, 6)
+    ra = _run(a, 3)
+    b = _tiered(cfg, pad_federated_data(data, 6 + 4), 6)
+    rb = _run(b, 3)
+    for x, y in zip(ra, rb):
+        assert x.selected == y.selected and x.aggregator == y.aggregator
+        np.testing.assert_array_equal(x.client_metrics, y.client_metrics)
+    _assert_states_equal(a.store.host, b.store.host)
+
+
+# ------------------------- memory accounting --------------------------- #
+
+def test_device_bytes_scale_with_cohort_not_fleet():
+    """The acceptance's memory pin: the device-resident state slab scales
+    with C (x8 for C 64 -> 512) and sits far below the dense layout's
+    device bytes at the same N."""
+    from fedmse_tpu.federation.state import dense_state_bytes
+
+    cfg = _cfg()
+    model = _model(cfg)
+    tx = optax.adam(cfg.lr_rate)
+    n = 4096
+    tier = TieredClientStore.create(model, tx, jax.random.key(0), n)
+    b64, b512 = tier.slab_bytes(64), tier.slab_bytes(512)
+    assert b512 == 8 * b64
+    # measured slab: gather C rows, sum the live device leaf bytes
+    slab = tier.gather(np.arange(512, dtype=np.int32))
+    measured = sum(int(l.nbytes) for l in jax.tree.leaves(slab))
+    assert measured == b512
+    dense_bytes = dense_state_bytes(jax.eval_shape(
+        lambda: init_client_states(model, tx, jax.random.key(0), n)))
+    assert dense_bytes >= (n // 512) * measured  # scales with N, slab with C
+
+
+def test_100k_client_init_never_materializes_dense_device_tree():
+    """A 100k-client tiered init holds the fleet in host numpy only: no
+    live device array carries the fleet-sized leading axis (params OR f32
+    Adam moments), and the device footprint of a C=512 round slab is
+    >= 100x smaller than the dense tree would be."""
+    from fedmse_tpu.federation.state import dense_state_bytes
+
+    n = 100_000
+    cfg = _cfg()
+    model = make_model("hybrid", 6, 4, 2, cfg.shrink_lambda)
+    tx = optax.adam(cfg.lr_rate)
+    tier = TieredClientStore.create(model, tx, jax.random.key(1), n,
+                                    init_chunk=8192)
+    fleet_axis = [a for a in jax.live_arrays()
+                  if a.shape and a.shape[0] == n]
+    assert not fleet_axis, [a.shape for a in fleet_axis[:3]]
+    assert tier.host.hist_perf.shape == (n,)
+    dense_bytes = dense_state_bytes(jax.eval_shape(
+        lambda: init_client_states(model, tx, jax.random.key(1), n)))
+    assert dense_bytes / tier.slab_bytes(512) >= 100
+
+
+# ----------------------- checkpoint interchange ------------------------ #
+
+def test_checkpoints_interchange_between_layouts(tmp_path):
+    from fedmse_tpu.checkpointing import CheckpointManager
+
+    cfg = _cfg(num_rounds=2)
+    clients, data = _federation(6, cfg)
+    tier = _tiered(cfg, data, 6)
+    _run(tier, 2)
+    ck = CheckpointManager(str(tmp_path))
+    ck.save("tag", tier.states_for_checkpoint(6), tier.host, 2)
+
+    # tiered snapshot -> dense engine (device restore)
+    cfg_d = cfg.replace(state_layout="dense")
+    dense = RoundEngine(_model(cfg_d), cfg_d, data, n_real=6,
+                        rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                        model_type="hybrid", update_type="mse_avg",
+                        fused=True)
+    st, host, ri, _ = ck.restore("tag", dense.states)
+    assert ri == 2
+    _assert_states_equal(jax.device_get(st), tier.store.host)
+
+    # dense snapshot (pre-PR-11 layout) -> tier: host-owned numpy leaves
+    ck.save("dense_tag", dense.states, dense.host, 1)
+    st2, _, _, _ = ck.restore("dense_tag", tier.states_for_checkpoint(6),
+                              layout="tiered")
+    assert all(isinstance(l, np.ndarray) for l in jax.tree.leaves(st2))
+    fresh = _tiered(cfg, data, 6)
+    fresh.restore_states(st2)
+    _assert_states_equal(fresh.store.host, jax.device_get(dense.states))
+
+
+# -------------------------- fault/membership --------------------------- #
+
+def test_chaos_at_cohort_width_smoke():
+    cfg = _cfg(num_rounds=3)
+    clients, data = _federation(6, cfg)
+    eng = _tiered(cfg, data, 6, chaos=ChaosSpec(dropout_p=0.3, crash_p=0.2))
+    res = _run(eng, 3)
+    for r in res:
+        assert r.divergence is not None
+        assert set(r.effective) <= set(r.selected)
+
+
+def test_elastic_tier_transitions_mutate_host_rows():
+    """A join under the tiered layout mutates the HOST tier: the joiner's
+    params row becomes the full-fleet incumbent mean, moments zero,
+    history cleared (elastic.apply_membership_transitions)."""
+    from fedmse_tpu.federation.elastic import apply_membership_transitions
+
+    cfg = _cfg()
+    model = _model(cfg)
+    tx = optax.adam(cfg.lr_rate)
+    tier = TieredClientStore.create(model, tx, jax.random.key(3), 5)
+    # make history/moments visibly nonzero first
+    for leaf in jax.tree.leaves(tier.host.opt_state):
+        leaf += 1
+    tier.host.hist_seen[:] = True
+    tier.host.rejected[:] = 2
+    before = jax.tree.map(np.copy, tier.host.params)
+    member = np.array([1, 1, 1, 0, 1], np.float32)
+    joined = np.array([0, 0, 0, 1, 0], np.float32)
+    left = np.array([0, 1, 0, 0, 0], np.float32)
+    member[3] = 1.0  # the joiner is a member this round
+    apply_membership_transitions(tier, member, joined, left)
+    w = np.array([1, 1, 1, 0, 1], np.float32) / 4.0
+    for leaf, b in zip(jax.tree.leaves(tier.host.params),
+                       jax.tree.leaves(before)):
+        np.testing.assert_allclose(
+            leaf[3], np.einsum("n,n...->...", w, b.astype(np.float32)
+                               ).astype(leaf.dtype), rtol=1e-6)
+    for leaf in jax.tree.leaves(tier.host.opt_state):
+        assert (leaf[3] == 0).all() and (leaf[1] == 0).all()  # join + leave
+        assert (leaf[0] == 1).all()                           # untouched
+    assert not tier.host.hist_seen[3] and tier.host.rejected[3] == 0
+    assert tier.host.hist_seen[0] and tier.host.rejected[0] == 2
+
+
+def test_elastic_cohort_run_reports_roster():
+    cfg = _cfg(num_rounds=3)
+    clients, data = _federation(6, cfg)
+    eng = _tiered(cfg, data, 6,
+                  elastic=ElasticSpec(leave_p=0.3, join_p=0.5))
+    res = _run(eng, 3)
+    assert res[-1].members is not None and res[-1].generations is not None
+    member = eng.members_at(3)
+    fm = eng.evaluate_final_streamed()
+    assert fm.shape == (6,)
+    assert sorted(res[-1].members) == np.flatnonzero(member).tolist()
+
+
+# ------------------------------ mesh slab ------------------------------ #
+
+def test_cohort_slab_shards_over_client_mesh(mesh8):
+    """C divisible by the mesh: the slab and cohort data shard P('clients')
+    and the round agrees with the unsharded run (float-level: the sharded
+    einsum merge may reorder the reduction)."""
+    cfg = _cfg(num_participants=0.5, num_rounds=2)
+    clients, data = _federation(32, cfg)
+    plain = _tiered(cfg, data, 32)
+    rp = _run(plain, 2)
+    meshed = _tiered(cfg, data, 32, mesh=mesh8)
+    assert meshed.cohort % 8 == 0
+    rm = _run(meshed, 2)
+    slab = meshed.store.gather(np.arange(meshed.cohort, dtype=np.int32),
+                               place=meshed._place)
+    leaf = jax.tree.leaves(slab)[0]
+    assert leaf.sharding.shard_shape(leaf.shape)[0] == leaf.shape[0] // 8
+    for a, b in zip(rp, rm):
+        assert a.selected == b.selected and a.aggregator == b.aggregator
+        np.testing.assert_allclose(a.client_metrics, b.client_metrics,
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------- guards -------------------------------- #
+
+def test_dense_engines_reject_tiered_layout():
+    from fedmse_tpu.federation.batched import BatchedRunEngine
+
+    cfg = _cfg()
+    clients, data = _federation(4, cfg)
+    with pytest.raises(ValueError, match="TieredRoundEngine"):
+        RoundEngine(_model(cfg), cfg, data, n_real=4,
+                    rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                    model_type="hybrid", update_type="mse_avg", fused=True)
+    with pytest.raises(ValueError, match="dense-layout only"):
+        BatchedRunEngine(_model(cfg), cfg, data, n_real=4, runs=2,
+                         model_type="hybrid", update_type="mse_avg")
+    with pytest.raises(ValueError, match="state_layout"):
+        RoundEngine(_model(cfg), cfg.replace(state_layout="bogus"), data,
+                    n_real=4,
+                    rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                    model_type="hybrid", update_type="mse_avg", fused=True)
