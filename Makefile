@@ -9,7 +9,7 @@ LIB := fedmse_tpu/native/libfedmse_io.so
 
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
         serve-bench chaos-sweep churn-sweep pipeline-bench precision-bench \
-        shard-bench knn-bench cohort-bench tpu-check
+        shard-bench knn-bench cohort-bench flywheel-sweep tpu-check
 
 native: $(LIB)
 
@@ -97,6 +97,14 @@ knn-bench:
 # H2D overlap targets the TPU DMA engines)
 cohort-bench:
 	python bench.py --cohort-bench --out BENCH_COHORT_r11_cpu.json
+
+# flywheel drift-recovery sweep (fedmse_tpu/flywheel/, DESIGN.md §17):
+# injected-shift grid over the closed serve -> buffer -> fine-tune ->
+# hot-swap loop — adapted vs frozen AUC per stage, swap counts, buffer
+# occupancy, zero-downtime ticket accounting (writes FLYWHEEL_r12.json;
+# hermetic CPU — the script pins the platform itself)
+flywheel-sweep:
+	python drift_recovery_sweep.py --out FLYWHEEL_r12.json
 
 tpu-check:
 	python tpu_check.py

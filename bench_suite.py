@@ -37,6 +37,12 @@ repo-grown axes):
      device-resident bytes must scale with the cohort width (reduction
      guard), small-N bit-parity echo, prefetch overlap telemetry (full
      protocol: make cohort-bench -> BENCH_COHORT_r11_cpu.json)
+ 15. flywheel control loop (fedmse_tpu/flywheel/, DESIGN.md §17): one
+     reduced drift-recovery cell — the regime walks 1.5 sigma while a
+     replay adversary sits behind it; the closed serve -> buffer ->
+     fine-tune -> hot-swap loop must keep detection AUC at the frozen
+     baseline's expense with zero dropped tickets (full protocol:
+     make flywheel-sweep -> FLYWHEEL_r12.json)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -372,6 +378,33 @@ def scen_cohort(cfg):
                 and res["bit_parity_small_n"]["states_bitwise"])}
 
 
+def scen_flywheel():
+    """Scenario 15: the flywheel control loop (ISSUE 12,
+    fedmse_tpu/flywheel/) — one reduced drift-recovery cell guarding the
+    loop's three contracts: the adapting front's final AUC must beat the
+    frozen baseline's and land within eps of pre-shift, every hot swap
+    must drop zero tickets, and at least one drift-triggered fine-tune
+    must actually fire. The committed standalone artifact
+    (make flywheel-sweep -> FLYWHEEL_r12.json) runs the full shift x
+    score_kind grid."""
+    from drift_recovery_sweep import run_cell
+
+    row = run_cell(1.5, "mse", 3)
+    return {"scenario": "flywheel drift recovery: 6-gateway regime walks "
+                        "1.5 sigma in 3 stages, replay adversary, "
+                        "serve -> buffer -> fine-tune -> hot swap",
+            "auc_pre_shift": row["auc_pre_shift"],
+            "auc_final_adapted": row["auc_final_adapted"],
+            "auc_final_frozen": row["auc_final_frozen"],
+            "swap_count": row["swap_count"],
+            "finetune_rounds_per_swap": row["finetune_rounds_per_swap"],
+            "buffer_fill": row["buffer_occupancy"]["fill_fraction"],
+            "zero_downtime": row["zero_downtime"],
+            "acceptance_met": bool(row["recovered_within_eps"]
+                                   and row["zero_downtime"]
+                                   and row["swap_count"] >= 1)}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -394,9 +427,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-14")
-        if not 1 <= only <= 14:
-            sys.exit(f"--only expects a scenario number 1-14, got {only}")
+            sys.exit("--only expects a scenario number 1-15")
+        if not 1 <= only <= 15:
+            sys.exit(f"--only expects a scenario number 1-15, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -487,6 +520,9 @@ def main():
 
     if only in (None, 14):
         emit(scen_cohort(ExperimentConfig()))
+
+    if only in (None, 15):
+        emit(scen_flywheel())
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

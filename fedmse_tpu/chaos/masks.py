@@ -13,6 +13,16 @@ Determinism contract:
   * round t's draws come from `fold_in(chaos_key, t)` with t the ABSOLUTE
     round index — masks are invariant to how the driver chunks the schedule
     (the mid-chunk rewind+replay recomputes identical masks);
+  * client i's per-round draws fold i into the round key individually
+    (`fold_in_keys`, PARITY.md §8) — NOT a shaped bernoulli over the client
+    axis — so client i's fault stream depends only on (chaos_key, t, i):
+    padding the client axis to a mesh multiple (or gathering a tiered
+    cohort's columns, federation/tiered.py) cannot perturb any real
+    client's faults. The original PR 3 shaped draws made a padded dense
+    run draw a DIFFERENT fault stream than an unpadded or tiered one for
+    the same seed (the latent documented at tiered._mask_kwargs until
+    this fix; padding invariance now regression-pinned in
+    tests/test_chaos.py);
   * the chaos key is the domain-separated stream from
     `ExperimentRngs.chaos_key()` (utils/seeding.py): drawing masks advances
     no other stream, so enabling chaos leaves training/eval/selection draws
@@ -31,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from fedmse_tpu.chaos.spec import ChaosSpec
+from fedmse_tpu.utils.seeding import fold_in_keys
 
 
 class ChaosMasks(NamedTuple):
@@ -67,11 +78,20 @@ def make_chaos_masks(spec: ChaosSpec, chaos_key: jax.Array, start_round: int,
         in_window = t >= spec.start_round
         if spec.stop_round is not None:
             in_window = in_window & (t < spec.stop_round)
-        down = jax.random.bernoulli(k_avail, spec.dropout_p, (n_clients,))
-        strag = jax.random.bernoulli(k_strag, spec.straggler_p, (n_clients,))
+
+        def bern(key, p):
+            # per-client fold_in, NOT a shaped draw over the (possibly
+            # padded) client axis: client i's draw must depend only on
+            # (key, i) so mesh padding / cohort gathers preserve every
+            # real client's fault stream (module docstring; the same
+            # rule as the elastic membership draws, PARITY.md §8)
+            return jax.vmap(lambda k: jax.random.bernoulli(k, p))(
+                fold_in_keys(key, n_clients))
+
+        down = bern(k_avail, spec.dropout_p)
+        strag = bern(k_strag, spec.straggler_p)
         crash = jax.random.bernoulli(k_crash, spec.crash_p)
-        drop = jax.random.bernoulli(k_drop, spec.broadcast_loss_p,
-                                    (n_clients,))
+        drop = bern(k_drop, spec.broadcast_loss_p)
         f32 = jnp.float32
         return ChaosMasks(
             available=jnp.where(in_window & down, f32(0), f32(1)),

@@ -292,6 +292,31 @@ class ExperimentConfig:
     # while throughput tracks the offered load).
     serve_max_batch: int = 256
     serve_latency_budget_ms: float = 2.0
+    # Flywheel control loop (fedmse_tpu/flywheel/, DESIGN.md §17): the
+    # serve -> buffer -> drift-triggered fine-tune -> hot-swap knobs the
+    # --flywheel smoke (and any deployment of FlywheelController) reads.
+    # buffer_size is the per-gateway fresh-normal reservoir capacity;
+    # rounds the fine-tune's federated round count; quorum the controller
+    # polls a swap_recommended verdict must survive (on top of the
+    # monitor's min_batches debounce); cooldown the DriftMonitor's
+    # post-rebaseline hysteresis in updates (the anti-thrash guard);
+    # min_rows the per-gateway buffered floor below which a gateway sits
+    # a fine-tune out; z / percentile the drift threshold (in calib-std
+    # units) and verdict percentile the flywheel serving front runs —
+    # percentile is deliberately HIGH (99) and z deliberately LOW (1.5)
+    # relative to the plain serving defaults, so drifting-but-still-
+    # plausible rows keep feeding the buffer while the monitor flags the
+    # mean shift early (DESIGN.md §17 on why admission and detection
+    # must not share one threshold); shift is the --flywheel smoke's
+    # injected covariate shift in feature stds.
+    flywheel_buffer_size: int = 512
+    flywheel_rounds: int = 3
+    flywheel_quorum: int = 2
+    flywheel_cooldown: int = 16
+    flywheel_min_rows: int = 64
+    flywheel_z: float = 1.5
+    flywheel_percentile: float = 99.0
+    flywheel_shift: float = 1.5
     # Client-state residency layout (DESIGN.md §16; ROADMAP item 2):
     #   'dense'  — the pre-PR-11 layout: every client's params + f32 Adam
     #              moments device-resident as [N, ...] stacked trees; the

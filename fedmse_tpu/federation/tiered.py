@@ -313,17 +313,14 @@ class TieredRoundEngine:
         precomputed [T, n_real] timelines gathered at the cohort's ABSOLUTE
         ids.
 
-        The ELASTIC timeline is fold_in-per-slot (PARITY.md §8), so the
-        gather preserves each slot's stream exactly and it matches the
-        dense program's at any padding. The CHAOS masks are SHAPED
-        bernoulli draws over their width (a PR 3 vintage predating the
-        §8 rule): this engine draws them at n_real — padding-invariant
-        for tiered runs by construction — which matches the dense
-        program only when the dense run is unpadded (n_pad == n_real).
-        A dense run that pads its client axis draws a DIFFERENT chaos
-        stream for the same seed, dense-vs-dense across paddings
-        included; making make_chaos_masks fold_in-per-client like the
-        elastic draws is the standing fix (ROADMAP)."""
+        Both timelines are fold_in-per-absolute-client (PARITY.md §8:
+        the elastic draws since PR 10, the chaos draws since the PR 12
+        fix of the PR 3-vintage shaped-bernoulli latent), so the gather
+        preserves each slot's stream exactly and matches the dense
+        program's at ANY padding — tiered-vs-dense and
+        dense-vs-dense-across-paddings draw one identical fault stream
+        for the same seed (padding invariance regression-pinned in
+        tests/test_chaos.py)."""
         t = plan.round_index
         rows = np.maximum(plan.ids, 0)
         pad = plan.ids < 0

@@ -1,0 +1,39 @@
+"""fedmse_tpu.flywheel — the streaming semi-supervised control loop.
+
+FedMSE's premise is semi-supervised learning on normal-only traffic
+(PAPER.md); this package turns that premise into a production control
+loop over the pieces the repo already has:
+
+    serve (serving/continuous.py)
+      -> buffer   (buffer.py: rows verdicted normal accumulate into
+                   per-gateway host reservoirs via an O(1)-per-batch
+                   intake tap)
+      -> trigger  (serving/drift.py swap_recommended, sustained over a
+                   controller quorum)
+      -> fine-tune (controller.py: a few fused federated rounds on the
+                   buffered data, warm-started from the live params —
+                   the UNCHANGED RoundEngine round body)
+      -> swap     (swap.py: params + refreshed kNN banks + refit
+                   thresholds installed through ContinuousBatcher.swap
+                   in ONE atomic call, drift monitor rebaselined,
+                   cooldown armed)
+      -> serve    (zero downtime: every in-flight ticket scores exactly
+                   once under the regime that admitted it)
+
+DESIGN.md §17 documents the dataflow, the atomicity argument, and when
+NOT to auto-fine-tune.
+"""
+
+from fedmse_tpu.flywheel.buffer import FlywheelBuffer, FinetuneData
+from fedmse_tpu.flywheel.controller import FlywheelController
+from fedmse_tpu.flywheel.harness import run_flywheel_smoke
+from fedmse_tpu.flywheel.swap import build_and_apply_swap, refit_calibration
+
+__all__ = [
+    "FlywheelBuffer",
+    "FinetuneData",
+    "FlywheelController",
+    "build_and_apply_swap",
+    "refit_calibration",
+    "run_flywheel_smoke",
+]

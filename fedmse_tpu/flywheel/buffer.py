@@ -1,0 +1,253 @@
+"""Per-gateway fresh-data reservoirs fed from the serving hot path.
+
+The flywheel's training data is the traffic the fleet just served: rows
+the deployed detector verdicted NORMAL accumulate into fixed-capacity
+host-side reservoirs, one per gateway, so a drift-triggered fine-tune
+always has a recent sample of each gateway's live normal distribution
+(the paper's semi-supervised premise — FedMSE trains on normal-only
+traffic — applied to the serving stream).
+
+Reservoir mechanics are the host twin of `knn/bank.py`'s priority trick:
+every admitted row draws a uniform priority from its gateway's OWN
+stream, and the `capacity` smallest priorities win — a reservoir-
+equivalent uniform sample over everything the gateway ever admitted, as
+one vectorized partition per (batch, gateway) instead of per-row
+bookkeeping.
+
+Determinism / padding invariance (PARITY.md §8, host edition): gateway
+g's priority stream is seeded by (seed, g) with g the ABSOLUTE gateway
+index, and consumed in g's OWN arrival order — so the reservoir contents
+depend only on (seed, g, the sequence of g's admitted rows). Growing the
+gateway axis (mesh padding), retiering the fleet, or interleaving other
+gateways' traffic differently can never perturb what gateway g retains
+(pinned by tests/test_flywheel.py).
+
+The admission tap (`tap()`) plugs into `ContinuousBatcher(intake=...)`:
+one call per harvested batch with that batch's (rows, gateways, scores,
+verdicts) arrays — O(1) python work per batch, off the per-ticket path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from fedmse_tpu.data.stacking import FederatedData
+
+
+def stack_ragged_rows(rows_list: List[np.ndarray], dim: int,
+                      width: Optional[int] = None):
+    """[N, S, D] zero-padded stack + [N, S] float row mask from ragged
+    per-gateway rows (S = `width`, default the max length, floored at 1
+    so the stacked shape stays valid) — the ONE home of the flywheel's
+    ragged-stack padding contract (build_finetune_data and the swap
+    payload's bank/centroid refresh both use it)."""
+    n = len(rows_list)
+    s = width if width is not None else max(
+        1, max((len(r) for r in rows_list), default=1))
+    x = np.zeros((n, s, dim), np.float32)
+    m = np.zeros((n, s), np.float32)
+    for g, rows in enumerate(rows_list):
+        x[g, :len(rows)] = rows
+        m[g, :len(rows)] = 1.0
+    return x, m
+
+
+@dataclasses.dataclass
+class FinetuneData:
+    """One fine-tune's worth of buffered data, split and stacked.
+
+    `data` is a regular FederatedData over the FULL gateway axis (the
+    fused round body wants static shapes); `eligible` marks the gateways
+    that actually hold enough fresh rows to train (member of the roster
+    AND >= min_rows buffered) — ineligible gateways carry zero row masks
+    and zero client_mask, are excluded from the fine-tune selection, and
+    keep their incumbent params/banks/thresholds through the swap
+    (flywheel/swap.py splices them back)."""
+
+    data: FederatedData
+    eligible: np.ndarray              # [N] bool
+    train_rows: List[np.ndarray]      # per gateway [t_g, D] (empty if not
+    valid_rows: List[np.ndarray]      # per gateway [v_g, D]  eligible)
+
+
+class FlywheelBuffer:
+    """Fixed-capacity per-gateway reservoirs of served-normal rows."""
+
+    def __init__(self, num_gateways: int, dim: int, capacity: int = 512,
+                 seed: int = 0):
+        if num_gateways < 1:
+            raise ValueError(f"num_gateways must be >= 1, got {num_gateways}")
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.num_gateways = num_gateways
+        self.dim = dim
+        self.capacity = capacity
+        self.seed = seed
+        self._rows = np.zeros((num_gateways, capacity, dim), np.float32)
+        self._pri = np.full((num_gateways, capacity), np.inf)
+        self.count = np.zeros(num_gateways, np.int64)  # valid slots
+        self.seen = np.zeros(num_gateways, np.int64)   # rows ever admitted
+        # per-gateway priority streams, created lazily on first traffic
+        # (a 100k-gateway fleet should not pay 100k Generator objects for
+        # the handful of gateways that actually see rows)
+        self._rngs: Dict[int, np.random.Generator] = {}
+
+    def _rng(self, g: int) -> np.random.Generator:
+        rng = self._rngs.get(g)
+        if rng is None:
+            # seeded by (seed, ABSOLUTE gateway index): the host analog of
+            # fold_in(key(seed), g) — gateway g's stream is independent of
+            # the axis length and of every other gateway (PARITY.md §8)
+            rng = self._rngs[g] = np.random.default_rng((self.seed, g))
+        return rng
+
+    def admit(self, rows, gateway_ids, verdicts=None, scores=None) -> int:
+        """Admit one served batch; returns the rows admitted.
+
+        `verdicts` (bool [n], True = anomalous) filters to the NORMAL
+        rows — the semi-supervised admission rule. None admits everything
+        (callers that pre-filter). `scores` is accepted for tap
+        signature compatibility and unused: admission is verdict-driven,
+        and thresholds — not raw scores — are the deployed notion of
+        normal."""
+        del scores
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        gw = np.broadcast_to(np.asarray(gateway_ids, np.int32),
+                             (rows.shape[0],))
+        if verdicts is not None:
+            keep = ~np.asarray(verdicts, bool)
+            rows, gw = rows[keep], gw[keep]
+        if not len(rows):
+            return 0
+        for g in np.unique(gw):
+            sel = gw == g
+            self._admit_one(int(g), rows[sel])
+        return len(rows)
+
+    def _admit_one(self, g: int, xs: np.ndarray) -> None:
+        pri = self._rng(g).random(len(xs))
+        cnt = int(self.count[g])
+        pool_pri = np.concatenate([self._pri[g, :cnt], pri])
+        pool_rows = np.concatenate([self._rows[g, :cnt], xs], axis=0)
+        # keep the capacity smallest priorities (the bank.py top_k trick,
+        # host-side); argsort — not argpartition — so slot order is a pure
+        # function of the priorities, never of numpy partition internals
+        order = np.argsort(pool_pri, kind="stable")[:self.capacity]
+        k = len(order)
+        self._rows[g, :k] = pool_rows[order]
+        self._pri[g, :k] = pool_pri[order]
+        self._pri[g, k:] = np.inf
+        self.count[g] = k
+        self.seen[g] += len(xs)
+
+    def tap(self):
+        """The `ContinuousBatcher(intake=...)` callable."""
+        def intake(rows, gateway_ids, scores, verdicts):
+            self.admit(rows, gateway_ids, verdicts=verdicts, scores=scores)
+        return intake
+
+    def rows_for(self, g: int) -> np.ndarray:
+        """Gateway g's current reservoir contents [count_g, D] (a copy)."""
+        return self._rows[g, :int(self.count[g])].copy()
+
+    def occupancy(self) -> Dict:
+        """JSON-safe fill telemetry (the sweep's buffer_occupancy field)."""
+        return {
+            "capacity": self.capacity,
+            "count": self.count.tolist(),
+            "seen": self.seen.tolist(),
+            "fill_fraction": float(np.mean(self.count / self.capacity)),
+        }
+
+    def clear(self, gateways=None) -> None:
+        """Drop buffered rows (all gateways, or the given subset). The
+        priority STREAMS keep advancing — a cleared gateway's future
+        retention stays deterministic."""
+        idx = (slice(None) if gateways is None
+               else np.asarray(gateways, np.int64))
+        self._pri[idx] = np.inf
+        self.count[idx] = 0
+
+    # ------------------------- fine-tune stacking ------------------------ #
+
+    def build_finetune_data(self, batch_size: int, dev_x: np.ndarray,
+                            valid_frac: float = 0.25, min_rows: int = 16,
+                            member: Optional[np.ndarray] = None
+                            ) -> FinetuneData:
+        """Stack the reservoirs into a FederatedData for the fine-tune
+        rounds (federation/rounds.py RoundEngine consumes it unchanged).
+
+        Each eligible gateway's reservoir splits train/valid by slot
+        order (slot order is already a uniform shuffle — it is priority
+        order); ineligible gateways (non-`member` under the serving
+        roster, or fewer than `min_rows` buffered) get zero row masks and
+        client_mask 0. The fine-tune has no labeled test traffic, so the
+        test tensors alias the valid split with all-normal labels —
+        per-round AUC is NaN by construction (single class) and every
+        consumer has been nan-aware since PR 10; recovery is measured by
+        the serving-side evaluation, not the fine-tune's internal metric.
+        `dev_x` is the incumbent federation's shared dev set (aggregation
+        weighting + dev-method verification need it)."""
+        if not 0.0 < valid_frac < 1.0:
+            raise ValueError(f"valid_frac must be in (0, 1), got {valid_frac}")
+        if min_rows < 2:
+            raise ValueError(f"min_rows must be >= 2 (the split needs at "
+                             f"least one train and one valid row), got "
+                             f"{min_rows}")
+        n = self.num_gateways
+        member = (np.ones(n, bool) if member is None
+                  else np.asarray(member, bool))
+        eligible = member & (self.count >= min_rows)
+        train_rows: List[np.ndarray] = []
+        valid_rows: List[np.ndarray] = []
+        for g in range(n):
+            if not eligible[g]:
+                train_rows.append(np.zeros((0, self.dim), np.float32))
+                valid_rows.append(np.zeros((0, self.dim), np.float32))
+                continue
+            rows = self.rows_for(g)
+            # clamp BOTH ends: at least one valid row, and at least one
+            # train row even when valid_frac rounds to the whole
+            # reservoir (min_rows >= 2 makes the clamp satisfiable)
+            n_valid = min(len(rows) - 1,
+                          max(1, int(round(valid_frac * len(rows)))))
+            train_rows.append(rows[:-n_valid])
+            valid_rows.append(rows[-n_valid:])
+
+        def ceil_div(a: int, b: int) -> int:
+            return -(-a // b)
+
+        def batched(rows_list, nb):
+            xb = np.zeros((n, nb, batch_size, self.dim), np.float32)
+            mb = np.zeros((n, nb, batch_size), np.float32)
+            flat_dim = nb * batch_size
+            for g, rows in enumerate(rows_list):
+                xb[g].reshape(flat_dim, self.dim)[:len(rows)] = rows
+                mb[g].reshape(flat_dim)[:len(rows)] = 1.0
+            return xb, mb
+
+        nb = max(1, max((ceil_div(len(r), batch_size) for r in train_rows),
+                        default=1))
+        nvb = max(1, max((ceil_div(len(r), batch_size) for r in valid_rows),
+                         default=1))
+        train_xb, train_mb = batched(train_rows, nb)
+        valid_xb, valid_mb = batched(valid_rows, nvb)
+        valid_x, valid_m = stack_ragged_rows(valid_rows, self.dim)
+        data = FederatedData(
+            train_xb=train_xb, train_mb=train_mb,
+            valid_xb=valid_xb, valid_mb=valid_mb,
+            valid_x=valid_x, valid_m=valid_m,
+            # no labeled test traffic mid-serve: the valid normals stand in
+            # (all labels 0 -> NaN per-round metric, docstring above)
+            test_x=valid_x, test_m=valid_m,
+            test_y=np.zeros(valid_m.shape, np.float32),
+            dev_x=np.asarray(dev_x, np.float32),
+            client_mask=eligible.astype(np.float32),
+        )
+        return FinetuneData(data=data, eligible=eligible,
+                            train_rows=train_rows, valid_rows=valid_rows)

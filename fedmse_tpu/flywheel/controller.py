@@ -1,0 +1,249 @@
+"""FlywheelController: drift verdict -> incremental federated fine-tune.
+
+The controller is the loop's host-side brain. It polls the serving
+front's `DriftMonitor` (already debounced: drifted AND sustained
+`min_batches` updates per gateway); when any gateway's recommendation
+additionally survives `quorum` consecutive controller polls — two
+debounce stages, so neither a score burst nor a single noisy monitor
+window can launch training — it runs an incremental federated fine-tune
+and installs the result through the atomic swap (flywheel/swap.py).
+
+The fine-tune is the EXISTING federation, not a new trainer:
+
+  * data — the per-gateway fresh-normal reservoirs
+    (flywheel/buffer.py), stacked into an ordinary FederatedData;
+  * engine — a `RoundEngine` over the unchanged fused round body
+    (select -> train -> vote -> aggregate -> broadcast -> verify), a few
+    rounds at full participation of the ELIGIBLE cohort;
+  * warm start — the live serving params (or an explicit f32 checkpoint
+    tree via `params=`, the `checkpointing.load_client_models` path):
+    params AND prev_global start at the incumbent weights, Adam moments
+    fresh — exactly an elastic join's state discipline, applied
+    fleet-wide;
+  * roster honor — gateways outside the serving roster are excluded
+    (their buffers are ignored and their incumbent rows pass through
+    the swap untouched); slots the roster recycled since the controller
+    last looked (generation advanced) warm-start from the incumbent
+    MEAN of the member fleet, exactly like an elastic join inherits the
+    global model, never from the departed tenant's weights.
+
+Anti-thrash: the monitor's own `cooldown_updates` is armed by the
+swap's rebaseline (serving/drift.py), and the controller layers
+`cooldown_polls` on top so even a monitor misconfigured with zero
+cooldown cannot re-trigger before the post-swap distribution settles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from fedmse_tpu.utils.logging import get_logger
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+logger = get_logger(__name__)
+
+# fine-tune RNG streams must never collide with a real training run's
+# (run seeds stride by run_seed_stride from 0): offset the run index into
+# its own range, strided by swap ordinal so successive fine-tunes draw
+# independent streams
+_FINETUNE_RUN_OFFSET = 90_000
+
+
+class FlywheelController:
+    """Watches the drift monitor; fine-tunes and swaps when it sustains."""
+
+    def __init__(self, batcher, monitor, buffer, model, model_type: str,
+                 update_type: str, cfg, dev_x, *, rounds: int = 3,
+                 quorum: int = 2, cooldown_polls: int = 8,
+                 min_rows: int = 16, valid_frac: float = 0.25,
+                 epochs: Optional[int] = None, clear_on_swap: bool = True):
+        self.batcher = batcher
+        self.monitor = monitor
+        self.buffer = buffer
+        self.model = model
+        self.model_type = model_type
+        self.update_type = update_type
+        self.cfg = cfg
+        self.dev_x = np.asarray(dev_x, np.float32)
+        if rounds < 1:
+            raise ValueError(f"rounds must be >= 1, got {rounds}")
+        if quorum < 1:
+            raise ValueError(f"quorum must be >= 1, got {quorum}")
+        self.rounds = rounds
+        self.quorum = quorum
+        self.cooldown_polls = cooldown_polls
+        self.min_rows = min_rows
+        self.valid_frac = valid_frac
+        self.epochs = epochs if epochs is not None else cfg.epochs
+        # clear_on_swap drops the reservoirs once a fine-tune consumed
+        # them: each fine-tune then trains on rows admitted SINCE the
+        # previous swap — recency by construction, so under sustained
+        # drift successive fine-tunes track the walking regime instead of
+        # averaging over its whole history (a reservoir is uniform over
+        # everything it ever admitted). False keeps the long-memory
+        # reservoir (the right call when drift is episodic, not a walk).
+        self.clear_on_swap = clear_on_swap
+        n = batcher.engine.num_gateways
+        self._poll_streak = np.zeros(n, np.int64)
+        self._cooldown = 0
+        # roster generation snapshot: slots whose generation advances past
+        # this were re-tenanted since the last fine-tune — they warm-start
+        # from the incumbent mean, not the previous tenant's weights
+        roster = getattr(batcher.engine, "roster", None)
+        self._gen_seen = (None if roster is None
+                          else roster.generation.copy())
+        self.events: List[Dict] = []
+        self.polls = 0
+
+    # ------------------------------- loop -------------------------------- #
+
+    def poll(self) -> Optional[Dict]:
+        """One control tick (call between flushes / on a timer): advances
+        the quorum streaks and, if the trigger fires, runs the fine-tune
+        and swap synchronously. Returns the swap event, or None."""
+        self.polls += 1
+        rec = np.asarray(self.monitor.swap_recommended(), bool)
+        self._poll_streak = np.where(rec, self._poll_streak + 1, 0)
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        flagged = np.flatnonzero(self._poll_streak >= self.quorum)
+        if not len(flagged):
+            return None
+        return self.trigger(flagged)
+
+    def trigger(self, flagged) -> Optional[Dict]:
+        """Fine-tune + atomic swap for a sustained drift verdict on the
+        `flagged` gateways. Returns the swap event (None if the buffers
+        cannot support a fine-tune yet — the controller then backs off
+        `cooldown_polls` so it doesn't spin on an empty buffer)."""
+        t0 = time.perf_counter()
+        roster = getattr(self.batcher.engine, "roster", None)
+        member = None if roster is None else roster.member
+        finetune = self.buffer.build_finetune_data(
+            self.cfg.batch_size, self.dev_x, valid_frac=self.valid_frac,
+            min_rows=self.min_rows, member=member)
+        flagged = np.asarray(flagged, np.int64)
+        if not finetune.eligible.any() \
+                or not finetune.eligible[flagged].any():
+            logger.info(
+                "flywheel trigger on gateways %s suppressed: no eligible "
+                "buffer (>= %d fresh-normal rows needed); backing off %d "
+                "polls", flagged.tolist(), self.min_rows,
+                self.cooldown_polls)
+            self._cooldown = self.cooldown_polls
+            return None
+        new_params, ft_metrics = self._finetune(finetune)
+        from fedmse_tpu.flywheel.swap import build_and_apply_swap
+        event = build_and_apply_swap(
+            self.batcher, self.model, finetune, new_params,
+            extra_event={
+                "trigger_gateways": flagged.tolist(),
+                "finetune_rounds": self.rounds,
+                "finetune_seconds": round(time.perf_counter() - t0, 4),
+                "finetune_metrics": ft_metrics,
+                "buffer": self.buffer.occupancy(),
+            })
+        # post-swap hygiene: streaks restart (the monitor was rebaselined
+        # inside the swap and arms its own cooldown_updates), the
+        # controller backs off, and the roster generations we fine-tuned
+        # under become the new baseline
+        self._poll_streak[:] = 0
+        self._cooldown = self.cooldown_polls
+        if self.clear_on_swap:
+            self.buffer.clear()
+        if roster is not None:
+            self._gen_seen = roster.generation.copy()
+        self.events.append(event)
+        return event
+
+    # ----------------------------- fine-tune ----------------------------- #
+
+    def _warm_start(self, eligible: np.ndarray):
+        """Incumbent stacked params (host f32) with recycled slots reset
+        to the incumbent MEAN of the member fleet (the elastic-join
+        inheritance rule, federation/elastic.py)."""
+        import jax
+
+        engine = self.batcher.engine
+        incumbent = jax.tree.map(lambda t: np.asarray(t, np.float32),
+                                 jax.device_get(engine.params))
+        roster = getattr(engine, "roster", None)
+        if roster is None or self._gen_seen is None:
+            return incumbent
+        recycled = (roster.generation > self._gen_seen) & roster.member
+        if not recycled.any():
+            return incumbent
+        member = roster.member
+
+        def inherit(leaf):
+            mean = leaf[member].mean(axis=0)
+            sel = recycled.reshape((-1,) + (1,) * (leaf.ndim - 1))
+            return np.where(sel, mean, leaf)
+
+        logger.info("flywheel warm start: recycled slot(s) %s inherit the "
+                    "incumbent mean", np.flatnonzero(recycled).tolist())
+        return jax.tree.map(inherit, incumbent)
+
+    def _finetune(self, finetune):
+        """A few fused federated rounds on the buffered data, warm-started
+        from the live params. Returns (new_params host f32 tree,
+        per-round metric summaries)."""
+        import jax
+        import jax.numpy as jnp
+
+        from fedmse_tpu.federation.rounds import RoundEngine
+
+        eligible = finetune.eligible
+        selected = sorted(int(g) for g in np.flatnonzero(eligible))
+        ft_cfg = self.cfg.replace(
+            num_rounds=self.rounds,
+            epochs=self.epochs,
+            num_participants=1.0,
+            # the fine-tune verifies on the shared dev set: the buffered
+            # valid splits are thin, and the reference's quirk-6 "last
+            # client's split" could be an INELIGIBLE gateway's empty mask
+            verification_method="dev",
+            # the flywheel fine-tunes the dense in-memory cohort; tiered
+            # residency is a training-scale concern the reservoir sizes
+            # never reach (capacity x gateways rows total)
+            state_layout="dense",
+        )
+        rngs = ExperimentRngs(
+            run=_FINETUNE_RUN_OFFSET + len(self.events),
+            data_seed=self.cfg.data_seed,
+            run_seed_stride=self.cfg.run_seed_stride)
+        engine = RoundEngine(self.model, ft_cfg, finetune.data,
+                             n_real=self.buffer.num_gateways, rngs=rngs,
+                             model_type=self.model_type,
+                             update_type=self.update_type, fused=True)
+        warm = self._warm_start(eligible)
+        # warm's host leaves can zero-copy-ALIAS the live serving
+        # engine's resident params (device_get + asarray on CPU), and
+        # the fused round program DONATES its states — donating memory
+        # the array does not own is the use-after-free class documented
+        # in federation/state.py / tiered.py, so force device-owned
+        # copies before they enter the donating program
+        warm_dev = jax.tree.map(lambda t: jnp.array(t, copy=True), warm)
+        # the elastic-join state discipline fleet-wide: params AND
+        # prev_global at the incumbent weights, Adam moments fresh (they
+        # are zero from init), verifier history empty
+        engine.states = dataclasses.replace(
+            engine.states, params=warm_dev,
+            prev_global=jax.tree.map(jnp.copy, warm_dev))
+        metrics = []
+        for r in range(self.rounds):
+            result = engine.run_round_fused(r, selected=selected)
+            metrics.append({
+                "round": r,
+                "aggregator": result.aggregator,
+                "mean_min_valid": float(np.nanmean(
+                    result.min_valid[eligible])),
+            })
+        new_params = jax.tree.map(lambda t: np.asarray(t, np.float32),
+                                  jax.device_get(engine.states.params))
+        return new_params, metrics

@@ -1,0 +1,173 @@
+"""One atomic, roster-aware swap payload: params + banks + thresholds.
+
+The flywheel's output must land in the serving front as ONE event —
+installing refreshed params without their matching thresholds would
+verdict the first post-swap batches against a threshold fit under the
+old model (systematic false positives or silent misses until the
+calibration catches up), and refreshing a kNN bank without the params
+that encoded it would measure distances in a stale latent space. So the
+payload is built completely BEFORE anything is installed:
+
+  1. splice: fine-tuned params for eligible gateways, incumbent rows for
+     everyone else (left gateways, under-buffered gateways) — a gateway
+     the fine-tune never touched must serve exactly what it served
+     before;
+  2. score-deciding state rides along: kNN banks reservoir-merge the
+     buffered fresh latents under the NEW params
+     (`knn.build_banks(existing=...)`), centroid engines refit their
+     per-gateway centroids on the same rows;
+  3. thresholds: each eligible gateway's buffered validation normals are
+     scored against the CANDIDATE state (`ServingEngine.score_candidate`
+     — the operand-state trick, nothing installed, zero retrace) and its
+     threshold/mean/std refit (`refit_calibration`, the vectorized
+     `ServingCalibration.refit`);
+  4. install: ONE `ContinuousBatcher.swap(params=..., banks=...,
+     centroids=..., calibration=...)` — batches in flight keep the old
+     regime, the forming batch dispatches under the new one, the drift
+     monitor rebaselines and arms its post-swap cooldown
+     (serving/drift.py `cooldown_updates`), and zero tickets are dropped
+     or re-scored (the PR 8 swap contract, re-pinned with the full
+     payload in tests/test_flywheel.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+from fedmse_tpu.serving.calibration import ServingCalibration
+from fedmse_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def refit_calibration(base: ServingCalibration,
+                      scores_by_gateway: Dict[int, np.ndarray]
+                      ) -> ServingCalibration:
+    """One COPY of `base` with each given gateway's threshold/mean/std/
+    count refit on fresh normal scores — the vectorized form of chaining
+    `ServingCalibration.refit` per gateway (one copy, not one per
+    gateway). Gateways not in the dict keep their incumbent calibration
+    untouched."""
+    from fedmse_tpu.serving.calibration import refit_row
+
+    thresholds = base.thresholds.copy()
+    mean, std = base.mean.copy(), base.std.copy()
+    count = base.count.copy()
+    for g, scores in scores_by_gateway.items():
+        thresholds[g], mean[g], std[g], count[g] = refit_row(
+            scores, base.percentile)
+    return ServingCalibration(percentile=base.percentile,
+                              thresholds=thresholds, mean=mean, std=std,
+                              count=count, model_type=base.model_type)
+
+
+def _splice(eligible: np.ndarray, new_tree, old_tree):
+    """Per-gateway select: row g from `new_tree` where eligible[g], else
+    from `old_tree` (leaves [N, ...]; result f32 numpy)."""
+    import jax
+
+    def pick(new_leaf, old_leaf):
+        new_leaf = np.asarray(new_leaf, np.float32)
+        old_leaf = np.asarray(old_leaf, np.float32)
+        sel = eligible.reshape((-1,) + (1,) * (new_leaf.ndim - 1))
+        return np.where(sel, new_leaf, old_leaf)
+
+    return jax.tree.map(pick, new_tree, old_tree)
+
+
+def build_and_apply_swap(batcher, model, finetune, new_params,
+                         extra_event: Optional[Dict] = None) -> Dict:
+    """Build the full payload from a finished fine-tune and install it
+    through ONE `batcher.swap` call (module docstring). Returns the swap
+    event, extended with the flywheel's bookkeeping.
+
+    `finetune` is the FinetuneData the fine-tune trained on (its
+    train/valid splits are the bank-refresh and threshold-refit rows);
+    `new_params` the fine-tuned stacked tree (host f32); `model` the flax
+    module (encoding for banks/centroids)."""
+    import jax
+    import jax.numpy as jnp
+
+    engine = batcher.engine
+    eligible = finetune.eligible
+    if not eligible.any():
+        raise ValueError("swap payload: no eligible gateway (nothing was "
+                         "fine-tuned)")
+    incumbent = jax.tree.map(lambda t: np.asarray(t, np.float32),
+                             jax.device_get(engine.params))
+    payload_params = _splice(eligible, new_params, incumbent)
+    params_dev = jax.tree.map(jnp.asarray, payload_params)
+
+    # bank-refresh / centroid-refit sample = the TRAIN split only: the
+    # valid rows are about to be scored against this very state to fit
+    # the post-swap thresholds, and a valid row merged into the bank
+    # would self-match at ~zero latent distance — biasing its kth-NN
+    # score (and the refit percentile) low, i.e. a post-swap
+    # false-positive rate above the configured one. Held out, the
+    # threshold fit sees the same unseen-row geometry live traffic will.
+    from fedmse_tpu.flywheel.buffer import stack_ragged_rows
+    fresh_x, fresh_m = stack_ragged_rows(finetune.train_rows, engine.dim)
+
+    banks_payload = None
+    if engine.score_kind == "knn" and engine.banks is not None:
+        from fedmse_tpu.knn import build_banks
+        old = jax.device_get(engine.banks)
+        merged = build_banks(model, params_dev, fresh_x, fresh_m,
+                             existing=old)
+        # ineligible gateways keep their bank EXACTLY (a resample of the
+        # retained slots would be distribution-preserving but not
+        # bit-preserving, and an untouched gateway must serve untouched
+        # state)
+        merged = jax.device_get(merged)
+        sel3 = eligible[:, None, None]
+        banks_payload = dataclasses.replace(
+            merged,
+            latents=np.where(sel3, np.asarray(merged.latents),
+                             np.asarray(old.latents)),
+            count=np.where(eligible, np.asarray(merged.count),
+                           np.asarray(old.count)))
+
+    centroids_payload = None
+    if engine.score_kind == "centroid" and engine.centroids is not None:
+        from fedmse_tpu.serving.engine import fit_gateway_centroids
+        refit = fit_gateway_centroids(model, params_dev, fresh_x, fresh_m)
+        centroids_payload = _splice(eligible, jax.device_get(refit),
+                                    jax.device_get(engine.centroids))
+
+    # thresholds fit on CANDIDATE scores: what the post-swap engine will
+    # actually produce for each gateway's held-out validation normals —
+    # ONE batched dispatch for the whole eligible set (score_candidate
+    # routes per row), split back per gateway for the refit. The payload
+    # is validated/placed here AND again inside batcher.swap — accepted:
+    # one extra host->device copy per swap EVENT keeps swap_state's API
+    # the plain host-tree one every other caller uses.
+    candidate = engine.candidate_state(
+        params=payload_params, banks=banks_payload,
+        centroids=centroids_payload)
+    gateways = [int(g) for g in np.flatnonzero(eligible)]
+    counts = [len(finetune.valid_rows[g]) for g in gateways]
+    all_rows = np.concatenate([finetune.valid_rows[g] for g in gateways])
+    all_gws = np.repeat(np.asarray(gateways, np.int32), counts)
+    all_scores = engine.score_candidate(candidate, all_rows, all_gws)
+    bounds = np.cumsum(counts)[:-1]
+    scores_by_gateway: Dict[int, np.ndarray] = dict(
+        zip(gateways, np.split(all_scores, bounds)))
+    calibration = refit_calibration(batcher.calibration, scores_by_gateway)
+
+    event = batcher.swap(params=payload_params, banks=banks_payload,
+                         centroids=centroids_payload,
+                         calibration=calibration)
+    event["flywheel"] = {
+        "eligible_gateways": np.flatnonzero(eligible).tolist(),
+        "refit_thresholds": {g: float(calibration.thresholds[g])
+                             for g in scores_by_gateway},
+        "bank_refreshed": banks_payload is not None,
+        "centroids_refreshed": centroids_payload is not None,
+        **(extra_event or {}),
+    }
+    logger.info("flywheel swap installed: %s (gateways %s)",
+                event["kinds"], event["flywheel"]["eligible_gateways"])
+    return event

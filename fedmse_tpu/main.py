@@ -533,7 +533,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                    batch_runs: bool = False,
                    serve: bool = False, serve_rows: int = 2048,
                    serve_warmup: bool = False,
-                   serve_continuous: bool = False) -> Dict:
+                   serve_continuous: bool = False,
+                   flywheel: bool = False) -> Dict:
     """The full sweep (src/main.py:108-399) -> training summary dict.
 
     `serve=True` appends a serving smoke pass (fedmse_tpu/serving/): the
@@ -542,7 +543,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
     through the micro-batched bucketed scorer with drift monitoring; the
     report lands under the returned dict's "serve_smoke" key.
     `serve_continuous=True` streams through the continuous-batching front
-    (serving/continuous.py) instead of the synchronous micro-batcher."""
+    (serving/continuous.py) instead of the synchronous micro-batcher.
+    `flywheel=True` appends the closed-loop smoke (fedmse_tpu/flywheel/):
+    the checkpointed federation serves a drifting stream through the
+    continuous front with the reservoir tap + controller attached, and
+    the report — swap events, ticket integrity, stale-vs-adapted AUC —
+    lands under "flywheel_smoke"."""
     mesh = None
     pad_multiple = None
     if use_mesh and len(jax.devices()) > 1:
@@ -659,6 +665,18 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                 max_rows=serve_rows, max_batch=cfg.serve_max_batch,
                 max_wait_ms=cfg.serve_latency_budget_ms,
                 warmup=serve_warmup, continuous=serve_continuous)
+    if flywheel:
+        if not save_checkpoints:
+            logger.warning("--flywheel needs the checkpointed ClientModel "
+                           "tree (run without --no-save); skipping the "
+                           "closed-loop smoke")
+        else:
+            from fedmse_tpu.flywheel import run_flywheel_smoke
+            out["flywheel_smoke"] = run_flywheel_smoke(
+                cfg, data, n_real, writer, device_names,
+                model_type=cfg.model_types[0],
+                update_type=cfg.update_types[0], run=0,
+                max_rows=serve_rows)
     return out
 
 
@@ -698,8 +716,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "with adaptive bucket selection and drift-triggered"
                         " hot swap) instead of the synchronous "
                         "wait-then-flush micro-batcher")
-    # (--serve-max-batch / --serve-latency-budget-ms ride in free via
-    # config.add_cli_overrides: they are ExperimentConfig fields)
+    p.add_argument("--flywheel", action="store_true",
+                   help="after the sweep, run the closed-loop flywheel "
+                        "smoke (fedmse_tpu/flywheel/): rebuild the serving "
+                        "front from the first combination's checkpoint "
+                        "with the fresh-data reservoir tap + controller "
+                        "attached, stream a gradually drifting test "
+                        "stream, and report the drift-triggered federated "
+                        "fine-tune + zero-downtime hot swap (stale vs "
+                        "adapted AUC, ticket integrity, swap events)")
+    # (--serve-max-batch / --serve-latency-budget-ms, and every
+    # --flywheel-* knob, ride in free via config.add_cli_overrides: they
+    # are ExperimentConfig fields)
     p.add_argument("--no-pipeline", action="store_true",
                    help="disable pipelined chunk execution (federation/"
                         "pipeline.py) and run the serial chunk loop: "
@@ -858,7 +886,8 @@ def main(argv: Optional[List[str]] = None) -> Dict:
                           batch_runs=args.batch_runs,
                           serve=args.serve, serve_rows=args.serve_rows,
                           serve_warmup=args.serve_warmup,
-                          serve_continuous=args.serve_continuous)
+                          serve_continuous=args.serve_continuous,
+                          flywheel=args.flywheel)
 
 
 def cli() -> int:

@@ -24,6 +24,20 @@ from typing import Optional
 import numpy as np
 
 
+def refit_row(scores, percentile: float):
+    """(threshold, mean, std, count) of one gateway's fresh normal
+    scores — the ONE home of the refit formula, shared by the
+    single-gateway `ServingCalibration.refit` and the flywheel's batch
+    `refit_calibration` (flywheel/swap.py) so the two hot-swap payload
+    builders can never desynchronize."""
+    scores = np.asarray(scores, np.float64)
+    if scores.size == 0:
+        raise ValueError("refit needs at least one normal score")
+    return (float(np.percentile(scores, percentile)),
+            float(np.mean(scores)), float(np.std(scores)),
+            int(scores.size))
+
+
 @dataclasses.dataclass
 class ServingCalibration:
     """Fitted per-gateway detector state (numpy, host-side)."""
@@ -58,17 +72,12 @@ class ServingCalibration:
         the flagged gateway and install the refit copy; every other
         gateway's calibration is untouched. The copy leaves `self` alone
         so batches already dispatched keep their snapshot."""
-        scores = np.asarray(scores, np.float64)
-        if scores.size == 0:
-            raise ValueError("refit needs at least one normal score")
         pct = self.percentile if percentile is None else percentile
         thresholds = self.thresholds.copy()
         mean, std = self.mean.copy(), self.std.copy()
         count = self.count.copy()
-        thresholds[gateway] = float(np.percentile(scores, pct))
-        mean[gateway] = float(np.mean(scores))
-        std[gateway] = float(np.std(scores))
-        count[gateway] = scores.size
+        (thresholds[gateway], mean[gateway], std[gateway],
+         count[gateway]) = refit_row(scores, pct)
         return ServingCalibration(percentile=self.percentile,
                                   thresholds=thresholds, mean=mean, std=std,
                                   count=count, model_type=self.model_type)

@@ -61,7 +61,7 @@ class BatchRecord:
     """One batch's shared result arrays (filled at harvest time)."""
 
     __slots__ = ("pend", "enq", "gws", "calibration", "drift", "n", "done",
-                 "scores", "verdicts", "lat")
+                 "scores", "verdicts", "lat", "rows", "intake")
 
     def __init__(self):
         self.pend = None          # PendingScores once dispatched
@@ -74,6 +74,8 @@ class BatchRecord:
         self.scores = None        # [n] f32, at harvest
         self.verdicts = None      # [n] bool or None
         self.lat = None           # [n] seconds, at harvest
+        self.rows = None          # [n, D] f32 — retained ONLY for an intake
+        self.intake = None        # tap snapshot at dispatch (flywheel)
 
 
 class StreamTicket(tuple):
@@ -228,12 +230,23 @@ class ContinuousBatcher:
     batch exactly like the sync batcher. `stats_window` bounds the latency
     window (percentiles and the windowed wall throughput describe the most
     recent ~stats_window rows; totals are exact lifetime counters).
+
+    `intake` is the flywheel's admission tap (fedmse_tpu/flywheel/): a
+    callable `(rows, gateway_ids, scores, verdicts)` invoked ONCE per
+    harvested batch with that batch's arrays — O(1) python per batch,
+    entirely off the per-ticket path, and downstream of the dispatch (a
+    slow tap delays only the host's bookkeeping half of the double
+    buffer, never the device). With an intake installed the batch's row
+    buffer is retained until its harvest (then dropped); with the
+    default None nothing is retained and the front's behavior is
+    byte-identical to an intake-free one (pinned by
+    tests/test_flywheel.py).
     """
 
     def __init__(self, engine, max_batch: int = 1024,
                  latency_budget_ms: float = 5.0, calibration=None,
                  drift=None, clock: Callable[[], float] = time.perf_counter,
-                 stats_window: int = 100_000):
+                 stats_window: int = 100_000, intake=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_batch > engine.max_bucket:
@@ -244,6 +257,7 @@ class ContinuousBatcher:
         self.budget_s = latency_budget_ms / 1000.0
         self.calibration = calibration
         self.drift = drift
+        self.intake = intake
         self.clock = clock
         self.stats_window = stats_window
         # forming bucket (host side), packed into ONE six-slot list the
@@ -445,6 +459,12 @@ class ContinuousBatcher:
         rec.n = rows.shape[0]
         rec.calibration = self.calibration  # verdict snapshot at dispatch
         rec.drift = self.drift              # drift sink for THIS regime
+        if self.intake is not None:
+            # the flywheel tap needs the batch's ROWS at harvest; retain
+            # them only while an intake is installed (snapshot like the
+            # calibration, so a mid-flight rebind stays per-batch atomic)
+            rec.rows = rows
+            rec.intake = self.intake
         hot[0], hot[2], hot[4], hot[5] = [], None, 0, False
         t0 = self.clock()
         rec.pend = self.engine.dispatch(rows, rec.gws)
@@ -506,6 +526,12 @@ class ContinuousBatcher:
             # (swap()), so scores produced under the old regime never
             # seed the new baseline's moments
             rec.drift.update(scores, rec.gws)
+        if rec.intake is not None:
+            # flywheel admission tap: one vectorized call per batch, then
+            # the row buffer is released (nothing retains it past here)
+            rec.intake(rec.rows, rec.gws, scores, rec.verdicts)
+            rec.rows = None
+            rec.intake = None
         rec.lat = t1 - rec.enq
         rec.done = True
         self.rows_served += rec.n

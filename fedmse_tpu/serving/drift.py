@@ -37,15 +37,26 @@ class DriftMonitor:
     acts on (serving/continuous.py swap), debounced so one anomalous
     burst does not churn checkpoints. The field is computed entirely
     here, so the trigger is testable without an engine in the loop.
+
+    `cooldown_updates` is the post-swap hysteresis (the flywheel's
+    anti-thrash guard, fedmse_tpu/flywheel/, but useful standalone):
+    after a `rebaseline`, `swap_recommended` stays suppressed for that
+    many further `update()` calls carrying each gateway's traffic, so a
+    swap that lands while the live distribution is still settling (or a
+    marginally-wrong recalibration) cannot immediately re-trigger the
+    swap it just performed. Drift DETECTION (`drifted`, `shift`) is not
+    suppressed — only the recommendation — so telemetry keeps seeing the
+    truth during the cooldown.
     """
 
     def __init__(self, calibration: ServingCalibration,
                  z_threshold: float = 3.0, min_count: int = 30,
-                 min_batches: int = 3):
+                 min_batches: int = 3, cooldown_updates: int = 0):
         self.calibration = calibration
         self.z_threshold = z_threshold
         self.min_count = min_count
         self.min_batches = min_batches
+        self.cooldown_updates = cooldown_updates
         n = calibration.num_gateways
         self.count = np.zeros(n, np.int64)
         self.mean = np.zeros(n)
@@ -53,6 +64,16 @@ class DriftMonitor:
         # consecutive drifted updates (per gateway, counting only updates
         # that carried that gateway's rows)
         self._streak = np.zeros(n, np.int64)
+        # post-rebaseline hysteresis: >= 0 means the gateway is inside
+        # its cooldown (decremented only by updates carrying its
+        # traffic, floored at -1; -1 = cooldown over / never armed, so a
+        # freshly built monitor is NOT suppressed)
+        self._cooldown = np.full(n, -1, np.int64)
+        # update()-call counter + the count at the last rebaseline (None
+        # until one happens) — report() surfaces both so an operator can
+        # see how fresh the current baseline is
+        self.updates = 0
+        self.last_rebaseline = None
 
     def update(self, scores, gateway_ids=None) -> None:
         """Absorb one served batch of scores (+ per-row gateway ids)."""
@@ -81,6 +102,12 @@ class DriftMonitor:
         drifted = self.drifted()
         self._streak[present] = np.where(drifted[present],
                                          self._streak[present] + 1, 0)
+        # cooldown ticks on the same evidence basis as the streak: only
+        # updates carrying a gateway's traffic count it down. Armed at
+        # cooldown_updates by rebaseline(), it stays >= 0 — suppressing
+        # the recommendation — for exactly cooldown_updates such updates
+        self._cooldown[present] = np.maximum(self._cooldown[present] - 1, -1)
+        self.updates += 1
 
     def live_std(self) -> np.ndarray:
         with np.errstate(invalid="ignore", divide="ignore"):
@@ -107,10 +134,12 @@ class DriftMonitor:
                 & (z > self.z_threshold))
 
     def swap_recommended(self) -> np.ndarray:
-        """[N] bool: drifted AND sustained for min_batches updates — the
-        debounced hot-swap trigger (recalibrate / refresh bank / pull a
-        newer checkpoint, serving/continuous.py swap)."""
-        return self.drifted() & (self._streak >= self.min_batches)
+        """[N] bool: drifted AND sustained for min_batches updates AND out
+        of the post-rebaseline cooldown — the debounced hot-swap trigger
+        (recalibrate / refresh bank / pull a newer checkpoint,
+        serving/continuous.py swap; the flywheel controller's input)."""
+        return (self.drifted() & (self._streak >= self.min_batches)
+                & (self._cooldown < 0))
 
     def rebaseline(self, calibration: ServingCalibration,
                    reset: bool = True) -> None:
@@ -125,6 +154,12 @@ class DriftMonitor:
                 f"{calibration.num_gateways} gateways, monitor tracks "
                 f"{self.calibration.num_gateways}")
         self.calibration = calibration
+        self.last_rebaseline = self.updates
+        # arm the anti-thrash hysteresis: no swap recommendation for the
+        # next cooldown_updates updates per gateway (class docstring);
+        # 0 = feature off, nothing armed
+        self._cooldown[:] = (self.cooldown_updates
+                             if self.cooldown_updates > 0 else -1)
         if reset:
             self.count[:] = 0
             self.mean[:] = 0.0
@@ -152,12 +187,16 @@ class DriftMonitor:
                 "calibrated": bool(cal.count[g] > 0),
                 "drifted": bool(drifted[g]),
                 "drift_streak": int(self._streak[g]),
+                "cooldown_remaining": int(max(self._cooldown[g], 0)),
                 "swap_recommended": bool(recommended[g]),
             })
         return {
             "z_threshold": self.z_threshold,
             "min_count": self.min_count,
             "min_batches": self.min_batches,
+            "cooldown_updates": self.cooldown_updates,
+            "updates": self.updates,
+            "last_rebaseline": self.last_rebaseline,
             "drifted_gateways": [int(g) for g in np.nonzero(drifted)[0]],
             "swap_recommended_gateways": [int(g) for g in
                                           np.nonzero(recommended)[0]],

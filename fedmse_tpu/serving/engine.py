@@ -443,24 +443,11 @@ class ServingEngine:
                 f"alongside the recycled slot's params/banks/calibration "
                 f"if the slot was re-tenanted")
 
-    def swap_state(self, *, params=None, centroids=None, banks=None,
-                   roster=None) -> Dict:
-        """Atomically install a new checkpoint / centroids / kNN banks /
-        membership roster.
-
-        The replacement becomes the operand of the NEXT dispatch; batches
-        already in flight captured the old state dict and are unaffected
-        (PendingScores docstring) — so a swap between dispatches drops or
-        re-scores nothing. Shapes/dtypes/tree structure must match the
-        resident state: jit keys its executable cache on them, so a
-        matching swap is a pointer flip with ZERO retrace or recompile
-        (pinned by tests/test_continuous.py via _cache_size). A refreshed
-        bank may change its slot capacity (the one legitimate reshape —
-        buckets then lazily recompile, logged); anything else mismatched
-        means the payload came from a different federation and fails loud.
-
-        Returns a small dict describing what was swapped (for serving
-        telemetry)."""
+    def _merge_state(self, *, params=None, centroids=None, banks=None):
+        """Validated, device-placed copy of the resident state dict with
+        the given components replaced — the shared payload builder of
+        `swap_state` (which installs it) and `candidate_state` (which
+        does not). Returns (new_state, swapped_component_names)."""
         new = dict(self._state)
         swapped = []
         if params is not None:
@@ -497,6 +484,28 @@ class ServingEngine:
                             banks.bank_size)
             new["banks"] = self._place_state(banks)
             swapped.append("banks")
+        return new, swapped
+
+    def swap_state(self, *, params=None, centroids=None, banks=None,
+                   roster=None) -> Dict:
+        """Atomically install a new checkpoint / centroids / kNN banks /
+        membership roster.
+
+        The replacement becomes the operand of the NEXT dispatch; batches
+        already in flight captured the old state dict and are unaffected
+        (PendingScores docstring) — so a swap between dispatches drops or
+        re-scores nothing. Shapes/dtypes/tree structure must match the
+        resident state: jit keys its executable cache on them, so a
+        matching swap is a pointer flip with ZERO retrace or recompile
+        (pinned by tests/test_continuous.py via _cache_size). A refreshed
+        bank may change its slot capacity (the one legitimate reshape —
+        buckets then lazily recompile, logged); anything else mismatched
+        means the payload came from a different federation and fails loud.
+
+        Returns a small dict describing what was swapped (for serving
+        telemetry)."""
+        new, swapped = self._merge_state(params=params, centroids=centroids,
+                                         banks=banks)
         roster_delta = None
         if roster is not None:
             if roster.num_gateways != self.num_gateways:
@@ -534,6 +543,31 @@ class ServingEngine:
         if roster_delta is not None:
             out["roster_delta"] = roster_delta
         return out
+
+    def candidate_state(self, *, params=None, centroids=None,
+                        banks=None) -> Dict[str, Any]:
+        """A validated, device-placed state dict carrying the given
+        replacements over the resident state WITHOUT installing it.
+
+        The scorer takes its state as an operand, so a candidate scores
+        through the SAME compiled programs (`score_candidate`) with zero
+        retrace while live traffic keeps dispatching against the resident
+        state — the flywheel's pre-swap step (fedmse_tpu/flywheel/swap.py):
+        fresh thresholds must be fit on scores the POST-swap engine will
+        produce, before the swap happens, or the first post-swap batches
+        would be verdicted against thresholds fit under the old model."""
+        new, swapped = self._merge_state(params=params, centroids=centroids,
+                                         banks=banks)
+        if not swapped:
+            raise ValueError("candidate_state: nothing replaced")
+        return new
+
+    def score_candidate(self, state: Dict[str, Any], x,
+                        gateway_ids=None) -> np.ndarray:
+        """`score`, but against a `candidate_state` instead of the
+        resident state — nothing is installed, in-flight dispatches are
+        untouched, and identical shapes mean zero retrace."""
+        return self.score(x, gateway_ids, state=state)
 
     @staticmethod
     def _check_swap(name: str, old, new):
@@ -696,7 +730,8 @@ class ServingEngine:
 
     # ----------------------------- scoring ------------------------------ #
 
-    def score(self, x, gateway_ids=None) -> np.ndarray:
+    def score(self, x, gateway_ids=None, *,
+              state: Optional[Dict[str, Any]] = None) -> np.ndarray:
         """Anomaly scores [B] for rows `x` [B, D] (a single row [D]
         returns its scalar score).
 
@@ -705,6 +740,8 @@ class ServingEngine:
         would silently score every row under gateway 0's model); ignored
         (and optional) on the single-global path. Requests pad up to the
         next bucket; oversize requests are chunked at max_bucket.
+        `state` scores against an uninstalled `candidate_state` instead
+        of the resident one (`score_candidate` is the documented entry).
         """
         x = np.asarray(x, dtype=np.float32)
         squeeze = x.ndim == 1
@@ -731,7 +768,7 @@ class ServingEngine:
         while start < n:
             take = min(self.max_bucket, n - start)
             pend = self._dispatch_chunk(x[start:start + take],
-                                        gw[start:start + take])
+                                        gw[start:start + take], state=state)
             out[start:start + take] = pend.harvest()
             start += take
         return out[0] if squeeze else out
@@ -777,9 +814,12 @@ class ServingEngine:
         self._check_roster(gw)
         return self._dispatch_chunk(x, gw)
 
-    def _dispatch_chunk(self, x: np.ndarray, gw: np.ndarray) -> PendingScores:
+    def _dispatch_chunk(self, x: np.ndarray, gw: np.ndarray,
+                        state: Optional[Dict[str, Any]] = None
+                        ) -> PendingScores:
         """Pad one validated [take<=max_bucket] chunk to its bucket and
-        launch it (shared by the sync `score` loop and async `dispatch`)."""
+        launch it (shared by the sync `score` loop, async `dispatch`, and
+        `score_candidate` — which passes an uninstalled `state`)."""
         take = x.shape[0]
         b = self.bucket_for(take)
         cdt = self.policy.compute_dtype
@@ -803,7 +843,7 @@ class ServingEngine:
             gp = np.zeros(b, np.int32)
             gp[:take] = gw
         xd, gd = self._place_rows(xp, gp)
-        dev = self._scorer()(self._state, xd, gd)
+        dev = self._scorer()(self._state if state is None else state, xd, gd)
         copy_async = getattr(dev, "copy_to_host_async", None)
         if copy_async is not None:
             copy_async()  # transfer starts the moment compute finishes

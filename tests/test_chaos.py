@@ -114,6 +114,27 @@ def test_masks_reproduce_from_seed_and_respect_window():
                for la, lo in zip(a, other))
 
 
+def test_masks_are_padding_invariant():
+    """PARITY.md §8 applied to the fault stream (the PR 3-vintage latent
+    fixed in PR 12): client i's draws depend only on (chaos_key, t, i),
+    so padding the client axis — a mesh-size artifact — must leave every
+    real client's faults bit-identical, and a tiered engine's n_real
+    expansion must equal the first n_real columns of a padded dense
+    one."""
+    spec = ChaosSpec(dropout_p=0.4, straggler_p=0.3, crash_p=0.3,
+                     broadcast_loss_p=0.2)
+    key = ExperimentRngs(run=0).chaos_key()
+    small = make_chaos_masks(spec, key, 0, 6, N)
+    padded = make_chaos_masks(spec, key, 0, 6, N + 5)
+    for name in ("available", "straggler", "bcast_drop"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(small, name)),
+            np.asarray(getattr(padded, name))[:, :N])
+    # the scalar crash bit is client-count-independent by construction
+    np.testing.assert_array_equal(np.asarray(small.crash),
+                                  np.asarray(padded.crash))
+
+
 def test_chaos_key_is_domain_separated():
     """Building masks must consume NOTHING from the training/eval streams:
     chaos_key is a pure fold of the run root, and the fold counter + host

@@ -83,10 +83,13 @@ def test_tickets_complete_one_flush_late_and_poll_harvests():
     t2 = [front.submit(rows[i], gws[i]) for i in range(8, 16)]
     # flushing batch 2 harvested batch 1
     assert all(t.done for t in t1) and not t2[0].done
-    # poll() harvests a ready in-flight batch without new traffic
-    for _ in range(1000):
-        if front.poll():
-            break
+    # poll() harvests a ready in-flight batch without new traffic (the
+    # wait is TIME-bounded, not iteration-bounded: a fixed poll count
+    # races the async dispatch and flakes under host load)
+    import time as _time
+    deadline = _time.perf_counter() + 10.0
+    while not front.poll() and _time.perf_counter() < deadline:
+        pass
     assert all(t.done for t in t2)
     np.testing.assert_allclose(
         [t.score for t in t1 + t2], eng.score(rows[:16], gws[:16]),

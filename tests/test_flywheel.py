@@ -1,0 +1,367 @@
+"""Flywheel control-loop tests (fedmse_tpu/flywheel/): the acceptance
+contracts pinned —
+
+  * reservoir contents are padding/layout-invariant (absolute-gateway
+    keyed priority streams, PARITY.md §8 host edition);
+  * with the flywheel disabled (no intake) the continuous front is
+    BIT-identical to one that never heard of the flywheel — and an
+    attached-but-never-triggering flywheel changes no score/verdict byte;
+  * zero dropped/duplicated tickets across a mid-load full-payload swap
+    (params + banks + thresholds in ONE call), with per-batch regime
+    atomicity;
+  * candidate-state scoring equals post-install scoring and leaves the
+    resident state untouched;
+  * DriftMonitor cooldown hysteresis + last_rebaseline telemetry;
+  * the end-to-end loop: train -> serve -> inject shift -> buffer fills
+    -> fine-tune fires -> swap lands -> detection recovers while a
+    frozen engine degrades.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedmse_tpu.config import ExperimentConfig
+from fedmse_tpu.flywheel import (FlywheelBuffer, FlywheelController,
+                                 refit_calibration)
+from fedmse_tpu.flywheel.harness import (host_auc, stream_with_polling,
+                                         ticket_integrity)
+from fedmse_tpu.models import init_stacked_params, make_model
+from fedmse_tpu.serving import (ContinuousBatcher, DriftMonitor,
+                                ServingEngine, fit_calibration)
+
+pytestmark = pytest.mark.flywheel
+
+DIM = 10
+N = 4
+
+
+def _setup(score_kind="auto", seed=0, max_bucket=64):
+    rng = np.random.default_rng(seed)
+    model = make_model("autoencoder", DIM)
+    params = init_stacked_params(model, jax.random.key(seed), N)
+    train_x = rng.normal(size=(N, 80, DIM)).astype(np.float32)
+    eng = ServingEngine.from_federation(
+        model, "autoencoder", params, train_x=train_x,
+        score_kind=score_kind, knn_bank_size=32, max_bucket=max_bucket)
+    valid_x = rng.normal(size=(N, 120, DIM)).astype(np.float32)
+    cal = fit_calibration(eng, valid_x, percentile=99.0)
+    rows = rng.normal(size=(500, DIM)).astype(np.float32)
+    gws = rng.integers(0, N, 500).astype(np.int32)
+    return model, params, train_x, eng, cal, rows, gws
+
+
+# ------------------------------ reservoir ------------------------------ #
+
+def test_buffer_padding_and_layout_invariant():
+    """Gateway g's retained rows depend only on (seed, g, g's own row
+    arrival order): growing the gateway axis and re-interleaving OTHER
+    gateways' traffic must not move a byte (PARITY.md §8)."""
+    rng = np.random.default_rng(3)
+    per_g = {g: rng.normal(size=(60, DIM)).astype(np.float32)
+             for g in range(3)}
+    a = FlywheelBuffer(3, DIM, capacity=16, seed=5)
+    b = FlywheelBuffer(9, DIM, capacity=16, seed=5)  # padded axis
+    # a: admit gateway-major; b: admit row-interleaved, wider axis —
+    # per-gateway arrival order is identical, everything else differs
+    for g in range(3):
+        a.admit(per_g[g], np.full(60, g, np.int32))
+    for start in range(0, 60, 10):
+        for g in (2, 0, 1):
+            b.admit(per_g[g][start:start + 10], np.full(10, g, np.int32))
+    for g in range(3):
+        np.testing.assert_array_equal(a.rows_for(g), b.rows_for(g))
+        assert a.count[g] == b.count[g] == 16
+        assert a.seen[g] == b.seen[g] == 60
+
+
+def test_buffer_admits_only_normal_verdicts_and_clears():
+    buf = FlywheelBuffer(2, DIM, capacity=8, seed=0)
+    rows = np.arange(6 * DIM, dtype=np.float32).reshape(6, DIM)
+    verdicts = np.asarray([False, True, False, True, True, False])
+    n = buf.admit(rows, np.zeros(6, np.int32), verdicts=verdicts)
+    assert n == 3 and buf.count[0] == 3 and buf.seen[0] == 3
+    kept = buf.rows_for(0)
+    for row in kept:  # every kept row was a normal-verdicted one
+        assert any(np.array_equal(row, rows[i]) for i in (0, 2, 5))
+    buf.clear()
+    assert buf.count[0] == 0 and buf.rows_for(0).shape == (0, DIM)
+
+
+def test_finetune_data_masks_and_eligibility():
+    buf = FlywheelBuffer(3, DIM, capacity=32, seed=0)
+    rng = np.random.default_rng(0)
+    buf.admit(rng.normal(size=(30, DIM)).astype(np.float32),
+              np.zeros(30, np.int32))
+    buf.admit(rng.normal(size=(4, DIM)).astype(np.float32),
+              np.full(4, 1, np.int32))  # below min_rows
+    member = np.asarray([True, True, False])  # gateway 2 left the roster
+    ft = buf.build_finetune_data(8, np.zeros((5, DIM), np.float32),
+                                 valid_frac=0.25, min_rows=8, member=member)
+    assert ft.eligible.tolist() == [True, False, False]
+    d = ft.data
+    assert d.client_mask.tolist() == [1.0, 0.0, 0.0]
+    # ineligible gateways carry ZERO row masks everywhere
+    for leaf in (d.train_mb, d.valid_mb, d.valid_m, d.test_m):
+        assert float(np.sum(np.asarray(leaf)[1:])) == 0.0
+    # the eligible gateway's split covers all its rows exactly once
+    assert len(ft.train_rows[0]) + len(ft.valid_rows[0]) == 30
+    assert float(np.sum(np.asarray(d.train_mb)[0])) == len(ft.train_rows[0])
+    assert float(np.sum(np.asarray(d.valid_m)[0])) == len(ft.valid_rows[0])
+
+
+# --------------------- flywheel-off bit-identity ----------------------- #
+
+def test_flywheel_off_bit_identical_to_plain_front():
+    """Pin (a): no intake == the pre-flywheel front, byte for byte; and
+    an ATTACHED but never-triggering tap changes no score/verdict byte
+    either (it only observes harvested arrays)."""
+    _, _, _, eng, cal, rows, gws = _setup()
+    plain = ContinuousBatcher(eng, max_batch=32, latency_budget_ms=1e9,
+                              calibration=cal)
+    t_plain = [plain.submit(rows[i], gws[i]) for i in range(300)]
+    plain.drain()
+
+    buf = FlywheelBuffer(N, DIM, capacity=64, seed=0)
+    tapped = ContinuousBatcher(eng, max_batch=32, latency_budget_ms=1e9,
+                               calibration=cal, intake=buf.tap())
+    t_tap = [tapped.submit(rows[i], gws[i]) for i in range(300)]
+    tapped.drain()
+
+    np.testing.assert_array_equal(
+        np.asarray([t.score for t in t_plain], np.float32),
+        np.asarray([t.score for t in t_tap], np.float32))
+    assert [t.verdict for t in t_plain] == [t.verdict for t in t_tap]
+    assert plain.stats()["dispatches"] == tapped.stats()["dispatches"]
+    # the tap actually observed the stream (normal-verdicted rows only)
+    assert buf.seen.sum() > 0
+    # ... and the no-intake record retained no row buffers
+    assert plain._inflight is None and tapped._inflight is None
+
+
+# ----------------------- candidate-state scoring ----------------------- #
+
+def test_score_candidate_matches_install_and_leaves_resident_untouched():
+    model, params, train_x, eng, cal, rows, gws = _setup()
+    params2 = init_stacked_params(model, jax.random.key(9), N)
+    before = eng.score(rows[:64], gws[:64])
+    cand = eng.candidate_state(params=params2)
+    got = eng.score_candidate(cand, rows[:64], gws[:64])
+    # resident state untouched by the candidate pass
+    np.testing.assert_array_equal(eng.score(rows[:64], gws[:64]), before)
+    assert eng.swap_count == 0
+    eng.swap_state(params=params2)
+    np.testing.assert_allclose(got, eng.score(rows[:64], gws[:64]),
+                               atol=1e-6)
+    with pytest.raises(ValueError, match="nothing replaced"):
+        eng.candidate_state()
+
+
+def test_refit_calibration_matches_chained_refit():
+    _, _, _, eng, cal, rows, gws = _setup()
+    rng = np.random.default_rng(1)
+    scores = {0: rng.normal(size=40), 2: rng.normal(size=25)}
+    vec = refit_calibration(cal, scores)
+    chained = cal.refit(0, scores[0]).refit(2, scores[2])
+    np.testing.assert_array_equal(vec.thresholds, chained.thresholds)
+    np.testing.assert_array_equal(vec.mean, chained.mean)
+    np.testing.assert_array_equal(vec.std, chained.std)
+    np.testing.assert_array_equal(vec.count, chained.count)
+    # untouched gateways keep the incumbent calibration
+    assert vec.thresholds[1] == cal.thresholds[1]
+
+
+# ------------------------- drift monitor knobs ------------------------- #
+
+def test_drift_cooldown_suppresses_recommendation_and_reports():
+    _, _, _, eng, cal, _, _ = _setup()
+    mon = DriftMonitor(cal, z_threshold=0.5, min_count=10, min_batches=2,
+                       cooldown_updates=3)
+    assert mon.report()["last_rebaseline"] is None
+    hot = cal.mean[0] + 50 * (cal.std[0] + 1.0)  # unmistakable shift
+    for _ in range(4):
+        mon.update(np.full(20, hot), np.zeros(20, np.int32))
+    assert mon.swap_recommended()[0]
+    upd = mon.updates
+    mon.rebaseline(cal)
+    assert mon.report()["last_rebaseline"] == upd
+    # drifted again immediately — but the cooldown suppresses the
+    # RECOMMENDATION (not the detection) for 3 traffic-carrying updates
+    for i in range(3):
+        mon.update(np.full(20, hot), np.zeros(20, np.int32))
+        assert not mon.swap_recommended()[0], f"update {i} in cooldown"
+    assert mon.report()["gateways"][0]["drifted"]  # detection kept seeing it
+    mon.update(np.full(20, hot), np.zeros(20, np.int32))
+    assert mon.swap_recommended()[0]  # cooldown over, streak sustained
+
+
+# --------------------- mid-load full-payload swap ---------------------- #
+
+def test_full_payload_swap_mid_load_zero_drops_and_atomic():
+    """Pin (b): a flywheel-shaped swap (params + banks + thresholds in
+    ONE call) lands between dispatches of a live stream with zero
+    dropped/duplicated tickets, old-regime batches verdicted under the
+    old calibration and new-regime batches under the new."""
+    model, params, train_x, eng, cal, rows, gws = _setup(score_kind="knn")
+    from fedmse_tpu.knn import build_banks
+    params2 = init_stacked_params(model, jax.random.key(9), N)
+    banks2 = build_banks(model, params2, train_x, bank_size=32)
+    always = refit_calibration(cal, {g: np.asarray([1e9])
+                                     for g in range(N)})  # never flags
+
+    front = ContinuousBatcher(eng, max_batch=16, latency_budget_ms=1e9,
+                              calibration=cal)
+    pre = [front.submit(rows[i], gws[i]) for i in range(24)]  # 16 in flight
+    cache = eng._score_fn._cache_size()
+    event = front.swap(params=params2, banks=banks2, calibration=always)
+    post = [front.submit(rows[i], gws[i]) for i in range(24, 48)]
+    front.drain()
+    assert sorted(event["kinds"]) == ["banks", "params", "thresholds"]
+    assert eng._score_fn._cache_size() == cache  # zero retrace
+    assert all(t.done for t in pre + post)
+    st = front.stats()
+    assert st["rows_served"] == st["rows_submitted"] == 48
+    # batch 1 (in flight at swap) scored under the OLD state + thresholds
+    eng_old = ServingEngine.from_federation(
+        model, "autoencoder", params, train_x=train_x, score_kind="knn",
+        knn_bank_size=32, max_bucket=64)
+    np.testing.assert_allclose([t.score for t in pre[:16]],
+                               eng_old.score(rows[:16], gws[:16]), atol=1e-5)
+    want_pre = cal.verdicts(eng_old.score(rows[:16], gws[:16]), gws[:16])
+    assert [t.verdict for t in pre[:16]] == list(want_pre)
+    # everything after the swap: new params+banks, thresholds never flag
+    np.testing.assert_allclose([t.score for t in post],
+                               eng.score(rows[24:48], gws[24:48]), atol=1e-5)
+    assert not any(t.verdict for t in pre[16:] + post)
+
+
+# ------------------------- end-to-end recovery ------------------------- #
+
+def _manifold_regime(seed, dim, rank=2, noise=0.2):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(rank, dim))
+    w /= np.linalg.norm(w, axis=1, keepdims=True)
+    q, _ = np.linalg.qr(w.T)
+    u = rng.normal(size=dim)
+    u -= q @ (q.T @ u)
+    u /= np.linalg.norm(u)
+
+    def normals(rng_, n, shift=0.0):
+        x = rng_.normal(size=(n, rank)) @ w \
+            + noise * rng_.normal(size=(n, dim))
+        return (x + shift * u).astype(np.float32)
+
+    return normals, u
+
+
+def test_flywheel_end_to_end_recovery():
+    """The loop: train -> serve -> inject shift -> buffer fills ->
+    fine-tune fires -> swap lands -> detection recovers while the frozen
+    engine degrades. Reduced-scale twin of drift_recovery_sweep.py."""
+    import pandas as pd
+
+    from fedmse_tpu.data import build_dev_dataset, stack_clients
+    from fedmse_tpu.data.loader import ClientData
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.parallel import host_fetch
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    dim, n_clients, behind = 10, 3, 1.25
+    normals, u = _manifold_regime(0, dim)
+    rng = np.random.default_rng(1)
+    cfg = ExperimentConfig(network_size=n_clients, dim_features=dim,
+                           epochs=4, num_rounds=2, batch_size=12)
+    clients = [ClientData(
+        name=f"fw-{i}", train_x=normals(rng, 160),
+        valid_x=normals(rng, 48),
+        test_x=normals(rng, 24), test_y=np.zeros(24, np.float32),
+        dev_raw=pd.DataFrame(normals(rng, 60)), scaler=None)
+        for i in range(n_clients)]
+    data = stack_clients(clients,
+                         build_dev_dataset(clients,
+                                           ExperimentRngs(run=0).data_rng),
+                         cfg.batch_size)
+    model = make_model("autoencoder", dim)
+    trainer = RoundEngine(model, cfg, data, n_real=n_clients,
+                          rngs=ExperimentRngs(run=0),
+                          model_type="autoencoder", update_type="mse_avg",
+                          fused=True)
+    trainer.run_rounds(0, cfg.num_rounds)
+    params = host_fetch(trainer.states.params)
+
+    def build_serving():
+        return ServingEngine.from_federation(
+            model, "autoencoder", params,
+            train_x=np.asarray(data.train_xb),
+            train_m=np.asarray(data.train_mb), max_bucket=64)
+
+    engine, frozen = build_serving(), build_serving()
+    calib = fit_calibration(engine, np.asarray(data.valid_x),
+                            np.asarray(data.valid_m), percentile=99.0)
+    monitor = DriftMonitor(calib, z_threshold=0.5, min_batches=2,
+                           cooldown_updates=2)
+    buf = FlywheelBuffer(n_clients, dim, capacity=128, seed=0)
+    front = ContinuousBatcher(engine, max_batch=32, latency_budget_ms=1e9,
+                              calibration=calib, drift=monitor,
+                              intake=buf.tap())
+    controller = FlywheelController(
+        front, monitor, buf, model, "autoencoder", "mse_avg", cfg,
+        dev_x=np.asarray(data.dev_x), rounds=2, quorum=2, cooldown_polls=2,
+        min_rows=48)
+
+    def eval_auc(score_fn, shift):
+        r = np.random.default_rng(99)
+        xs = np.concatenate([normals(r, 96, shift),
+                             normals(r, 96, -behind)])
+        ys = np.concatenate([np.zeros(96), np.ones(96)])
+        g = np.tile(np.arange(n_clients, dtype=np.int32),
+                    -(-len(xs) // n_clients))[:len(xs)]
+        return host_auc(ys, score_fn(xs, g))
+
+    auc_pre = eval_auc(engine.score, 0.0)
+    gws = np.tile(np.arange(n_clients, dtype=np.int32), 96)
+    blocks = []
+    for shift in (0.0, 0.6, 1.2, 1.8, 1.8):  # ramp, then hold
+        fresh = normals(rng, 96 * n_clients, shift)
+        bs, _ = stream_with_polling(front, controller, fresh, gws,
+                                    chunk=32)
+        blocks.extend(bs)
+
+    assert len(controller.events) >= 1, "fine-tune never fired"
+    for event in controller.events:
+        assert "params" in event["kinds"] and "thresholds" in event["kinds"]
+    integ = ticket_integrity(blocks)
+    assert integ["zero_dropped"], integ
+    st = front.stats()
+    assert st["rows_served"] == st["rows_submitted"]
+    auc_live = eval_auc(engine.score, 1.8)
+    auc_frozen = eval_auc(frozen.score, 1.8)
+    assert auc_frozen < auc_pre - 0.1, (auc_pre, auc_frozen)
+    assert auc_live > auc_frozen + 0.2, (auc_live, auc_frozen)
+    assert auc_live > 0.85, auc_live
+    # the monitor was rebaselined by the swap and says so
+    assert monitor.report()["last_rebaseline"] is not None
+
+
+def test_controller_backs_off_on_empty_buffer():
+    """A sustained drift verdict with an empty reservoir must NOT train:
+    the controller logs, backs off, and swaps nothing."""
+    model, params, train_x, eng, cal, rows, gws = _setup()
+    mon = DriftMonitor(cal, z_threshold=0.5, min_count=10, min_batches=1)
+    buf = FlywheelBuffer(N, DIM, capacity=32, seed=0)
+    front = ContinuousBatcher(eng, max_batch=16, latency_budget_ms=1e9,
+                              calibration=cal, drift=mon)
+    cfg = ExperimentConfig(network_size=N, dim_features=DIM)
+    ctl = FlywheelController(front, mon, buf, model, "autoencoder",
+                             "mse_avg", cfg, dev_x=np.zeros((4, DIM)),
+                             quorum=1, cooldown_polls=3, min_rows=16)
+    hot = cal.mean + 50 * (cal.std + 1.0)
+    for g in range(N):
+        mon.update(np.full(20, hot[g]), np.full(20, g, np.int32))
+    assert mon.swap_recommended().any()
+    assert ctl.poll() is None          # trigger suppressed: empty buffer
+    assert not ctl.events and eng.swap_count == 0
+    assert ctl._cooldown == 3          # backed off, not spinning
