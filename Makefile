@@ -9,7 +9,8 @@ LIB := fedmse_tpu/native/libfedmse_io.so
 
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
         serve-bench chaos-sweep churn-sweep pipeline-bench precision-bench \
-        shard-bench knn-bench cohort-bench flywheel-sweep tpu-check
+        shard-bench knn-bench cohort-bench flywheel-sweep net-bench \
+        tpu-check
 
 native: $(LIB)
 
@@ -105,6 +106,16 @@ cohort-bench:
 # hermetic CPU — the script pins the platform itself)
 flywheel-sweep:
 	python drift_recovery_sweep.py --out FLYWHEEL_r12.json
+
+# network serving plane (fedmse_tpu/net/, DESIGN.md §18): bursty
+# multi-client open-loop load over localhost TCP against 2 engine
+# replicas behind the roster-aware router — saturation probe, steady
+# phase with a mid-load hot swap + roster change, tiered overload with
+# shedding, remote-replica topology, cost-aware autoscaler trace
+# (writes BENCH_NET_r13_cpu.json; hermetic CPU like the tests)
+net-bench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python bench_net.py --out BENCH_NET_r13_cpu.json
 
 tpu-check:
 	python tpu_check.py

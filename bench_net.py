@@ -1,0 +1,608 @@
+"""Network serving plane benchmark: bursty multi-client open-loop load
+over localhost TCP (fedmse_tpu/net/, DESIGN.md §18).
+
+The protocol (ISSUE 13 acceptance):
+
+  1. **in-process burst baseline** — the same synthetic federation's
+     burst-admission rows/s through ONE in-process ContinuousBatcher
+     (the PR 8 column, re-measured in this artifact so the networked
+     ratio is same-box, same-day);
+  2. **saturation probe** — two OPEN-LOOP client processes, single-tier
+     (tier 0 = the guaranteed class), unthrottled against the server
+     process (2 engine replicas behind the roster-aware router +
+     admission): the scored rows/s IS the plane's sustained capacity —
+     the number the >= 0.5x in-process acceptance bar reads;
+  3. **steady phase** — the same clients throttled to ~60% of the
+     probed capacity; a hot swap (fresh params broadcast to both
+     replicas) AND an elastic roster change (gateway 9 retired) land
+     MID-LOAD. Checks: zero dropped/duplicated admitted tickets, zero
+     shedding (offered < capacity), UNKNOWN_GATEWAY verdicts for the
+     retired slot's traffic after the change, request p99 within the
+     configured budget;
+  4. **overload phase** — unthrottled with a 3-tier mix: shedding must
+     engage (offered > sustained capacity), shed lowest tier first
+     with tier 0 untouched, every row still statused exactly once;
+  5. **remote-replica topology** — a router striping over two replica
+     WORKER PROCESSES (client.RemoteReplica over the same wire), the
+     across-process half of the replication story;
+  6. **autoscaler trace** — the SLO policy + 2509.14920 cost model
+     replayed over the measured demand curve (what the plane would buy,
+     CPU vs accelerator, at each phase's arrival rate).
+
+Open-loop discipline: clients send on a fixed schedule (or saturate the
+socket in the overload phase) and read results opportunistically —
+completions never pace arrivals, so the measured system cannot set its
+own offered load. Writes BENCH_NET_r13_cpu.json (`make net-bench`).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+N_GATEWAYS = 10
+DIM = 115
+MAX_BATCH = 1024
+# the configured end-to-end request p99 budget (the plane's
+# serve_latency_budget_ms; also the staleness-shedding base unit) — a
+# network SLO, deliberately looser than the in-process smoke's 2 ms
+# forming budget
+BUDGET_MS = 50.0
+TIERS = 3
+SEED = 0
+
+
+def _flag(name, default):
+    value = default
+    for i, a in enumerate(sys.argv):
+        if a == name and i + 1 < len(sys.argv):
+            value = sys.argv[i + 1]
+        elif a.startswith(name + "="):
+            value = a.split("=", 1)[1]
+    return value
+
+
+# ----------------------------- load worker ----------------------------- #
+
+def _load_worker():
+    """Self-invoked open-loop client (`--load-worker`): stream bursts at
+    --rate rows/s (0 = saturate) for --duration seconds, read results
+    opportunistically, print one JSON line of per-status counts and
+    request latency percentiles. Rows are pregenerated; gateways cycle
+    0..N-1 INCLUDING slot 9 — after the parent's mid-load roster change
+    those rows must come back UNKNOWN_GATEWAY, not hang or kill the
+    stream."""
+    import struct
+
+    import numpy as np
+
+    from fedmse_tpu.net import wire
+    from fedmse_tpu.net.client import NetClient
+
+    port = int(_flag("--port", 0))
+    rate = float(_flag("--rate", 0.0))
+    duration = float(_flag("--duration", 6.0))
+    burst = int(_flag("--burst", MAX_BATCH))
+    use_tiers = "--tiers" in sys.argv
+    seed = int(_flag("--seed", 1))
+
+    # pre-packed frame pool: the open-loop generator's per-send work is
+    # two struct patches (request id + t_sent) and the socket write —
+    # packing per burst would make the GENERATOR the bottleneck on a
+    # 2-core box and undercut the system under test
+    rng = np.random.default_rng(seed)
+    frames = []
+    for k in range(8):
+        rows = rng.normal(size=(burst, DIM)).astype(np.float32)
+        gws = ((np.arange(burst) + k) % N_GATEWAYS).astype(np.int32)
+        tiers = ((np.arange(burst) + k) % TIERS).astype(np.uint8)
+        frames.append(bytearray(wire.pack_submit(
+            0, rows, gws, tiers if use_tiers else None)))
+
+    client = NetClient("127.0.0.1", port, timeout_s=120.0)
+    interval = burst / rate if rate > 0 else 0.0
+    t0 = time.perf_counter()
+    t_next = t0
+    sent_bursts = 0
+    while True:
+        now = time.perf_counter()
+        if now - t0 >= duration:
+            break
+        if rate > 0 and now < t_next:
+            client.poll()
+            time.sleep(min(t_next - now, 0.002))
+            continue
+        frame = frames[sent_bursts % 8]
+        rid = client._next_id
+        client._next_id += 1
+        struct.pack_into("!Q", frame, wire.REQUEST_ID_OFFSET, rid)
+        struct.pack_into("!d", frame, wire.T_SENT_OFFSET, time.time())
+        client.outstanding[rid] = (burst, time.perf_counter())
+        client.rows_submitted += burst
+        client._send(bytes(frame))
+        sent_bursts += 1
+        t_next += interval
+        client.poll()
+    wall_send = time.perf_counter() - t0
+    client.wait_all(timeout_s=120.0)
+    wall_total = time.perf_counter() - t0
+    # percentiles skip the first few requests (connection + first-frame
+    # warm path); throughput counters keep everything
+    lat = np.asarray([client.results[r][2]
+                      for r in sorted(client.results) if r > 10])
+    if not len(lat):
+        lat = client.latencies_s()
+    counts = client.status_counts()
+    resolved = int(sum(counts.values()))
+    scored = counts["normal"] + counts["anomaly"]
+    out = {
+        "rows_submitted": int(client.rows_submitted),
+        "bursts": sent_bursts,
+        "burst": burst,
+        "target_rate_rows_per_sec": rate,
+        "duration_s": round(wall_send, 3),
+        "wall_total_s": round(wall_total, 3),
+        "statuses": counts,
+        "rows_resolved": resolved,
+        "exactly_once": bool(resolved == client.rows_submitted
+                             and not client.outstanding),
+        "offered_rows_per_sec": round(client.rows_submitted / wall_send, 1),
+        "scored_rows_per_sec": round(scored / wall_total, 1),
+        "request_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "request_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+    }
+    client.close()
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------- orchestration ----------------------------- #
+
+def _spawn_server(replicas=2, extra=()):
+    """Launch `python -m fedmse_tpu.net.server` and wait for its
+    listening line; returns (proc, port)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable, "-m", "fedmse_tpu.net.server", "--port", "0",
+           "--replicas", str(replicas), "--gateways", str(N_GATEWAYS),
+           "--dim", str(DIM), "--max-batch", str(MAX_BATCH),
+           "--budget-ms", str(BUDGET_MS), "--tiers", str(TIERS),
+           "--seed", str(SEED), *extra]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True,
+                            cwd=REPO_ROOT)
+    line = proc.stdout.readline()
+    if not line:
+        raise RuntimeError("net server died before listening")
+    info = json.loads(line)
+    return proc, info["port"]
+
+
+def _spawn_loaders(port, n, rate, duration, tiers, burst=MAX_BATCH):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = []
+    for i in range(n):
+        cmd = [sys.executable, os.path.abspath(__file__), "--load-worker",
+               "--port", str(port), "--rate", str(rate),
+               "--duration", str(duration), "--burst", str(burst),
+               "--seed", str(i + 1)]
+        if tiers:
+            cmd.append("--tiers")
+        procs.append(subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True,
+                                      cwd=REPO_ROOT))
+    return procs
+
+
+def _collect(procs):
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        if p.returncode != 0:
+            raise RuntimeError(f"load worker failed:\n{err[-2000:]}")
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    return outs
+
+
+def bench_inprocess_burst(reps=3):
+    """The PR 8 burst column re-measured on this box: one in-process
+    ContinuousBatcher under submit_many bursts, no socket anywhere."""
+    import numpy as np
+
+    from fedmse_tpu.net.server import build_synthetic_router
+
+    router = build_synthetic_router(
+        n_gateways=N_GATEWAYS, dim=DIM, replicas=1, max_batch=MAX_BATCH,
+        latency_budget_ms=BUDGET_MS, tiers=TIERS, seed=SEED,
+        calibrate=False, warmup=True)
+    router.admission = None
+    front = router.replicas[0].batcher
+    rng = np.random.default_rng(SEED)
+    rows = rng.normal(size=(65536, DIM)).astype(np.float32)
+    gws = (np.arange(65536) % N_GATEWAYS).astype(np.int32)
+    best = 0.0
+    for _ in range(reps + 1):  # first pass untimed warm
+        t0 = time.perf_counter()
+        for s in range(0, len(rows), 64):
+            front.submit_many(rows[s:s + 64], gws[s:s + 64])
+        front.drain()
+        best = max(best, len(rows) / (time.perf_counter() - t0))
+    st = front.stats()
+    return {"rows_per_sec": round(best, 1), "burst": 64,
+            "rows": len(rows), "reps": reps,
+            "latency_p99_ms": round(st["latency_p99_ms"], 3),
+            "note": "single in-process continuous front, submit_many "
+                    "burst-64 admission (the PR 8 qualifying column), "
+                    "best of reps"}
+
+
+def _swap_payloads():
+    """(params+centroids hot-swap payload, retiring roster) for the
+    mid-load events — built from the same synthetic recipe the server
+    deployed from, as a release pipeline would."""
+    import numpy as np
+    import jax
+
+    from fedmse_tpu.models import init_stacked_params, make_model
+    from fedmse_tpu.serving.engine import ServingRoster, \
+        fit_gateway_centroids
+
+    rng = np.random.default_rng(SEED)
+    model = make_model("hybrid", DIM, shrink_lambda=10.0)
+    params2 = init_stacked_params(model, jax.random.key(SEED + 1),
+                                  N_GATEWAYS)
+    train_x = rng.normal(size=(N_GATEWAYS, 512, DIM)).astype(np.float32)
+    cens2 = fit_gateway_centroids(model, params2, train_x)
+    host = lambda t: jax.tree.map(lambda x: np.asarray(x), t)  # noqa: E731
+    member = np.ones(N_GATEWAYS, bool)
+    member[9] = False
+    gen = np.zeros(N_GATEWAYS, np.int64)
+    gen[9] = 1
+    roster = ServingRoster(member=member, generation=gen)
+    return {"params": host(params2), "centroids": host(cens2)}, roster
+
+
+def run_networked_phases(duration=6.0):
+    """Saturation probe, then steady (throttled, swap + roster change
+    mid-load), then overload (unthrottled, tiered) through one server
+    process; returns the three phase dicts + the server's closing
+    stats."""
+    from fedmse_tpu.net.client import NetClient
+
+    server, port = _spawn_server(replicas=2)
+    try:
+        ctl = NetClient("127.0.0.1", port, timeout_s=60.0)
+        st0 = ctl.stats()
+        capacity_probe = st0["router"]["admission"]["capacity_rows_per_sec"]
+
+        # ---- saturation probe: tier-0 open-loop flood; the scored rate
+        # is the plane's END-TO-END sustained capacity (the engine-side
+        # probe above excludes sockets/framing/host bookkeeping and the
+        # co-located load generators this phase deliberately includes).
+        # Best of 2 reps — the bench.py bursty-environment rule.
+        reps = []
+        for _ in range(2):
+            loaders = _spawn_loaders(port, 2, 0.0, duration, tiers=False,
+                                     burst=4096)
+            outs0 = _collect(loaders)
+            reps.append((sum(o["scored_rows_per_sec"] for o in outs0),
+                         outs0))
+        sustained, outs0 = max(reps, key=lambda r: r[0])
+        probe = {
+            "clients": outs0,
+            "engine_capacity_probe_rows_per_sec": capacity_probe,
+            "sustained_rows_per_sec": round(sustained, 1),
+            "sustained_rows_per_sec_reps": [round(r[0], 1) for r in reps],
+            "exactly_once": all(o["exactly_once"]
+                                for _, out in reps for o in out),
+            "shed_total_phase": sum(o["statuses"]["shed"]
+                                    for _, out in reps for o in out),
+        }
+
+        # ---- steady phase: ~60% of the probed sustained capacity (the
+        # autoscaler's target_utilization operating point)
+        rate_each = 0.30 * sustained
+        loaders = _spawn_loaders(port, 2, rate_each, duration, tiers=False)
+        swap_payload, roster = _swap_payloads()
+        time.sleep(duration * 0.35)
+        ev1 = ctl.swap(swap_payload, timeout_s=60.0)   # hot swap mid-load
+        time.sleep(duration * 0.2)
+        ev2 = ctl.swap({"roster": roster}, timeout_s=60.0)  # roster change
+        outs = _collect(loaders)
+        st1 = ctl.stats()
+        steady = {
+            "target_rate_rows_per_sec": round(2 * rate_each, 1),
+            "sustained_capacity_rows_per_sec": round(sustained, 1),
+            "clients": outs,
+            "scored_rows_per_sec": round(
+                sum(o["scored_rows_per_sec"] for o in outs), 1),
+            "request_p99_ms_worst": max(o["request_p99_ms"] for o in outs),
+            "exactly_once": all(o["exactly_once"] for o in outs),
+            "shed_total": st1["router"]["admission"]["shed_total"],
+            "unknown_gateway_rows": sum(
+                o["statuses"]["unknown_gateway"] for o in outs),
+            "swap_events": [ev1["kinds"], ev2["kinds"]],
+            "swap_replicas": ev1["replicas"],
+        }
+
+        # ---- overload phase: unthrottled, 3-tier mix
+        shed_before = st1["router"]["admission"]["shed_by_tier"]
+        loaders = _spawn_loaders(port, 2, 0.0, duration, tiers=True,
+                                 burst=4096)
+        outs2 = _collect(loaders)
+        st2 = ctl.stats()
+        adm = st2["router"]["admission"]
+        shed_by_tier = [a - b for a, b in zip(adm["shed_by_tier"],
+                                              shed_before)]
+        overload = {
+            "clients": outs2,
+            "offered_rows_per_sec": round(
+                sum(o["offered_rows_per_sec"] for o in outs2), 1),
+            "scored_rows_per_sec": round(
+                sum(o["scored_rows_per_sec"] for o in outs2), 1),
+            "request_p99_ms_worst": max(o["request_p99_ms"]
+                                        for o in outs2),
+            "exactly_once": all(o["exactly_once"] for o in outs2),
+            "shed_by_tier": shed_by_tier,
+            "shed_total": int(sum(shed_by_tier)),
+            "shed_rows_client_view": sum(o["statuses"]["shed"]
+                                         for o in outs2),
+        }
+        ctl.close()
+        return probe, steady, overload, st2
+    finally:
+        server.terminate()
+        server.wait(timeout=30)
+
+
+def run_remote_replica_row(rows_total=131072):
+    """Router striping over two replica WORKER PROCESSES — the
+    across-process replication topology, driven straight from this
+    process (no middle front tier)."""
+    import numpy as np
+
+    from fedmse_tpu.net.client import RemoteReplica
+    from fedmse_tpu.net.router import Router
+
+    s1, p1 = _spawn_server(replicas=1, extra=("--no-admission",))
+    s2, p2 = _spawn_server(replicas=1, extra=("--no-admission",))
+    try:
+        reps = [RemoteReplica("127.0.0.1", p, N_GATEWAYS,
+                              max_batch=MAX_BATCH) for p in (p1, p2)]
+        router = Router(reps)
+        rng = np.random.default_rng(SEED)
+        rows = rng.normal(size=(rows_total, DIM)).astype(np.float32)
+        gws = (np.arange(rows_total) % N_GATEWAYS).astype(np.int32)
+        for s in range(0, 16384, 2048):   # warm both workers
+            router.submit_many(rows[s:s + 2048], gws[s:s + 2048])
+        router.drain()
+        results = []
+        t0 = time.perf_counter()
+        for s in range(0, rows_total, 2048):
+            results.append(router.submit_many(rows[s:s + 2048],
+                                              gws[s:s + 2048]))
+            router.poll()
+        router.drain()
+        wall = time.perf_counter() - t0
+        ok = all(r.finalize() for r in results)
+        scored = sum(int((~np.isnan(r.scores)).sum()) for r in results)
+        per = [rep.stats() for rep in reps]
+        for rep in reps:
+            rep.close()
+        return {
+            "replicas": 2,
+            "rows": rows_total,
+            "rows_per_sec": round(rows_total / wall, 1),
+            "exactly_once": bool(ok and scored == rows_total),
+            "per_replica_rows_served": [p["rows_served"] for p in per],
+            "note": "router in this process striping 2048-row bursts "
+                    "over two replica server processes via RemoteReplica "
+                    "(one engine each); the across-process half of the "
+                    "replication story on a 2-core box",
+        }
+    finally:
+        for s in (s1, s2):
+            s.terminate()
+            s.wait(timeout=30)
+
+
+def autoscaler_trace(steady, overload, inproc):
+    """The SLO policy + cost model replayed over the measured demand
+    curve: what the plane would buy at each phase (arxiv 2509.14920 —
+    per-row accelerator cost undercuts CPU only past the amortization
+    point, so low rates stay on CPU replicas)."""
+    from fedmse_tpu.net.autoscale import BackendSpec, SLOAutoscaler
+
+    per_replica = max(1.0, steady["sustained_capacity_rows_per_sec"] / 2.0)
+    backends = [
+        BackendSpec("cpu", rows_per_sec=per_replica, usd_per_hour=0.10,
+                    max_replicas=8),
+        # the accelerator row is the PR 8 in-process burst rate scaled
+        # to a serving-class chip price — a MODEL input, labeled as such
+        BackendSpec("tpu", rows_per_sec=max(4.0 * per_replica,
+                                            inproc["rows_per_sec"]),
+                    usd_per_hour=1.20, max_replicas=4),
+    ]
+    sc = SLOAutoscaler(budget_ms=BUDGET_MS, backends=backends,
+                       cooldown_s=0.0, clock=lambda: 0.0)
+    trace = []
+    for name, arrival, p99 in (
+            ("steady", steady["scored_rows_per_sec"],
+             steady["request_p99_ms_worst"]),
+            ("overload_offered", overload["offered_rows_per_sec"],
+             overload["request_p99_ms_worst"]),
+            ("10x_overload", 10.0 * overload["offered_rows_per_sec"],
+             None)):
+        d = sc.decide(arrival_rows_per_sec=arrival, p99_ms=p99,
+                      current={"cpu": 2, "tpu": 0})
+        trace.append({"phase": name,
+                      "arrival_rows_per_sec": round(arrival, 1),
+                      "p99_ms": p99, "action": d.action,
+                      "replicas": d.replicas, "bucket": d.bucket,
+                      "usd_per_hour": round(d.usd_per_hour, 3),
+                      "reason": d.reason})
+    return {"backends": sc.stats()["backends"], "decisions": trace}
+
+
+def quick_cell():
+    """Reduced in-process guard for bench_suite scenario 16: the full
+    contract chain (route -> shed under synthetic overload only ->
+    mid-load swap + roster change -> exactly-once) through a REAL
+    localhost socket, one process, small row counts. Returns the
+    scenario row with acceptance_met."""
+    import numpy as np
+
+    from fedmse_tpu.net import wire
+    from fedmse_tpu.net.client import NetClient
+    from fedmse_tpu.net.server import (FrontHandle, NetFront,
+                                       build_synthetic_router)
+
+    router = build_synthetic_router(
+        n_gateways=N_GATEWAYS, dim=DIM, replicas=2, max_batch=256,
+        latency_budget_ms=BUDGET_MS, tiers=TIERS, seed=SEED,
+        calibrate=True, warmup=True)
+    capacity = router.admission.capacity_rows_per_sec
+    handle = FrontHandle(NetFront(router))
+    rng = np.random.default_rng(SEED)
+    rows = rng.normal(size=(4096, DIM)).astype(np.float32)
+    gws = (np.arange(4096) % N_GATEWAYS).astype(np.int32)
+    tiers = (np.arange(4096) % TIERS).astype(np.uint8)
+    try:
+        client = NetClient("127.0.0.1", handle.port, timeout_s=60.0)
+        swap_payload, roster = _swap_payloads()
+        t0 = time.perf_counter()
+        rids = []
+        for s in range(0, 2048, 256):
+            rids.append(client.submit(rows[s:s + 256], gws[s:s + 256]))
+            client.poll()
+        ev1 = client.swap(swap_payload)            # hot swap mid-load
+        ev2 = client.swap({"roster": roster})      # roster change
+        for s in range(2048, 4096, 256):
+            rids.append(client.submit(rows[s:s + 256], gws[s:s + 256],
+                                      tiers=tiers[s:s + 256]))
+            client.poll()
+        client.wait_all(timeout_s=60.0)
+        wall = time.perf_counter() - t0
+        counts = client.status_counts()
+        shed_under_capacity = router.admission.stats()["shed_total"]
+        # synthetic overload: shrink the measured capacity (quiescent —
+        # nothing in flight after wait_all) so one mega-burst overruns
+        # the bucket; full-scale overload is bench_net's own phase 3
+        router.admission.set_capacity(2000.0)
+        over = client.submit(np.tile(rows, (2, 1))[:8192],
+                             np.zeros(8192, np.int32),
+                             tiers=np.full(8192, TIERS - 1, np.uint8))
+        client.wait_all(timeout_s=60.0)
+        shed_status = client.results[over][0]
+        client.close()
+    finally:
+        handle.stop()
+    exactly_once = (sum(counts.values()) == 4096
+                    and len(client.results) == len(rids) + 1)
+    # after the roster change, slot 9's rows come back UNKNOWN
+    unknown = counts["unknown_gateway"]
+    shed_over = int((shed_status == wire.STATUS_SHED).sum())
+    return {
+        "rows": 4096,
+        "rows_per_sec": round(4096 / wall, 1),
+        "capacity_rows_per_sec": capacity,
+        "statuses": counts,
+        "swap_kinds": [ev1["kinds"], ev2["kinds"]],
+        "shed_under_capacity": shed_under_capacity,
+        "shed_in_synthetic_overload": shed_over,
+        "acceptance_met": bool(
+            exactly_once and unknown > 0
+            and shed_under_capacity == 0 and shed_over > 0
+            and "params" in ev1["kinds"] and "roster" in ev2["kinds"]),
+    }
+
+
+def main():
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
+    enable_compilation_cache()
+    capture_provenance()
+    import jax
+
+    duration = float(_flag("--duration", 6.0))
+    inproc = bench_inprocess_burst()
+    probe, steady, overload, server_stats = run_networked_phases(duration)
+    remote = run_remote_replica_row()
+    trace = autoscaler_trace(steady, overload, inproc)
+
+    net_rate = probe["sustained_rows_per_sec"]
+    ratio = net_rate / inproc["rows_per_sec"]
+    shed_ordered = all(
+        overload["shed_by_tier"][i] <= overload["shed_by_tier"][i + 1]
+        for i in range(len(overload["shed_by_tier"]) - 1))
+    acceptance = {
+        "bar": ">= 0.5x in-process burst rows/s with >= 2 replicas; p99 "
+               "within the configured budget in the steady phase; zero "
+               "dropped/duplicated admitted tickets across a mid-load "
+               "hot swap AND a mid-load roster change; shedding engages "
+               "(SHED verdicts, lowest tier first) only when offered "
+               "load exceeds the sustained capacity",
+        "inprocess_burst_rows_per_sec": inproc["rows_per_sec"],
+        "net_rows_per_sec": net_rate,
+        "ratio": round(ratio, 3),
+        "ratio_ok": ratio >= 0.5,
+        "replicas": 2,
+        "budget_ms": BUDGET_MS,
+        "steady_p99_ms": steady["request_p99_ms_worst"],
+        "p99_ok": steady["request_p99_ms_worst"] <= BUDGET_MS,
+        "exactly_once": bool(probe["exactly_once"]
+                             and steady["exactly_once"]
+                             and overload["exactly_once"]),
+        "swap_and_roster_mid_load": bool(
+            steady["swap_events"] and steady["unknown_gateway_rows"] > 0),
+        "shed_only_over_capacity": bool(steady["shed_total"] == 0
+                                        and overload["shed_total"] > 0),
+        "shed_lowest_tier_first": bool(shed_ordered
+                                       and overload["shed_by_tier"][0]
+                                       == 0),
+    }
+    acceptance["met"] = bool(
+        acceptance["ratio_ok"] and acceptance["p99_ok"]
+        and acceptance["exactly_once"]
+        and acceptance["swap_and_roster_mid_load"]
+        and acceptance["shed_only_over_capacity"]
+        and acceptance["shed_lowest_tier_first"])
+
+    device = jax.devices()[0]
+    out = {
+        "metric": "network serving plane sustained rows/s over localhost "
+                  f"TCP ({N_GATEWAYS} gateways, dim {DIM}, 2 engine "
+                  "replicas, roster-aware router, tiered admission)",
+        "value": net_rate,
+        "unit": "rows/s",
+        "inprocess_burst": inproc,
+        "saturation_probe": probe,
+        "steady_phase": steady,
+        "overload_phase": overload,
+        "remote_replica_topology": remote,
+        "autoscaler": trace,
+        "server_stats_final": {
+            k: v for k, v in server_stats["router"].items()
+            if k != "per_replica"},
+        "acceptance": acceptance,
+        "device": str(device),
+        "platform": device.platform,
+    }
+    out.update(capture_provenance())
+    line = json.dumps(out)
+    print(line)
+    dest = _flag("--out", f"BENCH_NET_r13_{device.platform}.json")
+    with open(dest, "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    if "--load-worker" in sys.argv:
+        _load_worker()
+    else:
+        main()

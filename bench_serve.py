@@ -28,6 +28,13 @@ sync loop leaves the device idle while the host accumulates and fills
 tickets; the continuous front overlaps them. Acceptance: continuous
 >= 2.5x sync rows/s at the same (or better) p99.
 
+`--continuous` also drives >= 2 engine replicas in SEPARATE PROCESSES
+(`--replica-worker` self-invocation, stdin start barrier) and records
+aggregate rows/s + per-replica p99 beside the single-process columns
+(ISSUE 13 satellite; `--multiprocess-only` writes just that block to
+BENCH_SERVE_MP_r13_<platform>.json without re-stamping the committed
+single-process numbers).
+
 Prints ONE JSON line and writes BENCH_SERVE_pr02_<platform>.json
 (override with --out). Run on CPU via `make serve-bench`.
 """
@@ -206,6 +213,114 @@ def bench_fronts(engine, rows, gws, max_batch, calibration, reps=5,
     return out
 
 
+def _replica_worker():
+    """Self-invoked subprocess body (`--replica-worker`): build the SAME
+    synthetic engine the parent benches, print a ready line, WAIT for
+    the parent's go (one stdin newline — the start barrier that makes
+    the workers' timed streams actually overlap), then stream `--rows`
+    rows through the continuous front under burst-64 admission and
+    print one JSON line. Each worker is its own process with its own
+    XLA CPU device — the multi-process replica capture ROADMAP item 3
+    asked for."""
+    import numpy as np
+
+    from fedmse_tpu.net.server import build_synthetic_router
+    from fedmse_tpu.serving import ContinuousBatcher
+
+    model_type = _flag("--model-type", "hybrid")
+    total_rows = int(_flag("--rows", 32768))
+    burst = int(_flag("--burst", 64))
+    dim = 115
+    # ONE home for the synthetic deployment recipe (models, inits,
+    # calibration, warmup): the net plane's builder, replica count 1
+    router = build_synthetic_router(
+        n_gateways=N_GATEWAYS, dim=dim, replicas=1,
+        max_batch=max(BATCHES), seed=0, model_type=model_type,
+        calibrate=False, warmup=True)
+    engine = router.replicas[0].engine
+    calibration = router.replicas[0].batcher.calibration
+    rng = np.random.default_rng(1)
+    rows = rng.normal(size=(total_rows, dim)).astype(np.float32)
+    gws = rng.integers(0, N_GATEWAYS, size=total_rows).astype(np.int32)
+
+    def stream():
+        b = ContinuousBatcher(engine, max_batch=max(BATCHES),
+                              latency_budget_ms=1e9,
+                              calibration=calibration)
+        t0 = time.perf_counter()
+        for i in range(0, total_rows, burst):
+            b.submit_many(rows[i:i + burst], gws[i:i + burst])
+        b.drain()
+        return b, time.perf_counter() - t0
+
+    stream()  # untimed warm pass (the bench_fronts protocol)
+    print(json.dumps({"ready": True}), flush=True)
+    sys.stdin.readline()  # the parent's go — all replicas start together
+    b, wall = stream()
+    st = b.stats()
+    print(json.dumps({
+        "rows": total_rows,
+        "wall_s": round(wall, 4),
+        "rows_per_sec": round(total_rows / wall, 1),
+        "latency_p50_ms": round(st["latency_p50_ms"], 4),
+        "latency_p99_ms": round(st["latency_p99_ms"], 4),
+        "dispatches": st["dispatches"],
+    }), flush=True)
+
+
+def bench_multiprocess(n_replicas: int = 2,
+                       rows_per_replica: int = 262144):
+    """Drive >= 2 engine replicas in SEPARATE PROCESSES (subprocess
+    self-invocation with --replica-worker) and record aggregate rows/s +
+    per-replica p99 alongside the single-process columns — the standing
+    multi-process serving headroom from ROADMAP item 3. Every worker
+    builds + warms, reports ready, and blocks on a stdin barrier; the
+    parent releases them together and times from the barrier to the
+    last exit, so the aggregate wall covers OVERLAPPING timed streams
+    and none of the ~seconds of interpreter/XLA startup."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "--replica-worker",
+           "--rows", str(rows_per_replica)]
+    procs = [subprocess.Popen(cmd, env=env, stdin=subprocess.PIPE,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for _ in range(n_replicas)]
+    for p in procs:  # wait until every replica is built + warm
+        line = p.stdout.readline()
+        if not line or not json.loads(line).get("ready"):
+            _, err = p.communicate(timeout=60)
+            raise RuntimeError(f"replica worker failed to ready:\n{err}")
+    t0 = time.perf_counter()
+    for p in procs:  # the barrier release
+        p.stdin.write("\n")
+        p.stdin.flush()
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        if p.returncode != 0:
+            raise RuntimeError(f"replica worker failed:\n{err}")
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    wall = time.perf_counter() - t0
+    total_rows = sum(o["rows"] for o in outs)
+    return {
+        "replicas": n_replicas,
+        "rows_total": total_rows,
+        "wall_s": round(wall, 4),
+        "aggregate_rows_per_sec": round(total_rows / wall, 1),
+        "per_replica": outs,
+        "per_replica_rows_per_sec": [o["rows_per_sec"] for o in outs],
+        "per_replica_p99_ms": [o["latency_p99_ms"] for o in outs],
+        "note": f"{n_replicas} worker processes, each its own XLA CPU "
+                "device, burst-64 continuous front over the same "
+                "synthetic federation; workers start together on a "
+                "stdin barrier, aggregate = total rows / (barrier -> "
+                "last exit)",
+    }
+
+
 def bench_unbatched(engine, rows, gws):
     """Per-request baseline: one dispatch per row (bucket-1 program)."""
     import numpy as np
@@ -356,6 +471,7 @@ def main():
     # sync-vs-continuous columns (ISSUE 8): paired fronts over the same
     # stream, device-idle fraction explaining the overlap win
     continuous_front = None
+    multiprocess = None
     if "--continuous" in sys.argv:
         # longer stream than the batched columns: the fronts comparison
         # wants many batches per window so medians are steady
@@ -363,6 +479,10 @@ def main():
         reps_gws = np.tile(gws, 4)
         continuous_front = bench_fronts(engine, reps_rows, reps_gws,
                                         max(BATCHES), calibration)
+        # multi-process replica capture (ISSUE 13 satellite): >= 2 engine
+        # replicas in separate processes, aggregate rows/s + per-replica
+        # p99 beside the single-process columns above
+        multiprocess = bench_multiprocess()
 
     device = jax.devices()[0]
     out = {
@@ -378,6 +498,7 @@ def main():
         "speedup_batch1024_vs_unbatched": results[-1]["speedup_vs_unbatched"],
         "bf16_scoring": bf16_scoring,
         "continuous_front": continuous_front,
+        "multiprocess_replicas": multiprocess,
         "first_request": first_request,
         "warmup_sec_per_bucket": {str(k): round(v, 4)
                                   for k, v in warmup_sec.items()},
@@ -393,5 +514,43 @@ def main():
         f.write(line + "\n")
 
 
+def main_multiprocess_only():
+    """Standalone multi-process replica capture -> its own artifact
+    (BENCH_SERVE_MP_r13_cpu.json): re-measuring the full serve bench
+    rewrites every column with this box's weather, but the
+    multi-process capture is NEW — land it without re-stamping the
+    committed single-process numbers. `--continuous` full runs embed
+    the same block alongside the single-process columns."""
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
+    enable_compilation_cache()
+    capture_provenance()
+    import jax
+
+    row = bench_multiprocess()
+    device = jax.devices()[0]
+    out = {
+        "metric": "multi-process serving replicas: aggregate rows/s + "
+                  "per-replica p99, 2 worker processes, burst-64 "
+                  "continuous fronts",
+        "value": row["aggregate_rows_per_sec"],
+        "unit": "rows/s",
+        "multiprocess_replicas": row,
+        "device": str(device),
+        "platform": device.platform,
+    }
+    out.update(capture_provenance())
+    line = json.dumps(out)
+    print(line)
+    dest = _flag("--out", f"BENCH_SERVE_MP_r13_{device.platform}.json")
+    with open(dest, "w") as f:
+        f.write(line + "\n")
+
+
 if __name__ == "__main__":
-    main()
+    if "--replica-worker" in sys.argv:
+        _replica_worker()
+    elif "--multiprocess-only" in sys.argv:
+        main_multiprocess_only()
+    else:
+        main()

@@ -43,6 +43,12 @@ repo-grown axes):
      fine-tune -> hot-swap loop must keep detection AUC at the frozen
      baseline's expense with zero dropped tickets (full protocol:
      make flywheel-sweep -> FLYWHEEL_r12.json)
+ 16. network serving plane (fedmse_tpu/net/, DESIGN.md §18): the full
+     contract chain through a real localhost socket — 2 replicas behind
+     the roster-aware router, a mid-load hot swap + roster change,
+     tiered shedding engaging only under synthetic overload, every row
+     statused exactly once (full protocol: make net-bench ->
+     BENCH_NET_r13_cpu.json)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -405,6 +411,22 @@ def scen_flywheel():
                                    and row["swap_count"] >= 1)}
 
 
+def scen_net():
+    """Scenario 16: the network serving plane (ISSUE 13,
+    fedmse_tpu/net/) — the reduced localhost guard: route -> mid-load
+    swap + roster change -> shed only under synthetic overload ->
+    exactly-once, through a real TCP socket in one process. The
+    committed standalone artifact (make net-bench ->
+    BENCH_NET_r13_cpu.json) carries the multi-process open-loop
+    protocol and the >= 0.5x in-process acceptance bar."""
+    from bench_net import quick_cell
+
+    row = quick_cell()
+    return {"scenario": "network serving plane: 2 replicas over "
+                        "localhost TCP, mid-load swap + roster change, "
+                        "tiered shedding guard", **row}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -427,9 +449,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-15")
-        if not 1 <= only <= 15:
-            sys.exit(f"--only expects a scenario number 1-15, got {only}")
+            sys.exit("--only expects a scenario number 1-16")
+        if not 1 <= only <= 16:
+            sys.exit(f"--only expects a scenario number 1-16, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -523,6 +545,9 @@ def main():
 
     if only in (None, 15):
         emit(scen_flywheel())
+
+    if only in (None, 16):
+        emit(scen_net())
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

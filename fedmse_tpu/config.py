@@ -317,6 +317,35 @@ class ExperimentConfig:
     flywheel_z: float = 1.5
     flywheel_percentile: float = 99.0
     flywheel_shift: float = 1.5
+    # Async fine-tune (fedmse_tpu/flywheel/controller.py): True moves the
+    # drift-triggered fine-tune off the controller's poll path onto a
+    # background executor — serving keeps harvesting while the fine-tune
+    # runs, and the completed swap payload installs atomically on a later
+    # poll (the PR 12 "deployment would run it on a training replica"
+    # headroom, landed in-process). False (default) keeps the synchronous
+    # trigger, whose trajectory the flywheel sweep artifacts pin.
+    flywheel_async: bool = False
+    # Recency-weighted reservoirs (flywheel/buffer.py): 0.0 = off (the
+    # default uniform reservoir, cleared on swap). A value in (0, 1) is
+    # the per-admitted-row exponential decay factor: a row admitted d
+    # rows ago carries relative retention weight decay^d, so the
+    # reservoir tracks a walking regime WITHOUT clear-on-swap (the
+    # alternative when drift is continuous rather than episodic;
+    # 0.999 ~ a half-life of ~700 admitted rows per gateway).
+    flywheel_decay: float = 0.0
+    # Network serving plane (fedmse_tpu/net/, DESIGN.md §18): the knobs
+    # the --serve-net smoke (and a real deployment of server.NetFront)
+    # builds the plane from. net_port 0 binds an ephemeral port;
+    # net_replicas is the engine replica count behind the roster-aware
+    # router; net_tiers the admission priority tier count (tier 0
+    # highest — shedding consumes capacity tier-0-first and sheds the
+    # lowest tiers present); net_shed_headroom the fraction of MEASURED
+    # capacity the token bucket refills at (the shedding knee sits at
+    # headroom x capacity, leaving the rest for latency slack).
+    net_port: int = 0
+    net_replicas: int = 2
+    net_tiers: int = 3
+    net_shed_headroom: float = 0.9
     # Client-state residency layout (DESIGN.md §16; ROADMAP item 2):
     #   'dense'  — the pre-PR-11 layout: every client's params + f32 Adam
     #              moments device-resident as [N, ...] stacked trees; the

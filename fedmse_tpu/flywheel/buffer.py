@@ -14,6 +14,19 @@ equivalent uniform sample over everything the gateway ever admitted, as
 one vectorized partition per (batch, gateway) instead of per-row
 bookkeeping.
 
+Recency weighting (`decay`, ISSUE 13 satellite): the alternative to
+clear-on-swap for CONTINUOUS drift. With decay λ in (0, 1], the j-th
+row a gateway ever admits draws priority log E_j + j·log λ (E_j a unit
+exponential from the same per-gateway stream) — the log-space form of
+A-Res weighted reservoir sampling (Efraimidis–Spirakis keys E/w with
+weight w_j = λ^{-j}), so a row admitted d rows ago survives with
+relative weight λ^d and the reservoir tracks a walking regime without
+ever being emptied. Log-space keeps the priorities finite at any
+stream length (λ^j underflows after ~700/ln(1/λ) rows; j·log λ never
+does). λ=1 degenerates to an unweighted reservoir (distinct draws from
+the uniform path, same distribution); None (default) keeps the
+original uniform path BYTE-IDENTICAL — its draw stream is untouched.
+
 Determinism / padding invariance (PARITY.md §8, host edition): gateway
 g's priority stream is seeded by (seed, g) with g the ABSOLUTE gateway
 index, and consumed in g's OWN arrival order — so the reservoir contents
@@ -77,15 +90,21 @@ class FlywheelBuffer:
     """Fixed-capacity per-gateway reservoirs of served-normal rows."""
 
     def __init__(self, num_gateways: int, dim: int, capacity: int = 512,
-                 seed: int = 0):
+                 seed: int = 0, decay: Optional[float] = None):
         if num_gateways < 1:
             raise ValueError(f"num_gateways must be >= 1, got {num_gateways}")
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if decay is not None and not 0.0 < decay <= 1.0:
+            raise ValueError(f"decay must be in (0, 1], got {decay}")
         self.num_gateways = num_gateways
         self.dim = dim
         self.capacity = capacity
         self.seed = seed
+        # None = uniform reservoir (the byte-pinned default); else the
+        # exponential recency weight per admitted row (module docstring)
+        self.decay = decay
+        self._log_decay = None if decay is None else float(np.log(decay))
         self._rows = np.zeros((num_gateways, capacity, dim), np.float32)
         self._pri = np.full((num_gateways, capacity), np.inf)
         self.count = np.zeros(num_gateways, np.int64)  # valid slots
@@ -130,7 +149,17 @@ class FlywheelBuffer:
         return len(rows)
 
     def _admit_one(self, g: int, xs: np.ndarray) -> None:
-        pri = self._rng(g).random(len(xs))
+        if self._log_decay is None:
+            pri = self._rng(g).random(len(xs))
+        else:
+            # A-Res in log space (module docstring): key_j = E_j / λ^{-j}
+            # -> log E_j + j log λ, with j the gateway's ABSOLUTE
+            # admission index — like the uniform path, the priority is a
+            # pure function of (seed, g, j), so padding/layout/interleave
+            # invariance carries over unchanged
+            j = self.seen[g] + np.arange(len(xs), dtype=np.float64)
+            e = self._rng(g).standard_exponential(len(xs))
+            pri = np.log(e) + j * self._log_decay
         cnt = int(self.count[g])
         pool_pri = np.concatenate([self._pri[g, :cnt], pri])
         pool_rows = np.concatenate([self._rows[g, :cnt], xs], axis=0)
@@ -184,7 +213,9 @@ class FlywheelBuffer:
 
         Each eligible gateway's reservoir splits train/valid by slot
         order (slot order is already a uniform shuffle — it is priority
-        order); ineligible gateways (non-`member` under the serving
+        order; under `decay` it is recency-biased instead, so the valid
+        tail skews toward the oldest retained rows — the conservative
+        side for threshold refits under drift); ineligible gateways (non-`member` under the serving
         roster, or fewer than `min_rows` buffered) get zero row masks and
         client_mask 0. The fine-tune has no labeled test traffic, so the
         test tensors alias the valid split with all-normal labels —

@@ -31,12 +31,29 @@ Anti-thrash: the monitor's own `cooldown_updates` is armed by the
 swap's rebaseline (serving/drift.py), and the controller layers
 `cooldown_polls` on top so even a monitor misconfigured with zero
 cooldown cannot re-trigger before the post-swap distribution settles.
+
+Async fine-tune (`background=True`, the PR 12 headroom landed): the
+fine-tune runs on a single-worker background executor instead of
+inside the poll — `trigger` snapshots the buffered data and submits
+`_finetune`, polls return immediately, and serving keeps dispatching /
+harvesting on the caller's thread throughout (JAX dispatch is
+thread-safe; the worker's round programs and the serving scorer just
+interleave on the device queue). The COMPLETED payload ships back to
+the poll path: the first poll that finds the future done builds and
+installs the atomic swap exactly like the synchronous path — the
+install itself never moves off the serving thread, so the
+per-batch-atomicity contract of `ContinuousBatcher.swap` is untouched.
+While a fine-tune is in flight no second trigger can fire (the pending
+future gates the trigger path), and rows admitted during the fine-tune
+are still cleared by clear_on_swap — exactly the rows a synchronous
+fine-tune would never have seen.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -60,7 +77,8 @@ class FlywheelController:
                  update_type: str, cfg, dev_x, *, rounds: int = 3,
                  quorum: int = 2, cooldown_polls: int = 8,
                  min_rows: int = 16, valid_frac: float = 0.25,
-                 epochs: Optional[int] = None, clear_on_swap: bool = True):
+                 epochs: Optional[int] = None, clear_on_swap: bool = True,
+                 background: bool = False):
         self.batcher = batcher
         self.monitor = monitor
         self.buffer = buffer
@@ -87,6 +105,11 @@ class FlywheelController:
         # everything it ever admitted). False keeps the long-memory
         # reservoir (the right call when drift is episodic, not a walk).
         self.clear_on_swap = clear_on_swap
+        # background=True runs _finetune on a lazy single-worker executor
+        # (module docstring); the pending future gates re-triggering
+        self.background = background
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._pending = None  # (future, finetune, flagged, t0)
         n = batcher.engine.num_gateways
         self._poll_streak = np.zeros(n, np.int64)
         self._cooldown = 0
@@ -104,10 +127,16 @@ class FlywheelController:
     def poll(self) -> Optional[Dict]:
         """One control tick (call between flushes / on a timer): advances
         the quorum streaks and, if the trigger fires, runs the fine-tune
-        and swap synchronously. Returns the swap event, or None."""
+        and swap (synchronously, or — background=True — hands the
+        fine-tune to the executor and installs its payload on a LATER
+        poll). Returns the swap event, or None."""
         self.polls += 1
         rec = np.asarray(self.monitor.swap_recommended(), bool)
         self._poll_streak = np.where(rec, self._poll_streak + 1, 0)
+        if self._pending is not None:
+            # a fine-tune is in flight on the executor: nothing else may
+            # fire, and cooldown only starts once its swap installs
+            return self._finish_pending(block=False)
         if self._cooldown > 0:
             self._cooldown -= 1
             return None
@@ -116,11 +145,39 @@ class FlywheelController:
             return None
         return self.trigger(flagged)
 
+    @property
+    def finetune_pending(self) -> bool:
+        """True while a background fine-tune is in flight."""
+        return self._pending is not None
+
+    def wait(self, timeout_s: Optional[float] = None) -> Optional[Dict]:
+        """Block until an in-flight background fine-tune completes and
+        install its swap (shutdown/test path). Returns the event, or
+        None when nothing was pending. A TIMEOUT keeps the fine-tune
+        pending (it is still running); a FAILED fine-tune clears the
+        pending slot and re-raises — the controller must never end up
+        permanently gated on a future that can no longer succeed."""
+        if self._pending is None:
+            return None
+        # exception() blocks like result() (raising TimeoutError if the
+        # future is still running) but does not raise the worker's own
+        # failure — that re-raise happens inside _finish_pending AFTER
+        # the pending slot is cleared
+        self._pending[0].exception(timeout=timeout_s)
+        return self._finish_pending(block=True)
+
     def trigger(self, flagged) -> Optional[Dict]:
         """Fine-tune + atomic swap for a sustained drift verdict on the
         `flagged` gateways. Returns the swap event (None if the buffers
         cannot support a fine-tune yet — the controller then backs off
-        `cooldown_polls` so it doesn't spin on an empty buffer)."""
+        `cooldown_polls` so it doesn't spin on an empty buffer — or if
+        background=True, where the event arrives from a later poll)."""
+        if self._pending is not None:
+            # the pending future gates THIS path too: a second submit
+            # would orphan the in-flight fine-tune's payload
+            logger.info("flywheel trigger suppressed: a background "
+                        "fine-tune is already in flight")
+            return None
         t0 = time.perf_counter()
         roster = getattr(self.batcher.engine, "roster", None)
         member = None if roster is None else roster.member
@@ -137,14 +194,44 @@ class FlywheelController:
                 self.cooldown_polls)
             self._cooldown = self.cooldown_polls
             return None
+        if self.background:
+            # the buffered snapshot (finetune) is already detached from
+            # the live reservoirs (rows_for copies), so the worker trains
+            # on frozen data while intake keeps admitting
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="flywheel-finetune")
+            fut = self._executor.submit(self._finetune, finetune)
+            self._pending = (fut, finetune, flagged, t0)
+            logger.info("flywheel fine-tune dispatched to background "
+                        "executor (gateways %s); serving continues",
+                        flagged.tolist())
+            return None
         new_params, ft_metrics = self._finetune(finetune)
+        return self._install(finetune, new_params, ft_metrics, flagged, t0)
+
+    def _finish_pending(self, block: bool) -> Optional[Dict]:
+        fut, finetune, flagged, t0 = self._pending
+        if not block and not fut.done():
+            return None
+        self._pending = None
+        new_params, ft_metrics = fut.result()  # re-raise worker failures
+        return self._install(finetune, new_params, ft_metrics, flagged, t0)
+
+    def _install(self, finetune, new_params, ft_metrics, flagged,
+                 t0: float) -> Dict:
+        """Build + atomically install the swap payload for a finished
+        fine-tune (the serving-thread half; shared by the sync path and
+        the background completion)."""
         from fedmse_tpu.flywheel.swap import build_and_apply_swap
+        roster = getattr(self.batcher.engine, "roster", None)
         event = build_and_apply_swap(
             self.batcher, self.model, finetune, new_params,
             extra_event={
-                "trigger_gateways": flagged.tolist(),
+                "trigger_gateways": np.asarray(flagged).tolist(),
                 "finetune_rounds": self.rounds,
                 "finetune_seconds": round(time.perf_counter() - t0, 4),
+                "finetune_async": self.background,
                 "finetune_metrics": ft_metrics,
                 "buffer": self.buffer.occupancy(),
             })

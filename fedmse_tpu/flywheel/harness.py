@@ -68,6 +68,13 @@ def stream_with_polling(batcher, controller, rows: np.ndarray,
         event = controller.poll()
         if event is not None:
             events.append(event)
+        if getattr(controller, "finetune_pending", False):
+            # async controller: the stream ended while a background
+            # fine-tune was still in flight — land its swap before
+            # handing back, so trajectories stay comparable
+            event = controller.wait()
+            if event is not None:
+                events.append(event)
     return blocks, events
 
 
@@ -121,7 +128,8 @@ def run_flywheel_smoke(cfg, data, n_real: int, writer, device_names,
                            min_batches=2,
                            cooldown_updates=cfg.flywheel_cooldown)
     buffer = FlywheelBuffer(n_real, cfg.dim_features,
-                            capacity=cfg.flywheel_buffer_size, seed=seed)
+                            capacity=cfg.flywheel_buffer_size, seed=seed,
+                            decay=cfg.flywheel_decay or None)
     batcher = ContinuousBatcher(
         engine, max_batch=cfg.serve_max_batch,
         latency_budget_ms=cfg.serve_latency_budget_ms,
@@ -129,7 +137,11 @@ def run_flywheel_smoke(cfg, data, n_real: int, writer, device_names,
     controller = FlywheelController(
         batcher, monitor, buffer, model, model_type, update_type, cfg,
         dev_x=np.asarray(data.dev_x), rounds=cfg.flywheel_rounds,
-        quorum=cfg.flywheel_quorum, min_rows=cfg.flywheel_min_rows)
+        quorum=cfg.flywheel_quorum, min_rows=cfg.flywheel_min_rows,
+        background=cfg.flywheel_async,
+        # with decay the reservoir tracks the walking regime by
+        # down-weighting, not by emptying
+        clear_on_swap=not cfg.flywheel_decay)
 
     rows, gws, labels = interleave_test_rows(
         np.asarray(data.test_x[:n_real]), np.asarray(data.test_m[:n_real]),

@@ -534,6 +534,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                    serve: bool = False, serve_rows: int = 2048,
                    serve_warmup: bool = False,
                    serve_continuous: bool = False,
+                   serve_net: bool = False,
                    flywheel: bool = False) -> Dict:
     """The full sweep (src/main.py:108-399) -> training summary dict.
 
@@ -544,6 +545,11 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
     report lands under the returned dict's "serve_smoke" key.
     `serve_continuous=True` streams through the continuous-batching front
     (serving/continuous.py) instead of the synchronous micro-batcher.
+    `serve_net=True` appends the network-plane smoke (fedmse_tpu/net/):
+    cfg.net_replicas engine replicas behind the roster-aware router +
+    tiered admission, bound on a localhost TCP port, with the test
+    traffic streamed back through a real socket in NIC-poll bursts and a
+    mid-stream hot swap broadcast; the report lands under "net_smoke".
     `flywheel=True` appends the closed-loop smoke (fedmse_tpu/flywheel/):
     the checkpointed federation serves a drifting stream through the
     continuous front with the reservoir tap + controller attached, and
@@ -665,6 +671,18 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                 max_rows=serve_rows, max_batch=cfg.serve_max_batch,
                 max_wait_ms=cfg.serve_latency_budget_ms,
                 warmup=serve_warmup, continuous=serve_continuous)
+    if serve_net:
+        if not save_checkpoints:
+            logger.warning("--serve-net needs the checkpointed ClientModel"
+                           " tree (run without --no-save); skipping the "
+                           "network-plane smoke")
+        else:
+            from fedmse_tpu.net import run_net_smoke
+            out["net_smoke"] = run_net_smoke(
+                cfg, data, n_real, writer, device_names,
+                model_type=cfg.model_types[0],
+                update_type=cfg.update_types[0], run=0,
+                max_rows=serve_rows)
     if flywheel:
         if not save_checkpoints:
             logger.warning("--flywheel needs the checkpointed ClientModel "
@@ -716,6 +734,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "with adaptive bucket selection and drift-triggered"
                         " hot swap) instead of the synchronous "
                         "wait-then-flush micro-batcher")
+    p.add_argument("--serve-net", action="store_true",
+                   help="after the sweep, run the network-plane smoke "
+                        "(fedmse_tpu/net/): --net-replicas engine "
+                        "replicas behind the roster-aware router + "
+                        "tiered admission, served over a localhost TCP "
+                        "socket (--net-port; 0 = ephemeral) with NIC-poll"
+                        " burst framing and a mid-stream hot-swap "
+                        "broadcast")
     p.add_argument("--flywheel", action="store_true",
                    help="after the sweep, run the closed-loop flywheel "
                         "smoke (fedmse_tpu/flywheel/): rebuild the serving "
@@ -887,6 +913,7 @@ def main(argv: Optional[List[str]] = None) -> Dict:
                           serve=args.serve, serve_rows=args.serve_rows,
                           serve_warmup=args.serve_warmup,
                           serve_continuous=args.serve_continuous,
+                          serve_net=args.serve_net,
                           flywheel=args.flywheel)
 
 

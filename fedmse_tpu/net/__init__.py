@@ -1,0 +1,66 @@
+"""Network serving plane — the production front over fedmse_tpu/serving/.
+
+The continuous front (serving/continuous.py) sustains >1M rows/s but is
+one in-process object; this package puts the process/network boundary
+around it (ROADMAP item 3, DESIGN.md §18):
+
+  wire.py       length-prefixed binary TCP frames that deserialize
+                straight into submit_many's contiguous burst shape;
+                explicit per-row terminal statuses (normal / anomaly /
+                SHED / UNKNOWN_GATEWAY — never a silent drop)
+  admission.py  tiered load shedding: a token bucket refilled at the
+                MEASURED fleet capacity sheds lowest-priority rows
+                first, only under sustained overload
+  router.py     N engine replicas (in-process or remote worker
+                processes) behind one roster-aware router: retired
+                gateways terminate AT the router, admitted bursts
+                stripe across replicas in contiguous max_batch slices,
+                hot swaps broadcast with per-replica regime atomicity
+  autoscale.py  SLO-driven scaling: replica count + bucket size from
+                the p99 budget and a CPU-vs-accelerator cost model
+                (arxiv 2509.14920's cost curves)
+  server.py     asyncio NetFront: socket -> router -> streamed RESULT
+                frames; one event loop owns every replica batcher;
+                `python -m fedmse_tpu.net.server` = standalone worker
+  client.py     open-loop blocking NetClient (the load-generator /
+                gateway-concentrator side) + RemoteReplica (a worker
+                process as a router stripe target)
+  smoke.py      end-to-end pass over a checkpointed federation, wired
+                to `fedmse_tpu.main --serve-net`
+
+Measured by bench_net.py (`make net-bench` -> BENCH_NET_r13_cpu.json):
+sustained rows/s + p99 under bursty multi-client open-loop load, across
+a mid-load hot swap AND a mid-load roster change, with shedding
+engaging only beyond measured capacity.
+"""
+
+from fedmse_tpu.net.admission import AdmissionController
+from fedmse_tpu.net.autoscale import BackendSpec, ScaleDecision, SLOAutoscaler
+from fedmse_tpu.net.client import NetClient, RemoteReplica
+from fedmse_tpu.net.router import (LocalReplica, RouteResult, Router,
+                                   make_local_replicas)
+from fedmse_tpu.net.server import FrontHandle, NetFront
+from fedmse_tpu.net.smoke import run_net_smoke
+from fedmse_tpu.net.wire import (STATUS_ANOMALY, STATUS_NAMES, STATUS_NORMAL,
+                                 STATUS_SHED, STATUS_UNKNOWN_GATEWAY)
+
+__all__ = [
+    "AdmissionController",
+    "BackendSpec",
+    "ScaleDecision",
+    "SLOAutoscaler",
+    "NetClient",
+    "RemoteReplica",
+    "LocalReplica",
+    "RouteResult",
+    "Router",
+    "make_local_replicas",
+    "FrontHandle",
+    "NetFront",
+    "run_net_smoke",
+    "STATUS_ANOMALY",
+    "STATUS_NAMES",
+    "STATUS_NORMAL",
+    "STATUS_SHED",
+    "STATUS_UNKNOWN_GATEWAY",
+]

@@ -365,3 +365,193 @@ def test_controller_backs_off_on_empty_buffer():
     assert ctl.poll() is None          # trigger suppressed: empty buffer
     assert not ctl.events and eng.swap_count == 0
     assert ctl._cooldown == 3          # backed off, not spinning
+
+
+# ------------------------- async fine-tune ----------------------------- #
+
+def test_async_finetune_serves_while_training_and_installs_atomically():
+    """background=True: the trigger hands the fine-tune to the executor
+    and polls return immediately; serving keeps submitting AND
+    harvesting throughout; the completed payload installs through ONE
+    atomic swap on a later poll, with zero dropped tickets."""
+    import threading
+
+    model, params, train_x, eng, cal, rows, gws = _setup()
+    mon = DriftMonitor(cal, z_threshold=1e9)  # never recommends: the
+    buf = FlywheelBuffer(N, DIM, capacity=64, seed=0)  # test drives
+    front = ContinuousBatcher(eng, max_batch=16, latency_budget_ms=1e9,
+                              calibration=cal, drift=mon, intake=buf.tap())
+    cfg = ExperimentConfig(network_size=N, dim_features=DIM)
+    ctl = FlywheelController(front, mon, buf, model, "autoencoder",
+                             "mse_avg", cfg, dev_x=np.zeros((4, DIM)),
+                             quorum=1, min_rows=16, background=True)
+    buf.admit(rows[:200], gws[:200])  # all gateways over min_rows
+
+    gate = threading.Event()
+    started = threading.Event()
+    incumbent = jax.device_get(eng.params)
+
+    def slow_finetune(finetune):
+        started.set()
+        assert gate.wait(30.0), "test gate never opened"
+        return (jax.tree.map(lambda t: np.asarray(t, np.float32),
+                             incumbent), [{"round": 0}])
+
+    ctl._finetune = slow_finetune
+    assert ctl.trigger(np.asarray([0])) is None  # dispatched, not done
+    assert ctl.finetune_pending
+    assert started.wait(30.0)
+
+    # serving continues WHILE the fine-tune runs: full round-trips,
+    # submit -> dispatch -> harvest, with the controller polled between
+    blk = front.submit_many(rows[:48], gws[:48])
+    front.drain()
+    assert ctl.poll() is None and ctl.finetune_pending
+    assert blk.done and blk.scores is not None
+    np.testing.assert_allclose(blk.scores, eng.score(rows[:48], gws[:48]),
+                               atol=1e-5)
+    assert eng.swap_count == 0  # nothing installed mid-flight
+
+    gate.set()
+    event = ctl.wait(30.0)  # deterministic completion for the test;
+    assert event is not None  # a deployment keeps poll()ing instead
+    assert not ctl.finetune_pending
+    assert event["flywheel"]["finetune_async"] is True
+    assert "params" in event["kinds"] and "thresholds" in event["kinds"]
+    assert eng.swap_count == 1 and ctl.events == [event]
+    # post-install hygiene matches the synchronous path
+    assert ctl._cooldown == ctl.cooldown_polls
+    assert buf.count.sum() == 0  # clear_on_swap consumed the reservoirs
+    # and the front still serves, under the installed regime
+    blk2 = front.submit_many(rows[48:80], gws[48:80])
+    front.drain()
+    assert blk2.done
+    st = front.stats()
+    assert st["rows_served"] == st["rows_submitted"]
+
+
+def test_async_finetune_blocks_second_trigger_until_installed():
+    """While a background fine-tune is pending, neither poll() nor a
+    direct trigger() may launch a second one."""
+    import threading
+
+    model, params, train_x, eng, cal, rows, gws = _setup()
+    mon = DriftMonitor(cal, z_threshold=0.5, min_count=10, min_batches=1)
+    buf = FlywheelBuffer(N, DIM, capacity=64, seed=0)
+    front = ContinuousBatcher(eng, max_batch=16, latency_budget_ms=1e9,
+                              calibration=cal, drift=mon)
+    cfg = ExperimentConfig(network_size=N, dim_features=DIM)
+    ctl = FlywheelController(front, mon, buf, model, "autoencoder",
+                             "mse_avg", cfg, dev_x=np.zeros((4, DIM)),
+                             quorum=1, min_rows=16, background=True)
+    buf.admit(rows[:200], gws[:200])
+    gate = threading.Event()
+    calls = []
+    incumbent = jax.device_get(eng.params)
+
+    def slow_finetune(finetune):
+        calls.append(1)
+        gate.wait(30.0)
+        return (jax.tree.map(lambda t: np.asarray(t, np.float32),
+                             incumbent), [])
+
+    ctl._finetune = slow_finetune
+    # a sustained recommendation keeps the streak hot on every poll
+    hot = cal.mean + 50 * (cal.std + 1.0)
+    for g in range(N):
+        mon.update(np.full(20, hot[g]), np.full(20, g, np.int32))
+    assert ctl.poll() is None and ctl.finetune_pending  # launched once
+    for _ in range(5):
+        mon.update(np.full(20, hot[0]), np.zeros(20, np.int32))
+        assert ctl.poll() is None  # pending gates re-trigger
+    assert ctl.trigger(np.asarray([0])) is None  # direct trigger gated too
+    assert len(calls) == 1
+    gate.set()
+    assert ctl.wait(30.0) is not None
+    assert len(calls) == 1 and eng.swap_count == 1
+
+
+# ----------------------- recency-weighted decay ------------------------ #
+
+def test_decay_reservoir_prefers_recent_rows():
+    """decay<1 biases retention exponentially toward recent admissions
+    (the clear-on-swap alternative for continuous drift); the uniform
+    default keeps sampling the whole history."""
+    t = 400
+    stream = np.zeros((t, DIM), np.float32)
+    stream[:, 0] = np.arange(t)  # feature 0 encodes the admission index
+    uni = FlywheelBuffer(1, DIM, capacity=16, seed=0)
+    dec = FlywheelBuffer(1, DIM, capacity=16, seed=0, decay=0.5)
+    for start in range(0, t, 25):  # same stream, batched admission
+        uni.admit(stream[start:start + 25], np.zeros(25, np.int32))
+        dec.admit(stream[start:start + 25], np.zeros(25, np.int32))
+    kept_uni = np.sort(uni.rows_for(0)[:, 0])
+    kept_dec = np.sort(dec.rows_for(0)[:, 0])
+    assert len(kept_uni) == len(kept_dec) == 16
+    # decay 0.5: a row d admissions old survives with weight 2^-d — the
+    # reservoir is essentially the most recent rows
+    assert kept_dec.min() >= t - 32
+    assert kept_dec.mean() > t - 20
+    # the uniform reservoir keeps sampling the whole stream
+    assert kept_uni.min() < t // 2
+    assert kept_uni.mean() < kept_dec.mean() - 100
+
+
+def test_decay_reservoir_padding_and_layout_invariant():
+    """The decayed priority is a pure function of (seed, g, j) with g
+    the ABSOLUTE gateway index and j the admission ordinal — so the
+    PARITY.md §8 invariance holds for the decay path exactly like the
+    uniform one."""
+    rng = np.random.default_rng(3)
+    per_g = {g: rng.normal(size=(60, DIM)).astype(np.float32)
+             for g in range(3)}
+    a = FlywheelBuffer(3, DIM, capacity=16, seed=5, decay=0.9)
+    b = FlywheelBuffer(9, DIM, capacity=16, seed=5, decay=0.9)
+    for g in range(3):
+        a.admit(per_g[g], np.full(60, g, np.int32))
+    for start in range(0, 60, 10):  # interleaved, wider axis
+        for g in (2, 0, 1):
+            b.admit(per_g[g][start:start + 10], np.full(10, g, np.int32))
+    for g in range(3):
+        np.testing.assert_array_equal(a.rows_for(g), b.rows_for(g))
+    # a post-clear stream keeps decaying from the ABSOLUTE ordinal: the
+    # cleared gateway's retention stays deterministic and recent-biased
+    a.clear([0])
+    b.clear([0])
+    more = rng.normal(size=(20, DIM)).astype(np.float32)
+    a.admit(more, np.zeros(20, np.int32))
+    b.admit(more, np.zeros(20, np.int32))
+    np.testing.assert_array_equal(a.rows_for(0), b.rows_for(0))
+
+
+def test_decay_validation():
+    with pytest.raises(ValueError, match="decay"):
+        FlywheelBuffer(1, DIM, decay=0.0)
+    with pytest.raises(ValueError, match="decay"):
+        FlywheelBuffer(1, DIM, decay=1.5)
+    FlywheelBuffer(1, DIM, decay=1.0)  # λ=1: unweighted, valid
+
+
+def test_async_finetune_failure_clears_pending_and_reraises():
+    """A failed background fine-tune must not gate the controller
+    forever: wait()/poll() clear the pending slot and re-raise."""
+    model, params, train_x, eng, cal, rows, gws = _setup()
+    mon = DriftMonitor(cal, z_threshold=1e9)
+    buf = FlywheelBuffer(N, DIM, capacity=64, seed=0)
+    front = ContinuousBatcher(eng, max_batch=16, latency_budget_ms=1e9,
+                              calibration=cal)
+    cfg = ExperimentConfig(network_size=N, dim_features=DIM)
+    ctl = FlywheelController(front, mon, buf, model, "autoencoder",
+                             "mse_avg", cfg, dev_x=np.zeros((4, DIM)),
+                             quorum=1, min_rows=16, background=True)
+    buf.admit(rows[:200], gws[:200])
+
+    def broken_finetune(finetune):
+        raise RuntimeError("synthetic fine-tune failure")
+
+    ctl._finetune = broken_finetune
+    assert ctl.trigger(np.asarray([0])) is None
+    with pytest.raises(RuntimeError, match="synthetic fine-tune"):
+        ctl.wait(30.0)
+    assert not ctl.finetune_pending  # slot cleared: the loop can retry
+    assert eng.swap_count == 0 and ctl.events == []
