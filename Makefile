@@ -10,7 +10,7 @@ LIB := fedmse_tpu/native/libfedmse_io.so
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
         serve-bench chaos-sweep churn-sweep pipeline-bench precision-bench \
         shard-bench knn-bench cohort-bench flywheel-sweep net-bench \
-        tpu-check
+        cluster-sweep tpu-check
 
 native: $(LIB)
 
@@ -111,11 +111,24 @@ flywheel-sweep:
 # multi-client open-loop load over localhost TCP against 2 engine
 # replicas behind the roster-aware router — saturation probe, steady
 # phase with a mid-load hot swap + roster change, tiered overload with
-# shedding, remote-replica topology, cost-aware autoscaler trace
-# (writes BENCH_NET_r13_cpu.json; hermetic CPU like the tests)
+# shedding, remote-replica topology, cost-aware autoscaler trace, and
+# the LIVE autoscale-apply phase (a 1-replica server grows its own
+# fleet under flood; applied-vs-planned recorded per decision)
+# (writes BENCH_NET_r15_cpu.json; hermetic CPU like the tests)
 net-bench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-		python bench_net.py --out BENCH_NET_r13_cpu.json
+		python bench_net.py --out BENCH_NET_r15_cpu.json
+
+# clustered + personalized federation sweep (fedmse_tpu/cluster/,
+# DESIGN.md §19): K in {1,2,4,8} x score_kind x clustered/personalized
+# over the typed multimodal + Dirichlet label-shift grids, the K=1
+# bitwise pin, assignment padding-invariance, the churn join-composition
+# row and the serving cluster-swap zero-retrace pin (writes
+# CLUSTER_r15.json; hermetic CPU like the tests — the AUC axis is
+# backend-independent)
+cluster-sweep:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python cluster_sweep.py --out CLUSTER_r15.json
 
 tpu-check:
 	python tpu_check.py

@@ -32,7 +32,7 @@ The protocol (ISSUE 13 acceptance):
 Open-loop discipline: clients send on a fixed schedule (or saturate the
 socket in the overload phase) and read results opportunistically —
 completions never pace arrivals, so the measured system cannot set its
-own offered load. Writes BENCH_NET_r13_cpu.json (`make net-bench`).
+own offered load. Writes BENCH_NET_r15_cpu.json (`make net-bench`).
 """
 
 import json
@@ -411,6 +411,70 @@ def run_remote_replica_row(rows_total=131072):
             s.wait(timeout=30)
 
 
+def run_live_autoscale_phase(duration=6.0):
+    """LIVE autoscale apply (ISSUE 15 satellite; closes the PR 13 "the
+    policy is unit-tested + traced offline" headroom): a server started
+    at ONE replica with `--autoscale` takes open-loop flood load; the
+    front's scale ticks must actually GROW the running fleet (warmed
+    local replicas through the replica factory, buckets resized, the
+    admission capacity re-scaled) while the stream stays exactly-once.
+    The row records applied-vs-planned for every decision: `decided_mix`
+    is what the policy wanted, `replicas_now` what the front applied."""
+    from fedmse_tpu.net.client import NetClient
+
+    # Supply model: the calibration probe runs against a QUIESCENT
+    # 1-replica server, but this phase floods it with two co-located
+    # loader processes on the same 2 cores — effective capacity is
+    # roughly half the probe, so the supply model derates by 0.5 (the
+    # sequential-probe overstatement admission.py documents, applied to
+    # the autoscaler). Target util 0.45 then makes the demand case for
+    # a second replica deterministic across box weather; the 3 s
+    # cooldown (server default) rides out the arrival-EMA dip the new
+    # replica's warmup causes.
+    server, port = _spawn_server(
+        replicas=1, extra=("--autoscale", "--autoscale-interval-s", "0.5",
+                           "--autoscale-target-util", "0.45",
+                           "--autoscale-capacity-derate", "0.5"))
+    try:
+        ctl = NetClient("127.0.0.1", port, timeout_s=60.0)
+        replicas_before = ctl.stats()["router"]["replicas"]
+        loaders = _spawn_loaders(port, 2, 0.0, duration, tiers=False,
+                                 burst=4096)
+        outs = _collect(loaders)
+        st = ctl.stats()
+        ctl.close()
+    finally:
+        server.terminate()
+        server.wait(timeout=30)
+    events = st.get("autoscale_events", [])
+    applied_vs_planned = [{
+        "action": e["action"],
+        "planned_replicas": sum(e["decided_mix"].values()),
+        "applied_replicas": e["replicas_now"],
+        "planned_bucket": e["bucket"],
+        "applied": bool(e["replicas_now"]
+                        == sum(e["decided_mix"].values())),
+        "reason": e["reason"],
+    } for e in events]
+    grew = st["router"]["replicas"] > replicas_before
+    matched = all(a["planned_replicas"] == a["applied_replicas"]
+                  for a in applied_vs_planned)
+    return {
+        "replicas_before": replicas_before,
+        "replicas_after": st["router"]["replicas"],
+        "scaled_up_live": bool(grew),
+        "applied_matches_planned": bool(matched and events),
+        "events": applied_vs_planned,
+        "scored_rows_per_sec": round(
+            sum(o["scored_rows_per_sec"] for o in outs), 1),
+        "exactly_once": all(o["exactly_once"] for o in outs),
+        "note": "server started at 1 replica with --autoscale; scale "
+                "ticks applied live (warmed replicas via the factory, "
+                "buckets resized, admission capacity re-scaled) under "
+                "open-loop flood",
+    }
+
+
 def autoscaler_trace(steady, overload, inproc):
     """The SLO policy + cost model replayed over the measured demand
     curve: what the plane would buy at each phase (arxiv 2509.14920 —
@@ -534,6 +598,7 @@ def main():
     probe, steady, overload, server_stats = run_networked_phases(duration)
     remote = run_remote_replica_row()
     trace = autoscaler_trace(steady, overload, inproc)
+    live_scale = run_live_autoscale_phase(duration)
 
     net_rate = probe["sustained_rows_per_sec"]
     ratio = net_rate / inproc["rows_per_sec"]
@@ -565,13 +630,21 @@ def main():
         "shed_lowest_tier_first": bool(shed_ordered
                                        and overload["shed_by_tier"][0]
                                        == 0),
+        # live autoscale apply (ISSUE 15 satellite): the policy's scale
+        # decisions must land on the RUNNING fleet, applied == planned,
+        # with the flooded stream still exactly-once
+        "autoscale_applied_live": bool(live_scale["scaled_up_live"]
+                                       and live_scale["exactly_once"]
+                                       and live_scale[
+                                           "applied_matches_planned"]),
     }
     acceptance["met"] = bool(
         acceptance["ratio_ok"] and acceptance["p99_ok"]
         and acceptance["exactly_once"]
         and acceptance["swap_and_roster_mid_load"]
         and acceptance["shed_only_over_capacity"]
-        and acceptance["shed_lowest_tier_first"])
+        and acceptance["shed_lowest_tier_first"]
+        and acceptance["autoscale_applied_live"])
 
     device = jax.devices()[0]
     out = {
@@ -586,6 +659,7 @@ def main():
         "overload_phase": overload,
         "remote_replica_topology": remote,
         "autoscaler": trace,
+        "autoscale_live_apply": live_scale,
         "server_stats_final": {
             k: v for k, v in server_stats["router"].items()
             if k != "per_replica"},
@@ -596,7 +670,7 @@ def main():
     out.update(capture_provenance())
     line = json.dumps(out)
     print(line)
-    dest = _flag("--out", f"BENCH_NET_r13_{device.platform}.json")
+    dest = _flag("--out", f"BENCH_NET_r15_{device.platform}.json")
     with open(dest, "w") as f:
         f.write(line + "\n")
 

@@ -48,7 +48,7 @@ repo-grown axes):
      the roster-aware router, a mid-load hot swap + roster change,
      tiered shedding engaging only under synthetic overload, every row
      statused exactly once (full protocol: make net-bench ->
-     BENCH_NET_r13_cpu.json)
+     BENCH_NET_r15_cpu.json)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -417,7 +417,7 @@ def scen_net():
     swap + roster change -> shed only under synthetic overload ->
     exactly-once, through a real TCP socket in one process. The
     committed standalone artifact (make net-bench ->
-    BENCH_NET_r13_cpu.json) carries the multi-process open-loop
+    BENCH_NET_r15_cpu.json) carries the multi-process open-loop
     protocol and the >= 0.5x in-process acceptance bar."""
     from bench_net import quick_cell
 
@@ -425,6 +425,22 @@ def scen_net():
     return {"scenario": "network serving plane: 2 replicas over "
                         "localhost TCP, mid-load swap + roster change, "
                         "tiered shedding guard", **row}
+
+
+def scen_cluster():
+    """Scenario 17: clustered + personalized federation (ISSUE 15,
+    fedmse_tpu/cluster/) — the reduced-grid regression guard: typed
+    2-type/8-gateway multimodal grid, K=2 clustered vs single-global on
+    the mse score (cross-type contamination the single global cannot
+    separate), plus the K=1 bitwise pin. The committed standalone
+    artifact (make cluster-sweep -> CLUSTER_r15.json) carries the full
+    K x score_kind x clustered/personalized grids, the churn composition
+    row and the serving zero-retrace pin."""
+    from cluster_sweep import quick_cell
+
+    row = quick_cell()
+    return {"scenario": "clustered federation: typed 2-type grid, K=2 "
+                        "vs single-global, K=1 bitwise pin", **row}
 
 
 def scen_pipeline(cfg, dataset):
@@ -449,9 +465,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-16")
-        if not 1 <= only <= 16:
-            sys.exit(f"--only expects a scenario number 1-16, got {only}")
+            sys.exit("--only expects a scenario number 1-17")
+        if not 1 <= only <= 17:
+            sys.exit(f"--only expects a scenario number 1-17, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -548,6 +564,9 @@ def main():
 
     if only in (None, 16):
         emit(scen_net())
+
+    if only in (None, 17):
+        emit(scen_cluster())
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

@@ -1,0 +1,430 @@
+"""Clustered + personalized federation sweep (ISSUE 15): K cluster-level
+global models vs the single global on the grids where one prior fails —
+the measurement half of fedmse_tpu/cluster/ (DESIGN.md §19).
+
+The PR 7 multimodal grid measured the failure (single-prototype centroid
+AUC 0.17); the PR 10 Dirichlet non-IID + label-shift grids are the
+regime cluster-level models should win. This sweep runs both:
+
+  * **typed multimodal grid** (synthetic_typed_clients — gateways come
+    in T device types with far-apart multimodal manifolds, anomalies
+    between each gateway's own modes): K in {1, 2, 4, 8} x score_kind
+    {mse, centroid, knn} x {clustered, personalized} against the K=1
+    single-global baseline of the SAME score_kind;
+  * **Dirichlet(alpha) + label-shift grid** (synthetic_dirichlet_clients
+    — the PR 10 construction): the non-IID cells;
+  * **K=1 bitwise pin** — ClusterSpec(k=1) vs no spec: states + metrics
+    bit-identical (the lowering-by-construction acceptance);
+  * **padding invariance** — the same fleet padded wider fits the
+    identical assignment (PARITY §8 for clusters);
+  * **churn composition** — a leave-burst + rejoin-wave elastic timeline
+    over the typed grid at K=4: every join recycles into
+    assignment[slot]'s incumbent mean, and the row reports the fraction
+    of joined slots whose latent statistics actually match that cluster
+    (nearest pooled-Gaussian by JS) — acceptance >= 0.9;
+  * **serving zero-retrace** — per-cluster models gathered into the
+    stacked per-gateway layout (cluster.cluster_models) install through
+    an ordinary hot swap with the roster's cluster column riding along,
+    `_cache_size` pinned across the swap.
+
+Writes CLUSTER.json (override with --out) and prints one line per row.
+Run: `make cluster-sweep` (env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+python cluster_sweep.py --out CLUSTER_r15.json). Hermetic CPU like the
+tests — the AUC axis is backend-independent; the [K, N]-sheet merge
+targets the same mesh lowering as the default einsum backend.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+DIM = 16
+ROUNDS = 8
+TYPES = 8
+GRID_CLIENTS = 24
+MODES = 3
+
+
+def base_cfg(score_kind="mse", **kw):
+    from fedmse_tpu.config import CompatConfig, ExperimentConfig
+    return ExperimentConfig(
+        dim_features=DIM, hidden_neus=12, latent_dim=5, epochs=10,
+        batch_size=16, num_rounds=ROUNDS, num_participants=0.5,
+        network_size=GRID_CLIENTS, score_kind=score_kind,
+        knn_bank_size=64, knn_k=4,
+        compat=CompatConfig(vote_tie_break=False), **kw)
+
+
+def model_type_for(score_kind: str) -> str:
+    """mse/knn cells run the plain AE (reconstruction must be LEARNED for
+    the cross-type contrast to exist — the shrink penalty pins recon
+    error near 1.0 at these scales, measured in the ISSUE 15 probe);
+    centroid keeps the reference hybrid pairing."""
+    return "hybrid" if score_kind == "centroid" else "autoencoder"
+
+
+def build_typed_grid(cfg, n_clients=GRID_CLIENTS, types=TYPES, seed=11):
+    from fedmse_tpu.data import build_dev_dataset, stack_clients
+    from fedmse_tpu.data.synthetic import synthetic_typed_clients
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+    clients = synthetic_typed_clients(
+        n_clients=n_clients, types=types, dim=cfg.dim_features,
+        n_normal=200, n_abnormal=80, modes=MODES, seed=seed)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(
+        run=0, data_seed=cfg.data_seed).data_rng)
+    return stack_clients(clients, dev_x, cfg.batch_size), len(clients)
+
+
+def build_dirichlet_grid(cfg, n_clients=GRID_CLIENTS, alpha=0.1,
+                         label_shift=0.5, seed=7):
+    from fedmse_tpu.data import build_dev_dataset, stack_clients
+    from fedmse_tpu.data.synthetic import synthetic_dirichlet_clients
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+    clients = synthetic_dirichlet_clients(
+        n_clients=n_clients, dim=cfg.dim_features, rows_per_client=200,
+        abnormal_per_client=80, modes=TYPES, alpha=alpha,
+        label_shift=label_shift, seed=seed)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(
+        run=0, data_seed=cfg.data_seed).data_rng)
+    return stack_clients(clients, dev_x, cfg.batch_size), len(clients)
+
+
+def run_cell(cfg, data, n_real, spec=None, elastic=None, label="cell"):
+    """One federation; returns (row, engine). AUC = nanmean over the
+    final full-fleet evaluation (the driver's final_metrics stream)."""
+    import numpy as np
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.parallel import host_fetch
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    model_type = model_type_for(cfg.score_kind)
+    model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
+                       cfg.latent_dim, shrink_lambda=cfg.shrink_lambda)
+    engine = RoundEngine(model, cfg, data, n_real=n_real,
+                         rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                         model_type=model_type, update_type="mse_avg",
+                         fused=True, cluster=spec, elastic=elastic)
+    t0 = time.time()
+    results, _, _ = engine.run_schedule_chunk(0, cfg.num_rounds)
+    sec = (time.time() - t0) / cfg.num_rounds
+    final = np.asarray(host_fetch(engine.evaluate_all(
+        engine.states.params, data.test_x, data.test_m, data.test_y,
+        data.train_xb, data.train_mb)))[:n_real]
+    if results[-1].members is not None:
+        member = np.zeros(n_real, bool)
+        member[results[-1].members] = True
+        final = np.where(member, final, np.nan)
+    row = {
+        "label": label,
+        "score_kind": cfg.score_kind,
+        "k": 1 if spec is None else spec.k,
+        "personalize": bool(spec is not None and spec.personalize),
+        "auc_mean": round(float(np.nanmean(final)), 4),
+        "auc_min": round(float(np.nanmin(final)), 4),
+        "sec_per_round": round(sec, 3),
+        "aggregated_rounds": sum(1 for r in results
+                                 if r.aggregator is not None),
+    }
+    if engine.cluster_assignment is not None:
+        row["cluster_sizes"] = np.bincount(
+            engine.cluster_assignment, minlength=spec.k).tolist()
+        if engine.cluster_fit is not None:
+            row["assignment_consistency"] = round(
+                engine.cluster_fit.consistency(), 4)
+    return row, engine
+
+
+def k1_bitwise_pin(cfg, data, n_real):
+    """ClusterSpec(k=1) lowers to the pre-cluster program: states AND
+    metrics bit-identical to an engine with no spec at all."""
+    import numpy as np
+    import jax
+    from fedmse_tpu.cluster import ClusterSpec
+    _, plain = run_cell(cfg.replace(num_rounds=4), data, n_real,
+                        label="k1-pin-plain")
+    _, null = run_cell(cfg.replace(num_rounds=4), data, n_real,
+                       spec=ClusterSpec(k=1), label="k1-pin-null")
+    states_equal = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(plain.states),
+                        jax.tree.leaves(null.states)))
+    return {"label": "k1_bitwise_pin", "states_bit_identical": states_equal}
+
+
+def padding_invariance(cfg, seed=11):
+    """Same fleet, padded client axis -> identical assignment."""
+    import numpy as np
+    from fedmse_tpu.cluster import ClusterSpec
+    from fedmse_tpu.data import build_dev_dataset, stack_clients
+    from fedmse_tpu.data.synthetic import synthetic_typed_clients
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    clients = synthetic_typed_clients(n_clients=8, types=2, dim=DIM,
+                                      n_normal=160, n_abnormal=64,
+                                      seed=seed)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(run=0).data_rng)
+    model = make_model("hybrid", DIM, cfg.hidden_neus, cfg.latent_dim,
+                       shrink_lambda=cfg.shrink_lambda)
+    vecs = []
+    for pad in (None, 12):
+        data = stack_clients(clients, dev_x, cfg.batch_size,
+                             pad_clients_to=pad)
+        eng = RoundEngine(model, cfg, data, n_real=8,
+                          rngs=ExperimentRngs(run=0), model_type="hybrid",
+                          update_type="mse_avg", fused=True,
+                          cluster=ClusterSpec(k=2))
+        eng._ensure_cluster_fit(0)
+        vecs.append(eng.cluster_assignment)
+    return {"label": "padding_invariance",
+            "assignment": vecs[0].tolist(),
+            "invariant": bool(np.array_equal(vecs[0], vecs[1]))}
+
+
+def churn_composition(cfg, data, n_real):
+    """Leave burst + rejoin wave at K=4: joins recycle into
+    assignment[slot]'s incumbent mean; the row measures how often that
+    cluster is the one the slot's latents statistically match."""
+    import numpy as np
+    from fedmse_tpu.cluster import ClusterSpec, nearest_cluster
+    from fedmse_tpu.federation import ElasticSpec
+
+    spec = ClusterSpec(k=4)
+    elastic = ElasticSpec(leave_p=0.25, join_p=0.6,
+                          leave_window=(2, 4), join_window=(4, None))
+    ccfg = cfg.replace(num_rounds=10)
+    row, engine = run_cell(ccfg, data, n_real, spec=spec, elastic=elastic,
+                           label="churn-composition")
+    fit = engine.cluster_fit
+    # joined slots: any generation advance over the horizon
+    gens = engine.generation_at(ccfg.num_rounds)
+    joined = np.flatnonzero(gens > 0)
+    near = nearest_cluster(fit.means, fit.covs, fit.cl_means, fit.cl_covs,
+                           fit.counts)
+    match = (near[joined] == fit.assignment[joined])
+    rate = float(match.mean()) if len(joined) else 1.0
+    row.update({
+        "label": "churn_composition",
+        "elastic": {"leave_p": 0.25, "join_p": 0.6,
+                    "leave_window": [2, 4], "join_window": [4, None]},
+        "joined_slots": joined.tolist(),
+        "join_cluster_match_rate": round(rate, 4),
+    })
+    return row
+
+
+def serving_zero_retrace(engine, n_real):
+    """Per-cluster models -> stacked per-gateway layout -> hot swap with
+    the cluster column; `_cache_size` pinned across the swap."""
+    import numpy as np
+    import jax
+    from fedmse_tpu.cluster import cluster_models
+    from fedmse_tpu.serving import ServingEngine, ServingRoster
+
+    assignment = engine.cluster_assignment
+    k = engine.cluster.k
+    params = jax.tree.map(lambda t: np.asarray(t)[:n_real],
+                          jax.device_get(engine.states.params))
+    # cluster-level models: each cluster's member-mean (the merge the
+    # round body broadcast; any cluster artifact would do — the swap
+    # mechanics are what this row pins)
+    cl_params = jax.tree.map(
+        lambda t: np.stack([
+            t[assignment == c].mean(axis=0) if (assignment == c).any()
+            else t.mean(axis=0) for c in range(k)]), params)
+    eng = ServingEngine.from_federation(
+        engine.model, "autoencoder", params, score_kind="mse",
+        max_bucket=64,
+        roster=ServingRoster(member=np.ones(n_real, bool),
+                             generation=np.zeros(n_real, np.int64),
+                             cluster=assignment))
+    eng.warmup()
+    cache = eng._score_fn._cache_size()
+    rng = np.random.default_rng(0)
+    rows = rng.normal(size=(64, DIM)).astype(np.float32)
+    gws = (np.arange(64) % n_real).astype(np.int32)
+    before = eng.score(rows, gws)
+    routed = cluster_models(cl_params, assignment)
+    eng.swap_state(params=routed,
+                   roster=ServingRoster(member=np.ones(n_real, bool),
+                                        generation=np.zeros(n_real,
+                                                            np.int64),
+                                        cluster=assignment))
+    after = eng.score(rows, gws)
+    zero_retrace = eng._score_fn._cache_size() == cache
+    # routing parity: after an accepted clustered round every member
+    # already HOLDS its cluster's merge, so installing the gathered
+    # cluster models must be score-identical — each gateway was serving
+    # its cluster model all along (the routing contract, not a no-op)
+    return {"label": "serving_cluster_swap",
+            "k": int(k),
+            "zero_retrace": bool(zero_retrace),
+            "routing_parity": bool(np.allclose(before, after, rtol=1e-4)),
+            "buckets_compiled": len(eng.buckets)}
+
+
+def quick_cell():
+    """Reduced-grid regression guard (bench_suite scenario 17): typed
+    2-type/8-gateway grid, mse score, K=2 clustered vs single-global +
+    the K=1 bitwise pin — small enough for the suite, sharp enough to
+    catch a scoping regression."""
+    import numpy as np
+    cfg = base_cfg("mse").replace(network_size=8, num_rounds=6)
+    data, n_real = build_typed_grid(cfg, n_clients=8, types=2)
+    from fedmse_tpu.cluster import ClusterSpec
+    single, _ = run_cell(cfg, data, n_real, label="quick-single")
+    clustered, eng = run_cell(cfg, data, n_real, spec=ClusterSpec(k=2),
+                              label="quick-k2")
+    pin = k1_bitwise_pin(cfg, data, n_real)
+    delta = clustered["auc_mean"] - single["auc_mean"]
+    return {
+        "single_global_auc": single["auc_mean"],
+        "clustered_k2_auc": clustered["auc_mean"],
+        "delta_auc": round(delta, 4),
+        "cluster_sizes": clustered.get("cluster_sizes"),
+        "k1_bit_identical": pin["states_bit_identical"],
+        "acceptance_met": bool(pin["states_bit_identical"]
+                               and delta >= 0.1),
+    }
+
+
+def main():
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
+    enable_compilation_cache()
+    capture_provenance()
+    import numpy as np
+    import jax
+    from fedmse_tpu.cluster import ClusterSpec
+
+    def emit(row):
+        print(json.dumps(row), flush=True)
+        return row
+
+    rows = []
+    t_start = time.time()
+
+    # ---- typed multimodal grid: K x score_kind x clustered/personalized
+    typed_cache = {}
+    for kind in ("mse", "centroid", "knn"):
+        cfg = base_cfg(kind)
+        if kind not in typed_cache:
+            typed_cache[kind] = build_typed_grid(cfg)
+        data, n_real = typed_cache[kind]
+        for k in (1, 2, 4, 8):
+            spec = None if k == 1 else ClusterSpec(k=k)
+            row, eng = run_cell(cfg, data, n_real, spec=spec,
+                                label=f"multimodal/{kind}/k{k}")
+            rows.append(emit({"grid": "multimodal", **row}))
+            if kind == "mse" and k in (1, 8):
+                prow, _ = run_cell(
+                    cfg, data, n_real,
+                    spec=ClusterSpec(k=k, personalize=True),
+                    label=f"multimodal/{kind}/k{k}-personalized")
+                rows.append(emit({"grid": "multimodal", **prow}))
+            if kind == "mse" and k == 4:
+                serve_engine = eng  # the zero-retrace row's federation
+
+    # ---- Dirichlet non-IID + label shift ----
+    for kind in ("mse", "knn"):
+        cfg = base_cfg(kind)
+        data_d, n_real_d = build_dirichlet_grid(cfg)
+        for k in (1, 4):
+            spec = None if k == 1 else ClusterSpec(k=k)
+            row, _ = run_cell(cfg, data_d, n_real_d, spec=spec,
+                              label=f"dirichlet/{kind}/k{k}")
+            rows.append(emit({"grid": "dirichlet-a0.1-ls0.5", **row}))
+
+    # ---- pins + composition rows ----
+    cfg = base_cfg("mse")
+    data, n_real = typed_cache["mse"]
+    pin = emit(k1_bitwise_pin(cfg, data, n_real))
+    pad = emit(padding_invariance(cfg))
+    churn = emit(churn_composition(cfg, data, n_real))
+    serve = emit(serving_zero_retrace(serve_engine, n_real))
+
+    # ---- acceptance ----
+    def best_delta(kind):
+        """Best SAME-GRID clustered/personalized-minus-single delta for
+        one score_kind (pooling grids would let cross-dataset AUC spread
+        fake — or mask — a win)."""
+        deltas = []
+        for grid in sorted({r["grid"] for r in rows if r.get("grid")}):
+            cells = [r for r in rows if r.get("grid") == grid
+                     and r["score_kind"] == kind]
+            singles = [r["auc_mean"] for r in cells if r["k"] == 1
+                       and not r["personalize"]]
+            multis = [r["auc_mean"] for r in cells if r["k"] > 1
+                      or r["personalize"]]
+            if singles and multis:
+                deltas.append(max(multis) - singles[0])
+        return round(max(deltas), 4) if deltas else None
+
+    deltas = {kind: best_delta(kind) for kind in ("mse", "centroid", "knn")}
+    best = max(d for d in deltas.values() if d is not None)
+    acceptance = {
+        "bar": "K=1 bit-identical to the single-global program; some K>1 "
+               "clustered or personalized cell beats the single-global AUC "
+               "for the same score_kind by >= 0.1 absolute; assignments "
+               "padding-invariant; >= 90% of churn joins recycle into the "
+               "cluster whose incumbents they statistically match; zero "
+               "retrace across cluster-model hot swaps in serving",
+        "k1_bit_identical": pin["states_bit_identical"],
+        "best_delta_auc_by_kind": deltas,
+        "best_delta_auc": best,
+        "delta_ok": bool(best >= 0.1),
+        "padding_invariant": pad["invariant"],
+        "join_cluster_match_rate": churn["join_cluster_match_rate"],
+        "join_match_ok": bool(churn["join_cluster_match_rate"] >= 0.9),
+        "serving_zero_retrace": serve["zero_retrace"],
+        "serving_routing_parity": serve["routing_parity"],
+    }
+    acceptance["met"] = bool(
+        acceptance["k1_bit_identical"] and acceptance["delta_ok"]
+        and acceptance["padding_invariant"] and acceptance["join_match_ok"]
+        and acceptance["serving_zero_retrace"]
+        and acceptance["serving_routing_parity"])
+
+    device = jax.devices()[0]
+    out = {
+        "metric": "clustered + personalized federation AUC vs the single "
+                  f"global on the typed multimodal ({TYPES} types) and "
+                  "Dirichlet(0.1)+label-shift grids "
+                  f"({GRID_CLIENTS} gateways, dim {DIM})",
+        "value": best,
+        "unit": "best same-score-kind AUC delta (K>1 minus K=1)",
+        "rows": rows,
+        "k1_pin": pin,
+        "padding": pad,
+        "churn": churn,
+        "serving": serve,
+        "acceptance": acceptance,
+        "total_seconds": round(time.time() - t_start, 1),
+        "device": str(device),
+        "platform": device.platform,
+    }
+    out.update(capture_provenance())
+    dest = "CLUSTER.json"
+    for i, a in enumerate(sys.argv):
+        if a == "--out" and i + 1 < len(sys.argv):
+            dest = sys.argv[i + 1]
+        elif a.startswith("--out="):
+            dest = a.split("=", 1)[1]
+    with open(dest, "w") as f:
+        f.write(json.dumps(out) + "\n")
+    print(json.dumps({"wrote": dest, "acceptance_met": acceptance["met"],
+                      "best_delta_auc": best}))
+
+
+if __name__ == "__main__":
+    main()

@@ -324,3 +324,12 @@ class CheckpointManager:
     def exists(self, tag: str) -> bool:
         return os.path.exists(self._path(tag)) and \
             os.path.exists(self._path(tag) + ".host.json")
+
+    def extra(self, tag: str) -> Dict:
+        """The snapshot's recorded `extra` dict WITHOUT restoring the
+        Orbax payload — callers that must validate/recover run-scoped
+        metadata (e.g. the clustered federation's gateway->cluster
+        assignment, cluster/assign.assignment_from_extra) read it before
+        committing to the expensive restore."""
+        with open(self._path(tag) + ".host.json") as f:
+            return json.load(f).get("extra", {})

@@ -1,0 +1,35 @@
+"""Clustered + personalized federation (ROADMAP 4; DESIGN.md §19).
+
+K cluster-level global models instead of one: gateways are grouped by
+Gaussian-JS similarity of their latent statistics (assign.py), cluster
+membership folds into the fused round body as a one-hot [K, N] weight
+sheet (merge.py + federation/fused.py `cluster_k`), and personalization
+keeps per-gateway decoders local via layer masks on the same machinery.
+K=1 lowers to the exact single-global program — bit-identity by
+construction."""
+
+from fedmse_tpu.cluster.assign import (ClusterAssignment,
+                                       assignment_from_extra,
+                                       cluster_gaussians, fit_assignments,
+                                       fit_from_states, fit_medoids,
+                                       incumbent_mean_params,
+                                       make_latent_stats_fn, nearest_cluster)
+from fedmse_tpu.cluster.merge import (cluster_models, cluster_one_hot,
+                                      clustered_incumbent_means,
+                                      clustered_tree_mean,
+                                      gather_cluster_rows,
+                                      make_clustered_aggregate_fn,
+                                      normalize_sheet, personalized_broadcast)
+from fedmse_tpu.cluster.similarity import (gaussian_js, gaussian_kl,
+                                           js_to_references, pairwise_js)
+from fedmse_tpu.cluster.spec import ClusterSpec
+
+__all__ = [
+    "ClusterAssignment", "ClusterSpec", "assignment_from_extra",
+    "cluster_gaussians", "cluster_models", "cluster_one_hot",
+    "clustered_incumbent_means", "clustered_tree_mean", "fit_assignments",
+    "fit_from_states", "fit_medoids", "gather_cluster_rows", "gaussian_js",
+    "gaussian_kl", "incumbent_mean_params", "js_to_references",
+    "make_clustered_aggregate_fn", "make_latent_stats_fn", "nearest_cluster",
+    "normalize_sheet", "pairwise_js", "personalized_broadcast",
+]

@@ -1,0 +1,290 @@
+"""Gateway -> cluster assignment: latent statistics, JS k-medoids, and
+the absolute-id-keyed `ClusterAssignment` the rest of the stack carries.
+
+The pipeline (DESIGN.md §19):
+
+  1. **probe encode** — every gateway's normal-train rows are encoded
+     with ONE shared probe model (the incumbent-mean of the current
+     federation params — the same f32 masked einsum the elastic joiner
+     inherits from), so the per-gateway statistics reflect DATA
+     heterogeneity, not model divergence;
+  2. **latent statistics** — masked mean + covariance of each gateway's
+     latents, f32 accumulation (`ops/distance.py` contract), covariance
+     regularized with eps·I so thin shards stay invertible;
+  3. **fit** — the [G, G] Gaussian-JS matrix (cluster/similarity.py, one
+     jitted dispatch) feeds a deterministic host-side k-medoids:
+     most-central seed, farthest-point expansion, Lloyd refinement to a
+     fixpoint. Host control flow over a device-computed matrix — the
+     voting/election discipline applied to clustering;
+  4. **cluster Gaussians** — per-cluster moment-matched pooled Gaussians
+     (mixture mean + within/between covariance) back the
+     nearest-cluster lookup: elastic joins recycle a slot from the
+     NEAREST cluster's incumbent mean, and the churn-composition
+     acceptance row checks joins land in the cluster whose incumbents
+     they statistically match.
+
+Padding/layout invariance (PARITY.md §8): everything is keyed by
+ABSOLUTE gateway id. The stats functions take the real-gateway slice,
+the JS matrix and the medoid fit see only real gateways in absolute
+order, and the probe mean is client_mask-weighted (pad rows carry
+exact-zero weight, and x + 0.0 is exact in IEEE — so the probe is
+bitwise padding-invariant). Mesh size or pad width can therefore never
+re-tenant a cluster (pinned by tests/test_cluster.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedmse_tpu.cluster.similarity import js_to_references, pairwise_js
+from fedmse_tpu.cluster.spec import ClusterSpec
+from fedmse_tpu.federation.state import client_mean_weights
+
+# covariance ridge: keeps thin-shard latent covariances invertible for
+# the JS quadratic form without visibly moving well-conditioned ones
+COV_EPS = 1e-4
+
+
+def incumbent_mean_params(stacked_params: Any, member: jax.Array) -> Any:
+    """The shared probe model: member-weighted mean of the stacked params
+    (f32 accumulation — the elastic incumbent-mean einsum, one leaf rule
+    for probe and joiner alike). `member` is any 0/1 weighting over the
+    stacked axis (client_mask, or member ∧ client_mask under churn)."""
+    w = client_mean_weights(member, jnp.sum(member))
+    return jax.tree.map(
+        lambda leaf: jnp.einsum("n,n...->...", w, leaf,
+                                preferred_element_type=jnp.float32
+                                ).astype(leaf.dtype), stacked_params)
+
+
+def make_latent_stats_fn(model):
+    """Build the jitted per-gateway latent-statistics program:
+
+    fn(probe_params, train_x, train_m) -> (means [G, L], covs [G, L, L])
+
+    `train_x` is batch-major [G, NB, B, D] (the FederatedData layout) or
+    flat [G, S, D]; `train_m` the matching row mask (None = all rows).
+    Masked mean/cov accumulate f32; covs carry the +eps·I ridge."""
+
+    @jax.jit
+    def stats(probe_params, train_x, train_m=None):
+        if train_x.ndim == 4:
+            train_x = train_x.reshape(train_x.shape[0], -1,
+                                      train_x.shape[-1])
+        if train_m is not None and train_m.ndim == 3:
+            train_m = train_m.reshape(train_m.shape[0], -1)
+
+        def one(x, m):
+            latent, _ = model.apply({"params": probe_params}, x)
+            latent = latent.astype(jnp.float32)
+            if m is None:
+                m = jnp.ones(latent.shape[0], jnp.float32)
+            m = m.astype(jnp.float32)
+            cnt = jnp.maximum(jnp.sum(m), 1.0)
+            mean = jnp.einsum("s,sl->l", m, latent,
+                              preferred_element_type=jnp.float32) / cnt
+            d = (latent - mean) * m[:, None]
+            # divide by count (not count-1): the ddof choice is shared by
+            # the numpy oracle comparison in the tests; at S >> L either
+            # convention orders the SAME pairs
+            cov = jnp.einsum("sl,sk->lk", d, (latent - mean) * m[:, None],
+                             preferred_element_type=jnp.float32) / cnt
+            return mean, cov + COV_EPS * jnp.eye(mean.shape[0], dtype=jnp.float32)
+
+        if train_m is None:
+            means, covs = jax.vmap(lambda x: one(x, None))(train_x)
+        else:
+            means, covs = jax.vmap(one)(train_x, train_m)
+        return means, covs
+
+    return stats
+
+
+def fit_medoids(js: np.ndarray, k: int, max_iter: int = 32
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic k-medoids over a symmetrized [G, G] divergence
+    matrix. Returns (assignment [G] int32, medoids [k'] int64) with
+    k' = min(k, G).
+
+    Seeding: the most central gateway (min total divergence) first, then
+    farthest-point (max of min-divergence-to-chosen) — ties resolve to
+    the LOWEST absolute id via argmin/argmax first-hit, so the fit is a
+    pure function of the matrix (no RNG stream to key)."""
+    g = js.shape[0]
+    k = min(k, g)
+    d = 0.5 * (js + js.T)
+    np.fill_diagonal(d, 0.0)
+    medoids = [int(np.argmin(d.sum(axis=1)))]
+    while len(medoids) < k:
+        dist_to_chosen = d[:, medoids].min(axis=1)
+        dist_to_chosen[medoids] = -np.inf  # a medoid can't be re-chosen
+        medoids.append(int(np.argmax(dist_to_chosen)))
+    medoids = np.asarray(sorted(medoids), np.int64)
+    assignment = np.argmin(d[:, medoids], axis=1).astype(np.int32)
+    for _ in range(max_iter):
+        new_medoids = medoids.copy()
+        for c in range(k):
+            members = np.flatnonzero(assignment == c)
+            if not len(members):
+                continue  # empty cluster keeps its medoid (stable labels)
+            intra = d[np.ix_(members, members)].sum(axis=1)
+            new_medoids[c] = int(members[np.argmin(intra)])
+        new_assignment = np.argmin(d[:, new_medoids], axis=1).astype(np.int32)
+        if (new_medoids == medoids).all() \
+                and (new_assignment == assignment).all():
+            break
+        medoids, assignment = new_medoids, new_assignment
+    return assignment, medoids
+
+
+def cluster_gaussians(means: np.ndarray, covs: np.ndarray,
+                      assignment: np.ndarray, k: int
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Moment-matched pooled Gaussian per cluster: mixture mean, plus
+    within-gateway covariance + between-gateway mean spread. Empty
+    clusters report count 0 with an identity-covariance placeholder (the
+    nearest-cluster lookup masks them out). Host numpy/f64 — fit-time
+    analytics, not a hot path."""
+    means = np.asarray(means, np.float64)
+    covs = np.asarray(covs, np.float64)
+    latent = means.shape[1]
+    cl_means = np.zeros((k, latent))
+    cl_covs = np.tile(np.eye(latent), (k, 1, 1))
+    counts = np.zeros(k, np.int64)
+    for c in range(k):
+        members = np.flatnonzero(assignment == c)
+        counts[c] = len(members)
+        if not len(members):
+            continue
+        mu = means[members].mean(axis=0)
+        spread = means[members] - mu
+        cl_means[c] = mu
+        cl_covs[c] = (covs[members].mean(axis=0)
+                      + np.einsum("gl,gk->lk", spread, spread) / len(members))
+    return (cl_means.astype(np.float32), cl_covs.astype(np.float32), counts)
+
+
+def nearest_cluster(means, covs, cl_means, cl_covs,
+                    counts: np.ndarray) -> np.ndarray:
+    """[G] nearest NON-EMPTY cluster of each gateway's latent Gaussian by
+    Gaussian JS (one jitted [G, K] dispatch) — the elastic-join target
+    and the churn-composition metric."""
+    js = np.array(js_to_references(
+        jnp.asarray(means, jnp.float32), jnp.asarray(covs, jnp.float32),
+        jnp.asarray(cl_means, jnp.float32),
+        jnp.asarray(cl_covs, jnp.float32)))  # owned copy: jax arrays view
+    js[:, np.asarray(counts) == 0] = np.inf  # ... read-only through asarray
+    return np.argmin(js, axis=1).astype(np.int32)
+
+
+@dataclasses.dataclass
+class ClusterAssignment:
+    """The fitted gateway -> cluster map, keyed by ABSOLUTE gateway id.
+
+    Carried by the round engines (as the fused program's `cluster_in`
+    input), the checkpoints (`to_extra`/`from_extra` — a resumed run
+    must train under the assignments its states were merged under), and
+    the serving roster (ServingRoster.cluster — each gateway routes to
+    its cluster model)."""
+
+    k: int
+    assignment: np.ndarray          # [n_real] int32, absolute gateway order
+    means: np.ndarray               # [n_real, L] gateway latent means
+    covs: np.ndarray                # [n_real, L, L] gateway latent covs
+    cl_means: np.ndarray            # [k, L] pooled cluster Gaussians
+    cl_covs: np.ndarray             # [k, L, L]
+    counts: np.ndarray              # [k] gateways per cluster
+    fitted_round: int = 0
+
+    def padded(self, n_pad: int) -> np.ndarray:
+        """[n_pad] int32 `cluster_in` vector: pad slots carry cluster 0 —
+        inert, because every weight they could touch is already masked
+        by client_mask/sel_mask (the chaos all-clear idiom)."""
+        out = np.zeros(n_pad, np.int32)
+        out[: len(self.assignment)] = self.assignment
+        return out
+
+    def consistency(self) -> float:
+        """Fraction of gateways whose nearest pooled cluster Gaussian is
+        their OWN cluster — the statistical-match rate the churn
+        composition row holds joins to (>= 0.9 acceptance): a joining
+        tenant recycles into `assignment[slot]`, and this measures how
+        often that is the cluster its latents actually match."""
+        near = nearest_cluster(self.means, self.covs, self.cl_means,
+                               self.cl_covs, self.counts)
+        return float(np.mean(near == self.assignment))
+
+    def to_extra(self) -> Dict:
+        """Checkpoint `extra` payload (JSON-stable)."""
+        return {"cluster_k": int(self.k),
+                "cluster_assignment": self.assignment.tolist(),
+                "cluster_fitted_round": int(self.fitted_round)}
+
+    @staticmethod
+    def from_arrays(k: int, assignment: np.ndarray, means, covs,
+                    fitted_round: int = 0) -> "ClusterAssignment":
+        cl_means, cl_covs, counts = cluster_gaussians(
+            means, covs, assignment, k)
+        return ClusterAssignment(
+            k=k, assignment=np.asarray(assignment, np.int32),
+            means=np.asarray(means, np.float32),
+            covs=np.asarray(covs, np.float32), cl_means=cl_means,
+            cl_covs=cl_covs, counts=counts, fitted_round=fitted_round)
+
+
+def fit_assignments(means, covs, k: int, fitted_round: int = 0,
+                    max_iter: int = 32) -> ClusterAssignment:
+    """JS k-medoids over per-gateway latent statistics -> the carried
+    `ClusterAssignment` (module docstring steps 3-4). The [G, G] matrix
+    is ONE device dispatch; the medoid loop is host control flow."""
+    means = np.asarray(means, np.float32)
+    covs = np.asarray(covs, np.float32)
+    js = np.asarray(pairwise_js(jnp.asarray(means), jnp.asarray(covs)))
+    assignment, _ = fit_medoids(js, k, max_iter=max_iter)
+    return ClusterAssignment.from_arrays(k, assignment, means, covs,
+                                         fitted_round=fitted_round)
+
+
+def fit_from_states(model, spec: ClusterSpec, stacked_params,
+                    train_x, train_m, client_mask, n_real: int,
+                    fitted_round: int = 0,
+                    stats_fn=None) -> ClusterAssignment:
+    """The engines' one-call fit: incumbent-mean probe -> latent stats ->
+    JS k-medoids. `stats_fn` (make_latent_stats_fn(model)) may be passed
+    in so repeated refits reuse one compiled program."""
+    probe = incumbent_mean_params(stacked_params, jnp.asarray(client_mask))
+    if stats_fn is None:
+        stats_fn = make_latent_stats_fn(model)
+    means, covs = stats_fn(probe, jnp.asarray(train_x),
+                           None if train_m is None else jnp.asarray(train_m))
+    return fit_assignments(np.asarray(means)[:n_real],
+                           np.asarray(covs)[:n_real], spec.k,
+                           fitted_round=fitted_round)
+
+
+def assignment_from_extra(extra: Dict, spec: ClusterSpec,
+                          n_real: int) -> Optional[np.ndarray]:
+    """Validate + recover a checkpointed assignment vector. Returns None
+    when the checkpoint predates clustering (caller re-fits); raises a
+    CLEAR error on a K change — the states were merged under the
+    recorded clustering, so resuming under another K would hand every
+    gateway a differently-tenanted cluster model."""
+    k = extra.get("cluster_k")
+    if k is None:
+        return None
+    if int(k) != spec.k:
+        raise ValueError(
+            f"checkpoint was trained with cluster_k={int(k)} but this run "
+            f"uses cluster_k={spec.k}; a K change re-tenants every cluster "
+            "model — resume with the matching ClusterSpec or start fresh")
+    assignment = np.asarray(extra["cluster_assignment"], np.int32)
+    if len(assignment) != n_real:
+        raise ValueError(
+            f"checkpoint assignment covers {len(assignment)} gateways, "
+            f"this federation has {n_real}")
+    return assignment

@@ -1,0 +1,171 @@
+"""Masked per-cluster aggregation: one program, K cluster-global models.
+
+The single-global merge is `Σ_n w_n · params_n` (federation/
+aggregation.py). Clustered federation folds cluster membership in as a
+one-hot [K, N] weight sheet: row k carries the (MSE- or uniformly-)
+weighted, WITHIN-CLUSTER-normalized weights of cluster k's effective
+cohort, and ONE einsum `kn,n...->k...` produces all K cluster models per
+round — same f32 accumulation contract, same round body, no per-cluster
+loop. Everything here is width-polymorphic (shapes derive from the
+arguments — the DESIGN §16 contract), so the tiered cohort program runs
+it unchanged at C ≪ N.
+
+A cluster whose effective cohort is empty this round produces no update
+(`has_update[k] = 0`): its clients keep their entire state — the same
+"missed the broadcast" semantics as chaos broadcast loss — rather than
+receiving (and rejecting, polluting their counters with) a zero model.
+
+Personalization rides the same machinery as LAYER masks, not new math:
+`personalized_broadcast` swaps the non-shared top-level modules
+(decoder/head) of the per-client broadcast tree back to each client's
+own post-training params, so the model a client verifies, loads and
+fedprox-anchors on is cluster-encoder + own-decoder.
+
+`cluster_models` is the serving side: gather the [K, ...] cluster trees
+into the stacked [N, ...] per-gateway layout the multi-tenant
+ServingEngine already routes — a cluster-model hot swap is then an
+ordinary `swap_state(params=...)` with unchanged shapes, i.e. zero
+retrace (pinned by tests/test_cluster.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.ops.losses import mse_loss
+
+
+def cluster_one_hot(cluster_in: jax.Array, k: int) -> jax.Array:
+    """[K, N] f32 membership sheet from the [N] assignment vector."""
+    return (cluster_in[None, :] == jnp.arange(k)[:, None]).astype(jnp.float32)
+
+
+def clustered_tree_mean(params: Any, sheet: jax.Array) -> Any:
+    """Σ_n sheet[k, n] · params_n for every cluster at once: leaves go
+    [N, ...] -> [K, ...], f32 accumulation whatever the leaf dtype (the
+    weighted_tree_mean contract, one more contraction axis)."""
+    def reduce_leaf(t: jax.Array) -> jax.Array:
+        acc = jnp.einsum("kn,n...->k...", sheet, t,
+                         preferred_element_type=jnp.float32)
+        return acc.astype(t.dtype)
+    return jax.tree.map(reduce_leaf, params)
+
+
+def normalize_sheet(raw: jax.Array, cluster_in: jax.Array,
+                    k: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(sheet [K, N], weights [N], has_update [K]) from per-client raw
+    weights: raw masked into its cluster's row and normalized WITHIN the
+    row (MSE-weighting scopes to the voter's cluster). Empty rows stay
+    zero and flag has_update=0."""
+    sheet = cluster_one_hot(cluster_in, k) * raw[None, :]
+    row_sums = jnp.sum(sheet, axis=1)
+    has_update = row_sums > 0
+    sheet = sheet / jnp.maximum(row_sums, 1e-30)[:, None]
+    # per-client weight inside its own cluster's merge (0 elsewhere) —
+    # the [N] observability stream FusedRoundOut.weights carries
+    weights = jnp.sum(sheet, axis=0)
+    return sheet, weights, has_update
+
+
+def make_clustered_aggregate_fn(model, update_type: str, k: int) -> Callable:
+    """Build fn(stacked_params, sel_mask, dev_x, cluster_in, sel_idx=None)
+    -> (cluster_params [K, ...] leaves, weights [N], has_update [K]).
+
+    The clustered twin of aggregation.make_aggregate_fn: identical
+    dev-set MSE scoring (including the compact-cohort `sel_idx` fast
+    path), with the normalization scoped per cluster instead of fleet-
+    wide. At k=1 the sheet is one all-ones row and the math degenerates
+    to the single-global merge — but k=1 engines never build this
+    program at all (they lower to the exact pre-cluster trace; see
+    federation/fused.py)."""
+
+    def dev_mse(params, dev_x):
+        _, recon = model.apply({"params": params}, dev_x)
+        return mse_loss(dev_x, recon)
+
+    @jax.jit
+    def aggregate(stacked_params, sel_mask, dev_x, cluster_in,
+                  sel_idx=None):
+        if update_type == "mse_avg":
+            if sel_idx is not None:  # compact cohort: score only the selected
+                sub = jax.tree.map(lambda t: jnp.take(t, sel_idx, axis=0),
+                                   stacked_params)
+                sub_mses = jax.vmap(dev_mse, in_axes=(0, None))(sub, dev_x)
+                mses = jnp.ones(sel_mask.shape, sub_mses.dtype
+                                ).at[sel_idx].set(sub_mses)
+            else:
+                mses = jax.vmap(dev_mse, in_axes=(0, None))(stacked_params,
+                                                            dev_x)
+            raw = sel_mask / mses
+        else:  # 'avg' and 'fedprox'
+            raw = sel_mask
+        sheet, weights, has_update = normalize_sheet(raw, cluster_in, k)
+        return clustered_tree_mean(stacked_params, sheet), weights, has_update
+
+    return aggregate
+
+
+def gather_cluster_rows(cluster_params: Any, cluster_in: jax.Array) -> Any:
+    """Per-client stacked tree from [K, ...] cluster trees: leaf n is its
+    gateway's cluster model (jnp.take by the assignment vector)."""
+    return jax.tree.map(lambda t: jnp.take(t, cluster_in, axis=0),
+                        cluster_params)
+
+
+def personalized_broadcast(agg_stacked: Any, local_params: Any,
+                           shared: Tuple[str, ...]) -> Any:
+    """Layer-mask personalization over the per-client broadcast tree:
+    top-level modules in `shared` take the cluster merge, every other
+    module keeps the client's OWN (post-local-training) params. Both
+    trees are the flax {"encoder": ..., "decoder": ...} layout with
+    [N, ...] leaves."""
+    missing = [m for m in shared if m not in agg_stacked]
+    if missing:
+        raise ValueError(
+            f"shared modules {missing} not in the param tree "
+            f"(top-level modules: {sorted(agg_stacked)})")
+    return {key: (agg_stacked[key] if key in shared else local_params[key])
+            for key in agg_stacked}
+
+
+def clustered_incumbent_means(params: Any, incumbents: jax.Array,
+                              cluster_in: jax.Array, k: int) -> Any:
+    """Per-client [N, ...] join-inheritance tree for the elastic entry
+    transition: client n's row is the uniform mean of ITS cluster's
+    incumbents; a cluster with no incumbents this round falls back to
+    the fleet incumbent-mean (strictly better than the zero-model corner
+    the fleet-wide path degrades to — a joiner always inherits SOME
+    live model when anyone is live)."""
+    sheet = cluster_one_hot(cluster_in, k) * incumbents[None, :]
+    counts = jnp.sum(sheet, axis=1)
+    has = counts > 0
+    sheet = sheet / jnp.maximum(counts, 1.0)[:, None]
+    fleet_w = incumbents / jnp.maximum(jnp.sum(incumbents), 1.0)
+
+    def per_client(t: jax.Array) -> jax.Array:
+        by_cluster = jnp.einsum("kn,n...->k...", sheet, t,
+                                preferred_element_type=jnp.float32
+                                ).astype(t.dtype)
+        fleet = jnp.einsum("n,n...->...", fleet_w, t,
+                           preferred_element_type=jnp.float32).astype(t.dtype)
+        rows = jnp.take(by_cluster, cluster_in, axis=0)
+        ok = jnp.take(has, cluster_in).reshape(
+            (-1,) + (1,) * (t.ndim - 1))
+        return jnp.where(ok, rows, fleet[None])
+
+    return jax.tree.map(per_client, params)
+
+
+def cluster_models(cluster_params: Any, assignment) -> Any:
+    """Serving-side routing materialization: [K, ...] cluster trees ->
+    the stacked [N, ...] per-gateway layout (gateway g serves
+    cluster_params[assignment[g]]). Shapes match the engine's resident
+    params, so installing the result is a zero-retrace hot swap."""
+    import numpy as np
+    assignment = np.asarray(assignment)
+    return jax.tree.map(lambda t: jnp.take(jnp.asarray(t),
+                                           jnp.asarray(assignment), axis=0),
+                        cluster_params)

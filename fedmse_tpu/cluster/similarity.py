@@ -1,0 +1,79 @@
+"""On-device Gaussian KL/JS divergence — the clustered-federation
+assignment metric (ROADMAP 4; the jax port of `utils/similarity.py`).
+
+`utils/similarity.py` has carried the closed-form Gaussian KL and the
+JS-via-half-mixture approximation since the seed, used only for parity —
+here that math becomes load-bearing: per-gateway latent statistics
+(mean/cov of normal-train latents, cluster/assign.py) are compared by
+Gaussian JS to group gateways into K cluster-level federations. The
+numpy implementation stays the ORACLE (host-side, f64 quadratic form);
+this port runs the G x G pairwise matrix as one jitted vmap with the
+f32 accumulation contract of `ops/distance.py` (quadratic form, trace
+and log-det all accumulate f32 whatever the operand dtype), and is
+parity-pinned against the oracle at float32 tolerance
+(tests/test_cluster.py::test_js_jax_matches_numpy_oracle).
+
+Numerical differences vs the reference formula, by design:
+  * `slogdet(q) - slogdet(p)` instead of `log(det(q)/det(p))` — the
+    determinant of a small-eigenvalue latent covariance underflows f32
+    long before its log-det does; identical value where both are finite;
+  * covariances are regularized by the CALLER (assign.py adds eps·I)
+    so `inv` is well-posed on thin shards — the oracle comparison feeds
+    both implementations the same regularized inputs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.ops.distance import ACCUM, quadratic_form
+
+
+def gaussian_kl(p_mean: jax.Array, p_cov: jax.Array,
+                q_mean: jax.Array, q_cov: jax.Array) -> jax.Array:
+    """KL(N(p)||N(q)) in closed form, f32 accumulation (the jax port of
+    utils/similarity.kl_divergence)."""
+    p_mean, q_mean = p_mean.astype(ACCUM), q_mean.astype(ACCUM)
+    p_cov, q_cov = p_cov.astype(ACCUM), q_cov.astype(ACCUM)
+    k = p_mean.shape[0]
+    q_cov_inv = jnp.linalg.inv(q_cov)
+    tr = jnp.trace(q_cov_inv @ p_cov)
+    maha = quadratic_form(q_mean - p_mean, q_cov_inv)
+    det_ratio = jnp.linalg.slogdet(q_cov)[1] - jnp.linalg.slogdet(p_cov)[1]
+    return 0.5 * (tr + maha - k + det_ratio)
+
+
+def gaussian_js(p_mean: jax.Array, p_cov: jax.Array,
+                q_mean: jax.Array, q_cov: jax.Array) -> jax.Array:
+    """Gaussian JS via the half-mixture approximation (the jax port of
+    utils/similarity.js_divergence): symmetric, >= 0 up to float noise."""
+    mix_mean = 0.5 * (p_mean + q_mean)
+    mix_cov = 0.5 * (p_cov + q_cov)
+    return 0.5 * (gaussian_kl(p_mean, p_cov, mix_mean, mix_cov)
+                  + gaussian_kl(q_mean, q_cov, mix_mean, mix_cov))
+
+
+@jax.jit
+def pairwise_js(means: jax.Array, covs: jax.Array) -> jax.Array:
+    """[G, G] Gaussian-JS matrix over G gateways' latent statistics
+    (means [G, L], covs [G, L, L]) — ONE dispatch for the whole fleet.
+    The matrix is symmetric up to float reduction order; the assignment
+    fitter symmetrizes ((D + Dᵀ)/2) so medoid updates cannot depend on
+    which triangle a float landed in."""
+    def one_vs_all(m, c):
+        return jax.vmap(lambda m2, c2: gaussian_js(m, c, m2, c2))(means, covs)
+    return jax.vmap(one_vs_all)(means, covs)
+
+
+@jax.jit
+def js_to_references(means: jax.Array, covs: jax.Array,
+                     ref_means: jax.Array, ref_covs: jax.Array) -> jax.Array:
+    """[G, K] Gaussian-JS of each gateway's latent Gaussian to K reference
+    (cluster-level) Gaussians — the nearest-cluster lookup of elastic
+    joins and the churn-composition acceptance row (cluster/assign.py
+    nearest_cluster)."""
+    def one(m, c):
+        return jax.vmap(lambda rm, rc: gaussian_js(m, c, rm, rc))(
+            ref_means, ref_covs)
+    return jax.vmap(one)(means, covs)

@@ -1,0 +1,92 @@
+"""ClusterSpec: the clustered/personalized-federation configuration axis.
+
+One global model is the wrong prior for heterogeneous IoT fleets — a
+camera and a thermostat should not share an anomaly manifold (ROADMAP 4;
+the PR 7 multimodal grid measures the failure: single-prototype centroid
+AUC collapses to 0.17). The spec declares how the federation is split:
+
+  * `k`             — number of cluster-level global models. k=1 is the
+                      single-global federation and lowers to the EXACT
+                      pre-cluster round program (bit-identity by
+                      construction, not by tolerance —
+                      tests/test_cluster.py pins states + metrics).
+  * `personalize`   — layer-mask personalization on the same machinery:
+                      the modules named in `shared_modules` (default the
+                      encoder) receive the cluster-level merge, every
+                      other top-level module (decoder/head) stays LOCAL
+                      per gateway — the broadcast each client verifies
+                      and loads is cluster-encoder + own-decoder.
+  * `refit_every`   — assignment cadence in rounds. 0 (default) fits the
+                      gateway->cluster assignment once at round 0 and
+                      keeps it; n > 0 re-fits whenever `refit_every`
+                      rounds have elapsed since the last fit (the fused
+                      schedule re-fits at dispatch-chunk granularity —
+                      an assignment rides a whole chunk).
+  * `metric`        — the assignment similarity. 'js' (Gaussian
+                      Jensen-Shannon over per-gateway latent statistics,
+                      cluster/similarity.py — the jax port of
+                      utils/similarity.py, parity-pinned) is the one
+                      supported metric; `similarity_score`'s KDE path is
+                      deliberately NOT an assignment metric — PARITY.md
+                      §9 records why (per-sample KDE cost, bandwidth
+                      instability on thin shards, and it measures the
+                      wrong thing: score-distribution overlap of a
+                      fitted KDE, not traffic-distribution similarity).
+
+Like ChaosSpec/ElasticSpec, validation is eager (a bad K must fail at
+construction, not as a silent mis-shaped one-hot under jit) and
+`signature()` feeds the checkpoint-compat guard: a snapshot trained
+under one clustering must not silently resume under another — a K
+change re-tenants every cluster model (checkpointing extra, main.py
+resume_expected).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterSpec:
+    """Clustered + personalized federation knobs (module docstring)."""
+
+    k: int = 1
+    personalize: bool = False
+    refit_every: int = 0
+    metric: str = "js"
+    shared_modules: Tuple[str, ...] = ("encoder",)
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"k must be >= 1, got {self.k}")
+        if self.refit_every < 0:
+            raise ValueError(
+                f"refit_every must be >= 0 (0 = fit once), got "
+                f"{self.refit_every}")
+        if self.metric != "js":
+            raise ValueError(
+                f"unknown assignment metric {self.metric!r}: 'js' (Gaussian "
+                "Jensen-Shannon over per-gateway latent statistics) is the "
+                "supported metric; the reference's KDE similarity_score is "
+                "deliberately not an assignment metric — PARITY.md §9")
+        if self.personalize and not self.shared_modules:
+            raise ValueError(
+                "personalize=True needs at least one shared module "
+                "(an empty shared set federates nothing — that is local "
+                "training, not personalized federation)")
+
+    @property
+    def is_null(self) -> bool:
+        """True when the spec changes nothing: k=1 without personalization
+        IS the single-global program (the bit-identity lowering)."""
+        return self.k == 1 and not self.personalize
+
+    def signature(self) -> str:
+        """Canonical string for the checkpoint-compat guard (JSON-stable,
+        the ElasticSpec.signature idiom): a K or mask change invalidates
+        resumed assignments with a clear message instead of a deep-Orbax
+        shape error."""
+        shared = ".".join(self.shared_modules)
+        return (f"k{self.k}p{int(self.personalize)}r{self.refit_every}"
+                f"m{self.metric}s{shared}")

@@ -122,6 +122,95 @@ def synthetic_multimodal_clients(
     return clients
 
 
+def synthetic_typed_clients(
+    n_clients: int = 8,
+    types: int = 2,
+    dim: int = 16,
+    n_normal: int = 240,
+    n_abnormal: int = 120,
+    modes: int = 3,
+    type_scale: float = 8.0,
+    seed: int = 0,
+) -> List[ClientData]:
+    """The TYPED multimodal fleet — the clustered-federation extension of
+    `synthetic_multimodal_clients` (ROADMAP 4; DESIGN.md §19).
+
+    Gateways come in `types` device types (client i is type i % types —
+    camera, thermostat, ...). Gateways of a type SHARE that type's
+    `modes` Gaussian mode centers (each gateway still sees a multimodal
+    normal mixture — the PR 7 regime), and the types are far apart
+    (`type_scale`). A gateway's ANOMALIES are another type's normal
+    traffic (drawn from the NEXT type's modes) — the cross-device-
+    contamination threat: a compromised camera gateway starts emitting
+    thermostat-shaped flows. Traffic that is anomalous FOR THIS GATEWAY
+    while being perfectly normal somewhere else in the fleet.
+
+    Why clustering wins here, by construction: the single global model
+    is federated across every type, so the "anomalous" traffic IS part
+    of its training manifold — it reconstructs the contamination as
+    readily as the gateway's own traffic and the separation collapses
+    toward chance. A per-type cluster model never trained on the other
+    type's manifold: own normals reconstruct tightly, cross-type rows
+    stay off-manifold, and the separation survives. Latent statistics
+    cleanly separate the types, so the Gaussian-JS assignment recovers
+    them (cluster/assign.py).
+
+    The contamination is RADIUS-MATCHED: the other type's rows are
+    z-scored in THEIR OWN frame and mapped into this gateway's raw frame
+    (z_other · σ_own + μ_own), so per-gateway standardization reproduces
+    exactly the other type's standardized mode layout — same scale and
+    spread as the gateway's own traffic, different geometry. Without
+    this, cross-type rows are trivial norm outliers under the gateway's
+    scaler and EVERY model (global included) detects them — the
+    distance confound would fake a win for everyone."""
+    rng = np.random.default_rng(seed)
+    type_centers = [rng.normal(0, type_scale, size=(modes, dim))
+                    for _ in range(types)]
+    # per-type population statistics (for the radius-matched z-mapping):
+    # one large draw per type, fixed across clients
+    type_stats = []
+    for t in range(types):
+        pool = (type_centers[t][rng.integers(0, modes, size=2000)]
+                + rng.normal(0, 0.5, size=(2000, dim)))
+        type_stats.append((pool.mean(axis=0), pool.std(axis=0) + 1e-8))
+    clients = []
+    for i in range(n_clients):
+        centers = type_centers[i % types]
+        other_t = (i + 1) % types  # the contaminating type
+        assign = rng.integers(0, modes, size=n_normal)
+        normal = centers[assign] + rng.normal(0, 0.5, size=(n_normal, dim))
+        ab_assign = rng.integers(0, modes, size=n_abnormal)
+        other_rows = (type_centers[other_t][ab_assign]
+                      + rng.normal(0, 0.5, size=(n_abnormal, dim)))
+        o_mu, o_sd = type_stats[other_t]
+        s_mu, s_sd = type_stats[i % types]
+        abnormal = (other_rows - o_mu) / o_sd * s_sd + s_mu
+
+        n_train = int(0.4 * n_normal)
+        n_valid = int(0.1 * n_normal)
+        n_dev = int(0.4 * n_normal)
+        train, valid = normal[:n_train], normal[n_train:n_train + n_valid]
+        dev = normal[n_train + n_valid:n_train + n_valid + n_dev]
+        test = normal[n_train + n_valid + n_dev:]
+
+        proc = IoTDataProcessor(scaler="standard")
+        train_x, _ = proc.fit_transform(train)
+        valid_x, _ = proc.transform(valid)
+        test_x, test_y = proc.transform(test)
+        ab_x, ab_y = proc.transform(abnormal, type="abnormal")
+
+        clients.append(ClientData(
+            name=f"typed-{i % types}-{i + 1}",
+            train_x=train_x.astype(np.float32),
+            valid_x=valid_x.astype(np.float32),
+            test_x=np.concatenate([test_x, ab_x]).astype(np.float32),
+            test_y=np.concatenate([test_y, ab_y]).astype(np.float32),
+            dev_raw=pd.DataFrame(dev),
+            scaler=proc,
+        ))
+    return clients
+
+
 def synthetic_dirichlet_clients(
     n_clients: int = 4,
     dim: int = 16,

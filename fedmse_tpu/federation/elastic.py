@@ -268,7 +268,9 @@ def make_batched_membership_masks(spec: ElasticSpec, elastic_keys,
 
 def apply_membership_transitions(store, member: np.ndarray,
                                  joined: np.ndarray,
-                                 left: np.ndarray) -> None:
+                                 left: np.ndarray,
+                                 assignment: Optional[np.ndarray] = None,
+                                 k: int = 1) -> None:
     """Apply one round's slot-pool ENTRY transitions to a host tier
     (federation/state.TieredClientStore; DESIGN.md §16): under the tiered
     layout joins and leaves mutate host rows directly instead of riding
@@ -283,23 +285,48 @@ def apply_membership_transitions(store, member: np.ndarray,
     under the tiered layout would only see the round's cohort — the host
     tier holds EVERY slot, so the incumbent mean here is the full-fleet
     one (closer to the dense program's semantics, not bitwise: numpy and
-    XLA order the f32 reduction differently)."""
+    XLA order the f32 reduction differently).
+
+    `assignment` ([n] int32 gateway -> cluster, fedmse_tpu/cluster/)
+    makes the inheritance cluster-scoped: a joiner recycles from ITS
+    cluster's incumbent mean (the dense clustered program's
+    clustered_incumbent_means rule), falling back to the fleet mean when
+    its cluster has no incumbents this round."""
     member = np.asarray(member) > 0
     joined_b = np.asarray(joined) > 0
     left_b = np.asarray(left) > 0
     host = store.host
     if joined_b.any():
         incumbents = (member & ~joined_b).astype(np.float32)
-        w = incumbents / max(float(incumbents.sum()), 1.0)
+        fleet_w = incumbents / max(float(incumbents.sum()), 1.0)
         rows = np.flatnonzero(joined_b)
-        # the joiner's model AND its prev_global are the incumbent mean of
-        # the PARAMS (fused.py sets both from mean_params)
-        for p_leaf, g_leaf in zip(jax.tree.leaves(host.params),
-                                  jax.tree.leaves(host.prev_global)):
-            mean = np.einsum("n,n...->...", w,
-                             p_leaf.astype(np.float32)).astype(p_leaf.dtype)
-            p_leaf[rows] = mean
-            g_leaf[rows] = mean
+        if assignment is not None and k > 1:
+            assignment = np.asarray(assignment)
+            sheet = np.zeros((k, len(incumbents)), np.float32)
+            sheet[assignment, np.arange(len(incumbents))] = 1.0
+            sheet *= incumbents[None, :]
+            counts = sheet.sum(axis=1)
+            has = counts > 0
+            sheet /= np.maximum(counts, 1.0)[:, None]
+            w_rows = np.where(has[assignment[rows], None],
+                              sheet[assignment[rows]], fleet_w[None, :])
+            for p_leaf, g_leaf in zip(jax.tree.leaves(host.params),
+                                      jax.tree.leaves(host.prev_global)):
+                mean = np.einsum(
+                    "jn,n...->j...", w_rows,
+                    p_leaf.astype(np.float32)).astype(p_leaf.dtype)
+                p_leaf[rows] = mean
+                g_leaf[rows] = mean
+        else:
+            # the joiner's model AND its prev_global are the incumbent
+            # mean of the PARAMS (fused.py sets both from mean_params)
+            for p_leaf, g_leaf in zip(jax.tree.leaves(host.params),
+                                      jax.tree.leaves(host.prev_global)):
+                mean = np.einsum(
+                    "n,n...->...", fleet_w,
+                    p_leaf.astype(np.float32)).astype(p_leaf.dtype)
+                p_leaf[rows] = mean
+                g_leaf[rows] = mean
         for leaf in jax.tree.leaves(host.hist_params):
             leaf[rows] = 0
         host.hist_perf[rows] = 0.0

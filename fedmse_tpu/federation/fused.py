@@ -36,7 +36,7 @@ phases, so exact bitwise equality is not guaranteed).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -72,10 +72,21 @@ class FusedRoundOut(NamedTuple):
 def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
                      sel_mask: jax.Array, agg_count: jax.Array,
                      vote_x: jax.Array, vote_m: jax.Array, rng: jax.Array,
-                     max_threshold: int) -> Tuple[jax.Array, jax.Array]:
+                     max_threshold: int,
+                     cluster_in: Optional[jax.Array] = None
+                     ) -> Tuple[jax.Array, jax.Array]:
     """First-voter-wins election entirely on device.
 
     Returns (aggregator i32 [-1 if none], winning voter's scores [N]).
+
+    `cluster_in` ([N] i32 cluster assignment — fedmse_tpu/cluster/)
+    scopes each voter's CANDIDACY to its own cluster: the round's merge
+    coordinator comes from the first effective voter's cluster, and a
+    voter whose cluster holds no other quota-eligible candidate simply
+    passes its turn to the next selected voter (the while_loop's
+    existing no-candidate fallthrough). None = fleet-wide candidacy
+    (the single-global program, trace-identical to the pre-cluster
+    election).
     """
     n = sel_mask.shape[0]
     n_sel = sel_indices.shape[0]
@@ -96,6 +107,10 @@ def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
         scores = scores_fn(params, vote_x, vote_m, jax.random.fold_in(rng, i))
         cand = (sel_mask > 0) & (client_ids != voter) & \
                (agg_count < max_threshold)
+        if cluster_in is not None:
+            # clustered federation: a voter only ranks peers of its OWN
+            # cluster — voting scopes to the voter's cluster (DESIGN §19)
+            cand = cand & (cluster_in == cluster_in[voter])
         # a voter masked out of the (effective) cohort casts no vote: under
         # chaos `sel_mask` is selected ∧ available ∧ ¬straggler, and a
         # dropped-out voter's turn passes to the next selected client
@@ -123,13 +138,16 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                     poison_fn: Optional[Callable] = None,
                     chaos: bool = False,
                     elastic: bool = False,
-                    divergence_fn: Optional[Callable] = None) -> Callable:
+                    divergence_fn: Optional[Callable] = None,
+                    cluster_k: int = 1,
+                    personalize: bool = False,
+                    shared_modules: Sequence[str] = ("encoder",)) -> Callable:
     """Build the traceable round body (jit-wrapped by make_fused_round,
     scanned directly by make_fused_rounds_scan):
 
     fn(states, data, ver_x [N,V,D], ver_m [N,V], sel_indices [S],
        sel_mask [N], agg_count [N], rng, round_index[, chaos_in]
-       [, elastic_in])
+       [, elastic_in][, cluster_in])
       -> (states, agg_count, FusedRoundOut)
 
     `data` (FederatedData) and the verification tensors are ARGUMENTS, not
@@ -180,6 +198,35 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
     ElasticSpec is bit-identical to the static program
     (tests/test_elastic.py, the same contract as the chaos masks').
 
+    `cluster_k > 1` compiles CLUSTERED federation into the program
+    (fedmse_tpu/cluster/, DESIGN.md §19) and adds a trailing [N] i32
+    `cluster_in` assignment vector (absolute-gateway-keyed,
+    cluster/assign.py):
+      * `aggregate` must then be the clustered merge
+        (cluster.make_clustered_aggregate_fn): membership folds in as a
+        one-hot [K, N] weight sheet and ONE einsum produces all K
+        cluster-global models per round, with MSE-weighting normalized
+        WITHIN each cluster;
+      * the election scopes candidacy to the voter's cluster (the
+        round's merge coordinator comes from the first effective
+        voter's cluster — _elect_on_device); verification deltas and
+        performance gates run against each client's OWN cluster's merge
+        (the per-client stacked broadcast — verification.py);
+      * a cluster whose effective cohort is empty this round produces
+        no update: its clients keep their entire state (the chaos
+        broadcast-loss semantics), never "reject" a zero model;
+      * elastic joins inherit the NEAREST cluster's incumbent mean
+        (cluster.clustered_incumbent_means; empty-cluster joins fall
+        back to the fleet mean);
+      * `personalize=True` keeps every top-level module NOT in
+        `shared_modules` local per gateway: the broadcast a client
+        verifies, loads and fedprox-anchors on is cluster-encoder +
+        own-decoder (layer masks on the same machinery, no new math).
+    `cluster_k <= 1` is NOT a one-row sheet: the cluster branches
+    simply do not trace, so the single-global program is byte-for-byte
+    the pre-cluster one — the K=1 bit-identity pin holds by
+    construction (tests/test_cluster.py).
+
     `divergence_fn(params, client_mask) -> [N]`, when given, replaces the
     default dense `tree_client_divergence` for the chaos-only divergence
     observable — the engine passes the explicit shard_map + psum reduction
@@ -198,9 +245,16 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
     inputs, never from a closed-over fleet size.
     """
 
+    # personalize alone (cluster_k == 1) still routes through the cluster
+    # machinery: a one-row sheet merges the shared modules globally while
+    # decoders stay local — the "single-global personalized" lane. The
+    # bit-identity lowering is cluster_k <= 1 AND personalize=False
+    # (ClusterSpec.is_null).
+    clustered = cluster_k > 1 or personalize
+
     def round_body(states: ClientStates, data, ver_x, ver_m, sel_indices,
                    sel_mask, agg_count, rng, round_index, chaos_in=None,
-                   elastic_in=None):
+                   elastic_in=None, cluster_in=None):
         n_pad = data.num_clients_padded
         client_ids = jnp.arange(n_pad)
         member_b = None
@@ -216,12 +270,21 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
             # the PR 5 contract; empty-incumbent clamp degenerates to a
             # zero model — see the module docstring corner)
             incumbents = member * (1.0 - elastic_in.joined)
-            w = client_mean_weights(incumbents, jnp.sum(incumbents))
-            mean_params = jax.tree.map(
-                lambda leaf: jnp.einsum(
-                    "n,n...->...", w, leaf,
-                    preferred_element_type=jnp.float32
-                ).astype(leaf.dtype)[None], states.params)
+            if clustered:
+                # clustered join inheritance: the joiner's slot recycles
+                # from ITS cluster's incumbent mean (empty cluster ->
+                # fleet mean) — fedmse_tpu/cluster/merge.py
+                from fedmse_tpu.cluster.merge import \
+                    clustered_incumbent_means
+                mean_params = clustered_incumbent_means(
+                    states.params, incumbents, cluster_in, cluster_k)
+            else:
+                w = client_mean_weights(incumbents, jnp.sum(incumbents))
+                mean_params = jax.tree.map(
+                    lambda leaf: jnp.einsum(
+                        "n,n...->...", w, leaf,
+                        preferred_element_type=jnp.float32
+                    ).astype(leaf.dtype)[None], states.params)
             # leave invalidates moments; join starts fresh — either way a
             # recycled slot's optimizer never sees the previous tenant's
             reset_opt = joined_b | left_b
@@ -283,7 +346,8 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
         vote_m = data.valid_m[vote_owner]
         aggregator, scores = _elect_on_device(
             scores_fn, states.params, sel_indices, eff_mask, agg_count,
-            vote_x, vote_m, rng, max_threshold)
+            vote_x, vote_m, rng, max_threshold,
+            cluster_in=cluster_in if clustered else None)
 
         # ---- aggregator crash -> on-device re-election (chaos only) ----
         crashed = jnp.int32(-1)
@@ -298,7 +362,8 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                 return _elect_on_device(
                     scores_fn, states.params, sel_indices, mask2, agg_count,
                     vote_x, vote_m, jax.random.fold_in(rng, 0x7FFFFFFE),
-                    max_threshold)
+                    max_threshold,
+                    cluster_in=cluster_in if clustered else None)
 
             crashed = jnp.where(crash_now, aggregator, jnp.int32(-1))
             aggregator, scores = jax.lax.cond(
@@ -311,26 +376,50 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
 
         # ---- aggregate + broadcast + verify (src/main.py:291-312) ----
         def do_aggregate(states):
-            agg_params, weights = aggregate(
-                states.params, agg_mask, data.dev_x,
-                sel_idx=sel_indices if compact_cohort else None)
-            if poison_fn is not None:  # malicious-aggregator tampering point
-                # fold constant is any index the voter loop can't reach
-                agg_params = poison_fn(agg_params, round_index,
-                                       jax.random.fold_in(rng, 0x7FFFFFFF))
+            if clustered:
+                # masked per-cluster merge: ONE einsum over the [K, N]
+                # sheet yields all K cluster-global models; each client's
+                # broadcast is ITS cluster's merge, optionally with the
+                # non-shared modules kept local (cluster/merge.py)
+                from fedmse_tpu.cluster.merge import (gather_cluster_rows,
+                                                      personalized_broadcast)
+                cluster_params, weights, has_update = aggregate(
+                    states.params, agg_mask, data.dev_x, cluster_in,
+                    sel_idx=sel_indices if compact_cohort else None)
+                if poison_fn is not None:  # tampers with ALL K merges
+                    cluster_params = poison_fn(
+                        cluster_params, round_index,
+                        jax.random.fold_in(rng, 0x7FFFFFFF))
+                agg_bcast = gather_cluster_rows(cluster_params, cluster_in)
+                if personalize:
+                    agg_bcast = personalized_broadcast(
+                        agg_bcast, states.params, tuple(shared_modules))
+            else:
+                has_update = None
+                agg_params, weights = aggregate(
+                    states.params, agg_mask, data.dev_x,
+                    sel_idx=sel_indices if compact_cohort else None)
+                if poison_fn is not None:  # malicious-aggregator tampering
+                    # fold constant is any index the voter loop can't reach
+                    agg_params = poison_fn(agg_params, round_index,
+                                           jax.random.fold_in(rng,
+                                                              0x7FFFFFFF))
+                agg_bcast = agg_params
             onehot = (client_ids == aggregator).astype(jnp.float32)
-            outcome = verify(states, agg_params, ver_x, ver_m, onehot,
+            outcome = verify(states, agg_bcast, ver_x, ver_m, onehot,
                              data.client_mask)
             new_states = outcome.states
-            if chaos or elastic:
+            if chaos or elastic or clustered:
                 # broadcast loss: a client that never RECEIVED the broadcast
                 # keeps its entire pre-merge state — params, prev_global,
                 # verifier history, rejected counter. Down clients (dropout,
                 # crashed ex-aggregator) miss it by definition — offline is
                 # offline whether or not they were selected; stragglers are
                 # merely SLOW, still online, and do receive; a RETIRED slot
-                # has nobody listening at all. The elected aggregator holds
-                # the aggregate locally (nothing to lose).
+                # has nobody listening at all. A cluster with NO effective
+                # cohort this round produced no merge — nothing was sent to
+                # its clients. The elected aggregator holds the aggregate
+                # locally (nothing to lose).
                 received = jnp.ones((n_pad,), bool)
                 if chaos:
                     received = ((chaos_in.bcast_drop <= 0)
@@ -338,6 +427,8 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                                 & (client_ids != crashed))
                 if elastic:
                     received = received & member_b
+                if clustered:
+                    received = received & jnp.take(has_update, cluster_in)
                 received = received | (client_ids == aggregator)
                 new_states = tree_select_clients(received, new_states,
                                                  states)
@@ -381,20 +472,29 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
 
 
 def make_fused_round(*args, chaos: bool = False, elastic: bool = False,
-                     divergence_fn: Optional[Callable] = None) -> Callable:
+                     divergence_fn: Optional[Callable] = None,
+                     cluster_k: int = 1, personalize: bool = False,
+                     shared_modules: Sequence[str] = ("encoder",)
+                     ) -> Callable:
     """The single-dispatch round: jitted round body with the incoming states
     buffers donated (they are consumed and replaced every round). With
     `chaos=True` the call takes a trailing single-round ChaosMasks slice;
-    with `elastic=True` a single-round MembershipMasks slice (pass both as
-    KEYWORDS — `chaos_in=` / `elastic_in=` — so either axis composes alone
-    without positional ambiguity)."""
+    with `elastic=True` a single-round MembershipMasks slice; with
+    `cluster_k > 1` a [N] i32 assignment vector (pass all as KEYWORDS —
+    `chaos_in=` / `elastic_in=` / `cluster_in=` — so any axis composes
+    alone without positional ambiguity)."""
     return jax.jit(make_round_body(*args, chaos=chaos, elastic=elastic,
-                                   divergence_fn=divergence_fn),
+                                   divergence_fn=divergence_fn,
+                                   cluster_k=cluster_k,
+                                   personalize=personalize,
+                                   shared_modules=shared_modules),
                    donate_argnums=(0,))
 
 
 def make_fused_rounds_scan(*args, chaos: bool = False, elastic: bool = False,
-                           divergence_fn: Optional[Callable] = None
+                           divergence_fn: Optional[Callable] = None,
+                           cluster_k: int = 1, personalize: bool = False,
+                           shared_modules: Sequence[str] = ("encoder",)
                            ) -> Callable:
     """Build the whole-schedule runner: `lax.scan` of the raw round body over
     a precomputed selection schedule.
@@ -419,14 +519,23 @@ def make_fused_rounds_scan(*args, chaos: bool = False, elastic: bool = False,
     with [R, N] leaves — federation/elastic.py) the same way: the
     client-slot pool's joins/leaves are data, so a churning fleet runs
     with ZERO recompiles after warmup.
+
+    `cluster_k > 1` adds a `cluster_in=` [N] i32 assignment vector as a
+    round-INVARIANT argument (not an xs leaf): the assignment re-fit
+    cadence is dispatch-chunk granularity (DESIGN §19), so one vector
+    rides the whole scan and a refit simply passes a new vector to the
+    next chunk's dispatch — same shapes, zero recompiles.
     """
     round_body = make_round_body(*args, chaos=chaos, elastic=elastic,
-                                 divergence_fn=divergence_fn)
+                                 divergence_fn=divergence_fn,
+                                 cluster_k=cluster_k,
+                                 personalize=personalize,
+                                 shared_modules=shared_modules)
 
     @partial(jax.jit, donate_argnums=(0,))
     def run_all(states: ClientStates, data, ver_x, ver_m, sel_schedule,
                 sel_masks, agg_count, keys, round_indices, chaos_masks=None,
-                elastic_masks=None):
+                elastic_masks=None, cluster_in=None):
         def step(carry, xs):
             states, agg_count = carry
             sel_indices, sel_mask, key, round_index = xs[:4]
@@ -436,7 +545,7 @@ def make_fused_rounds_scan(*args, chaos: bool = False, elastic: bool = False,
             states, agg_count, out = round_body(states, data, ver_x, ver_m,
                                                 sel_indices, sel_mask,
                                                 agg_count, key, round_index,
-                                                ch, el)
+                                                ch, el, cluster_in)
             return (states, agg_count), out
 
         xs = (sel_schedule, sel_masks, keys, round_indices)

@@ -153,6 +153,20 @@ def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
     return programs
 
 
+def clustered_aggregate_for(model, update_type: str, spec):
+    """The cached clustered merge program for a ClusterSpec — ONE home of
+    its cache policy, shared by RoundEngine and TieredRoundEngine (the
+    program depends only on (model, update_type, k): personalization and
+    shared_modules act in the round BODY, after the merge)."""
+    from fedmse_tpu.cluster import make_clustered_aggregate_fn
+    key = ("cluster_agg", model, update_type, spec.k)
+    fn = _PROGRAM_CACHE.get(key)
+    if fn is None:
+        fn = make_clustered_aggregate_fn(model, update_type, spec.k)
+        _cache_put(key, fn)
+    return fn
+
+
 def verification_tensors(cfg: ExperimentConfig, data: FederatedData,
                          n_real: int, n_pad: int):
     """Per-client verification data [N, V, D] / [N, V] (see verification.py
@@ -270,7 +284,8 @@ class RoundEngine:
                  n_real: int, rngs: ExperimentRngs, model_type: str,
                  update_type: str, profile: bool = False,
                  fused: bool = False, poison_fn=None, chaos=None,
-                 elastic=None, mesh=None):
+                 elastic=None, mesh=None, cluster=None,
+                 cluster_assignment=None):
         self.model = model
         self.cfg = cfg
         self.data = data
@@ -357,6 +372,27 @@ class RoundEngine:
         # just a dispatch-path optimization
         self._elastic_premade = None
         self._elastic_horizon = 0
+        # clustered + personalized federation (fedmse_tpu/cluster/,
+        # DESIGN.md §19): a ClusterSpec compiled into the fused program as
+        # a [N] assignment-vector input — same fused-only discipline as
+        # chaos/elastic. `cluster_assignment` pins a fixed assignment
+        # (checkpoint resume, flywheel fine-tune under the serving
+        # roster's cluster column) instead of fitting one.
+        self.cluster = cluster
+        if cluster is not None and not getattr(cluster, "is_null", False) \
+                and (not fused or profile):
+            raise ValueError(
+                "clustered federation is compiled into the fused round "
+                "program; construct the engine with fused=True (and "
+                "profile=False)")
+        self._cluster_assign = None       # fitted ClusterAssignment
+        self._cluster_vec = None          # [n_real] int32 assignment
+        self._cluster_fitted_round = 0
+        self._cluster_override = (None if cluster_assignment is None
+                                  else np.asarray(cluster_assignment,
+                                                  np.int32))
+        self._cluster_stats_fn = None     # shared compiled stats program
+        self._warned_cluster_backend = False
         self._fused_round = None
         self._fused_scan = None
         self._fused_compact = None  # compact value baked into the programs
@@ -378,6 +414,30 @@ class RoundEngine:
         self._fused_backend = self.agg_backend
         aggregate = self._aggregate_for(self._fused_backend)
         divergence_fn = self._divergence_for(self._fused_backend)
+        spec = self.cluster
+        cluster_on = spec is not None and not spec.is_null
+        cluster_kw = {}
+        if cluster_on:
+            # the clustered merge is the [K, N]-sheet einsum (cluster/
+            # merge.py); on a sharded mesh jit auto-partitions it to
+            # partial sums + all-reduce — the same lowering story as the
+            # default backend. The EXPLICIT shard_map/int8 collectives
+            # are single-model programs, so they degrade here (their
+            # per-hop error bounds transfer per-cluster unchanged — the
+            # merge is K independent weighted reductions; DESIGN §19).
+            if self._fused_backend != "einsum" \
+                    and not self._warned_cluster_backend:
+                self._warned_cluster_backend = True
+                logger.debug(
+                    "aggregation_backend=%s degrades to the clustered "
+                    "einsum merge under cluster_k=%d (jit auto-partitions "
+                    "the [K, N] sheet on the mesh)", self._fused_backend,
+                    spec.k)
+            aggregate = clustered_aggregate_for(self.model,
+                                                self.update_type, spec)
+            cluster_kw = {"cluster_k": spec.k,
+                          "personalize": spec.personalize,
+                          "shared_modules": spec.shared_modules}
         args = (self.train_all, self.scores_fn, aggregate, self.verify,
                 self.evaluate_all, self.cfg.max_aggregation_threshold,
                 self._fused_compact, self.poison_fn)
@@ -387,16 +447,18 @@ class RoundEngine:
         # by the already-cached phase callables, so identity works — except
         # with an attack poison_fn (arbitrary callable, not cache-keyable)
         key = ("fused",) + args[:-1] + (with_chaos, with_elastic,
-                                        divergence_fn)
+                                        divergence_fn,
+                                        tuple(sorted(cluster_kw.items())))
         if self.poison_fn is None and key in _PROGRAM_CACHE:
             self._fused_round, self._fused_scan = _PROGRAM_CACHE[key]
             return
         self._fused_round = make_fused_round(*args, chaos=with_chaos,
                                              elastic=with_elastic,
-                                             divergence_fn=divergence_fn)
+                                             divergence_fn=divergence_fn,
+                                             **cluster_kw)
         self._fused_scan = make_fused_rounds_scan(
             *args, chaos=with_chaos, elastic=with_elastic,
-            divergence_fn=divergence_fn)
+            divergence_fn=divergence_fn, **cluster_kw)
         if self.poison_fn is None:
             _cache_put(key, (self._fused_round, self._fused_scan))
 
@@ -551,6 +613,11 @@ class RoundEngine:
             self._elastic_key = self.rngs.elastic_key()
             self._elastic_premade = None
             self._elastic_horizon = 0
+        if self.cluster is not None and self._cluster_override is None:
+            # a fresh federation re-fits from its fresh init states
+            self._cluster_assign = None
+            self._cluster_vec = None
+            self._cluster_fitted_round = 0
 
     def _chaos_masks(self, start_round: int, n_rounds: int):
         """[n_rounds]-stacked fault tensors for the chunk — a pure function
@@ -628,6 +695,84 @@ class RoundEngine:
             kw["elastic_masks"] = self._elastic_masks(start_round, n_rounds)
         return kw
 
+    # ---- clustered federation (fedmse_tpu/cluster/, DESIGN.md §19) ---- #
+
+    @property
+    def cluster_assignment(self) -> Optional[np.ndarray]:
+        """The current [n_real] gateway -> cluster vector (None until the
+        first clustered dispatch fits it). The serving roster's cluster
+        column and the checkpoint extra read this."""
+        return self._cluster_vec
+
+    @property
+    def cluster_fit(self):
+        """The fitted ClusterAssignment (latent stats + pooled cluster
+        Gaussians — the nearest-cluster/consistency analytics); None when
+        the assignment was pinned rather than fitted."""
+        return self._cluster_assign
+
+    def set_cluster_assignment(self, assignment: np.ndarray,
+                               fitted_round: int = 0) -> None:
+        """Pin the assignment (checkpoint resume: a snapshot's states were
+        merged under ITS assignment, so the resumed schedule must carry it
+        — refit resumes on the recorded cadence clock)."""
+        assignment = np.asarray(assignment, np.int32)
+        if len(assignment) != self.n_real:
+            raise ValueError(f"assignment covers {len(assignment)} "
+                             f"gateways, federation has {self.n_real}")
+        spec = self.cluster
+        if spec is not None and assignment.size \
+                and int(assignment.max()) >= spec.k:
+            raise ValueError(
+                f"assignment references cluster {int(assignment.max())} "
+                f"but the spec has k={spec.k}; a K change re-tenants every "
+                "cluster model — resume with the matching ClusterSpec")
+        self._cluster_vec = assignment
+        self._cluster_assign = None
+        self._cluster_fitted_round = fitted_round
+
+    def _ensure_cluster_fit(self, round_index: int) -> None:
+        """Fit (or cadence-refit) the assignment before a dispatch. The
+        probe is the incumbent-mean model of the CURRENT states, stats are
+        per-gateway latent mean/cov over normal-train rows, the fit is JS
+        k-medoids — all absolute-gateway-keyed (cluster/assign.py). Under
+        the scanned schedule the cadence granularity is the dispatch
+        chunk: the vector fitted at chunk entry rides the whole chunk."""
+        spec = self.cluster
+        if self._cluster_override is not None:
+            if self._cluster_vec is None:
+                self.set_cluster_assignment(self._cluster_override)
+            return
+        due = (self._cluster_vec is None
+               or (spec.refit_every > 0
+                   and round_index - self._cluster_fitted_round
+                   >= spec.refit_every))
+        if not due:
+            return
+        from fedmse_tpu.cluster import fit_from_states, make_latent_stats_fn
+        if self._cluster_stats_fn is None:
+            self._cluster_stats_fn = make_latent_stats_fn(self.model)
+        self._cluster_assign = fit_from_states(
+            self.model, spec, self.states.params, self.data.train_xb,
+            self.data.train_mb, self.data.client_mask, self.n_real,
+            fitted_round=round_index, stats_fn=self._cluster_stats_fn)
+        self._cluster_vec = self._cluster_assign.assignment
+        self._cluster_fitted_round = round_index
+        logger.info("cluster fit at round %d: k=%d sizes=%s", round_index,
+                    spec.k, np.bincount(self._cluster_vec,
+                                        minlength=spec.k).tolist())
+
+    def _cluster_kwargs(self, round_index: int) -> dict:
+        """The `cluster_in=` input for one dispatch ({} when clustering is
+        off or the spec is the null k=1 single-global)."""
+        spec = self.cluster
+        if spec is None or spec.is_null:
+            return {}
+        self._ensure_cluster_fit(round_index)
+        vec = np.zeros(self.n_pad, np.int32)
+        vec[: self.n_real] = self._cluster_vec
+        return {"cluster_in": jnp.asarray(vec)}
+
     def run_round_fused(self, round_index: int,
                         selected: Optional[List[int]] = None,
                         key: Optional[jax.Array] = None) -> RoundResult:
@@ -651,6 +796,7 @@ class RoundEngine:
         if self.elastic is not None:
             kw["elastic_in"] = jax.tree.map(
                 lambda t: t[0], self._elastic_masks(round_index, 1))
+        kw.update(self._cluster_kwargs(round_index))
         self.states, _, out = self._fused_round(
             self.states, self.data, self._ver_x, self._ver_m,
             jnp.asarray(sel_indices), jnp.asarray(sel_mask),
@@ -696,7 +842,8 @@ class RoundEngine:
             self.states, self.data, self._ver_x, self._ver_m, sel_idx, masks,
             agg_count, keys,
             jnp.arange(start_round, start_round + n_rounds, dtype=jnp.int32),
-            **self._mask_kwargs(start_round, n_rounds))
+            **self._mask_kwargs(start_round, n_rounds),
+            **self._cluster_kwargs(start_round))
         return InFlightChunk(start_round=start_round, n_rounds=n_rounds,
                              schedule=schedule, keys=keys, outs=outs,
                              agg_count=out_agg,

@@ -73,7 +73,9 @@ from fedmse_tpu.federation.elastic import (MembershipMasks,
 from fedmse_tpu.federation.fused import FusedRoundOut
 from fedmse_tpu.federation.pipeline import PrefetchedCohort, TieredStats
 from fedmse_tpu.federation.rounds import (RoundResult, _PROGRAM_CACHE,
-                                          _cache_put, _engine_programs,
+                                          _cache_put,
+                                          clustered_aggregate_for,
+                                          _engine_programs,
                                           absorb_fused_out,
                                           split_metric_columns)
 from fedmse_tpu.federation.state import (ClientStates, HostState,
@@ -134,7 +136,7 @@ class TieredRoundEngine:
     def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
                  n_real: int, rngs: ExperimentRngs, model_type: str,
                  update_type: str, poison_fn=None, chaos=None, elastic=None,
-                 mesh=None, init_chunk: int = 4096):
+                 mesh=None, init_chunk: int = 4096, cluster=None):
         if cfg.metric == "time":
             raise ValueError("metric='time' is host-side wall-clock and "
                              "cannot run inside the fused cohort program")
@@ -199,10 +201,71 @@ class TieredRoundEngine:
         # elastic tiers keep the data prefetch but serialize the slab
         self._sync_gather = elastic is not None
 
+        # clustered federation over the tier (fedmse_tpu/cluster/): the
+        # assignment is fitted ONCE, lazily at the first round (so a
+        # resume that re-pins the checkpointed assignment never pays the
+        # full-fleet stats pass for a fit it would discard) — per-gateway
+        # latent stats computed in cohort-width device chunks over the
+        # host tier (no [N, ...] device materialization), keyed by
+        # absolute id so the cohort gather below carries exact per-slot
+        # cluster columns. Cadence refits are a dense-layout feature for
+        # now: the tier's probe would re-stream the whole fleet per refit
+        # (DESIGN §19).
+        self.cluster = cluster
+        self._cluster_vec = None
+        self.cluster_fit = None
+        if cluster is not None and not cluster.is_null \
+                and cluster.refit_every > 0:
+            logger.warning(
+                "state_layout=tiered fits the cluster assignment once; "
+                "refit_every=%d is inert here", cluster.refit_every)
+
         self._fused_round = None
         self.stats = TieredStats()
 
     # ------------------------------------------------------------------ #
+
+    def _ensure_cluster(self) -> None:
+        """Fit the assignment if clustering is on and nothing pinned it
+        (a resume pins the checkpointed vector before the first round)."""
+        if self.cluster is None or self.cluster.is_null \
+                or self._cluster_vec is not None:
+            return
+        self._cluster_vec = self._fit_cluster().assignment
+
+    def _fit_cluster(self):
+        """Latent stats over the host tier in cohort-width chunks -> JS
+        k-medoids (cluster/assign.py). The probe is the host-side mean of
+        the tier's init params (the incumbent mean at round 0)."""
+        from fedmse_tpu.cluster import (ClusterAssignment, fit_assignments,
+                                        make_latent_stats_fn)
+        host = self.store.host
+        probe = jax.tree.map(
+            lambda t: jnp.asarray(t.astype(np.float32).mean(axis=0)
+                                  .astype(t.dtype)), host.params)
+        stats_fn = make_latent_stats_fn(self.model)
+        c, n, hd = self.cohort, self.n_real, self.host_data
+        means, covs = [], []
+        for start in range(0, n, c):
+            stop = min(start + c, n)
+            ids = np.arange(start, start + c, dtype=np.int32)
+            ids[stop - start:] = start  # fixed-width chunk (one executable)
+            rows = np.minimum(ids, n - 1)
+            m, v = stats_fn(probe, jnp.asarray(hd.train_xb[rows]),
+                            jnp.asarray(hd.train_mb[rows]))
+            means.append(np.asarray(m)[: stop - start])
+            covs.append(np.asarray(v)[: stop - start])
+        fit = fit_assignments(np.concatenate(means), np.concatenate(covs),
+                              self.cluster.k)
+        self.cluster_fit: ClusterAssignment = fit
+        logger.info("tiered cluster fit: k=%d sizes=%s", self.cluster.k,
+                    np.bincount(fit.assignment,
+                                minlength=self.cluster.k).tolist())
+        return fit
+
+    @property
+    def cluster_assignment(self):
+        return self._cluster_vec
 
     def _build_fused(self):
         """The cohort round program — the SAME `make_round_body` the dense
@@ -221,19 +284,30 @@ class TieredRoundEngine:
         buffers, which also makes it safe to keep as the next round's
         patch source. Cost: one extra [C]-slab allocation per round —
         O(cohort), the same order as the prefetch buffers."""
+        spec = self.cluster
+        cluster_on = spec is not None and not spec.is_null
+        cluster_kw = {}
+        aggregate = self._programs["aggregate"]
+        if cluster_on:
+            aggregate = clustered_aggregate_for(self.model,
+                                                self.update_type, spec)
+            cluster_kw = {"cluster_k": spec.k,
+                          "personalize": spec.personalize,
+                          "shared_modules": spec.shared_modules}
         args = (self._programs["train_all"], self._programs["scores_fn"],
-                self._programs["aggregate"], self._programs["verify"],
+                aggregate, self._programs["verify"],
                 self._programs["evaluate_all"],
                 self.cfg.max_aggregation_threshold, False, self.poison_fn)
         with_chaos = self.chaos is not None
         with_elastic = self.elastic is not None
-        key = ("tiered_fused",) + args[:-1] + (with_chaos, with_elastic)
+        key = ("tiered_fused",) + args[:-1] + (
+            with_chaos, with_elastic, tuple(sorted(cluster_kw.items())))
         if self.poison_fn is None and key in _PROGRAM_CACHE:
             self._fused_round = _PROGRAM_CACHE[key]
             return
         from fedmse_tpu.federation.fused import make_round_body
         fused = jax.jit(make_round_body(*args, chaos=with_chaos,
-                                        elastic=with_elastic))
+                                        elastic=with_elastic, **cluster_kw))
         if self.poison_fn is None:
             _cache_put(key, fused)
         self._fused_round = fused
@@ -347,6 +421,13 @@ class TieredRoundEngine:
             kw["elastic_in"] = MembershipMasks(
                 member=jnp.asarray(member), joined=jnp.asarray(zeros),
                 left=jnp.asarray(zeros), generation=jnp.asarray(gen))
+        if self._cluster_vec is not None:
+            # cluster columns ride the cohort gather exactly like the
+            # fault/membership columns: absolute-id-keyed, pad lanes
+            # cluster 0 (inert — every weight they touch is masked)
+            cl = self._cluster_vec[rows].copy()
+            cl[pad] = 0
+            kw["cluster_in"] = jnp.asarray(cl)
         return kw
 
     # ------------------------------------------------------------------ #
@@ -421,6 +502,7 @@ class TieredRoundEngine:
         prefetched loop is pinned against; also the replay entry point)."""
         if self._fused_round is None:
             self._build_fused()
+        self._ensure_cluster()
         plan = self._plan(round_index, selected, key)
         self._entry_transitions(round_index)
         pf = self._prefetch(plan)
@@ -438,7 +520,9 @@ class TieredRoundEngine:
             self.store,
             self._elastic_np.member[round_index][: self.n_real],
             self._elastic_np.joined[round_index][: self.n_real],
-            self._elastic_np.left[round_index][: self.n_real])
+            self._elastic_np.left[round_index][: self.n_real],
+            assignment=self._cluster_vec,
+            k=1 if self.cluster is None else self.cluster.k)
 
     def run_rounds(self, start_round: int, num_rounds: int,
                    consume) -> TieredStats:
@@ -454,6 +538,7 @@ class TieredRoundEngine:
         the same contract as the pipelined chunk executor's)."""
         if self._fused_round is None:
             self._build_fused()
+        self._ensure_cluster()
         stats = self.stats
         end = start_round + num_rounds
         if num_rounds <= 0:
@@ -664,7 +749,8 @@ def run_tiered_combination(cfg: ExperimentConfig, data, n_real: int,
                            device_names: Optional[List[str]] = None,
                            mesh=None, resume=None,
                            save_checkpoints: bool = False,
-                           attack=None, chaos=None, elastic=None) -> Dict:
+                           attack=None, chaos=None, elastic=None,
+                           cluster=None) -> Dict:
     """`main.run_combination` for state_layout='tiered': same artifacts,
     same bookkeeping order, same early-stop/resume semantics — the round
     loop runs the cohort executor instead of the dense scanned schedule.
@@ -687,7 +773,8 @@ def run_tiered_combination(cfg: ExperimentConfig, data, n_real: int,
     engine = TieredRoundEngine(model, cfg, data, n_real=n_real, rngs=rngs,
                                model_type=model_type,
                                update_type=update_type, poison_fn=poison_fn,
-                               chaos=chaos, elastic=elastic, mesh=mesh)
+                               chaos=chaos, elastic=elastic, mesh=mesh,
+                               cluster=cluster)
 
     n_pad = data.num_clients_padded
     round_times: List[float] = []
@@ -696,17 +783,34 @@ def run_tiered_combination(cfg: ExperimentConfig, data, n_real: int,
     tag = f"{model_type}_{update_type}_run{run}"
     start_round = 0
     elastic_sig = None if elastic is None else elastic.signature()
+    cluster_sig = None if cluster is None else cluster.signature()
     resume_expected = {"flatten_optimizer": cfg.flatten_optimizer,
-                       "elastic": elastic_sig}
-    resume_defaults = {"flatten_optimizer": False, "elastic": None}
+                       "elastic": elastic_sig, "cluster": cluster_sig}
+    resume_defaults = {"flatten_optimizer": False, "elastic": None,
+                       "cluster": None}
 
     def resume_extra(next_round: int) -> Dict:
         gen = engine.generation_at(next_round)
-        return {"flatten_optimizer": cfg.flatten_optimizer,
-                "elastic": elastic_sig,
-                "elastic_generation": None if gen is None else gen.tolist()}
+        extra = {"flatten_optimizer": cfg.flatten_optimizer,
+                 "elastic": elastic_sig, "cluster": cluster_sig,
+                 "elastic_generation": None if gen is None
+                 else gen.tolist()}
+        if engine.cluster_assignment is not None:
+            extra.update({"cluster_k": cluster.k,
+                          "cluster_assignment":
+                          engine.cluster_assignment.tolist(),
+                          "cluster_fitted_round": 0})
+        return extra
 
     if resume is not None and resume.exists(tag):
+        if cluster is not None and not cluster.is_null:
+            # resume under the RECORDED assignment (K change fails with
+            # the clear cluster message — cluster/assign.py), not the
+            # construction-time fit from fresh init params
+            from fedmse_tpu.cluster import assignment_from_extra
+            vec = assignment_from_extra(resume.extra(tag), cluster, n_real)
+            if vec is not None:
+                engine._cluster_vec = vec
         states, engine.host, start_round, prev_tracking = resume.restore(
             tag, engine.states_for_checkpoint(n_pad),
             expected_extra=resume_expected, extra_defaults=resume_defaults,

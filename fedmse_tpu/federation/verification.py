@@ -146,11 +146,23 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
                ver_x: jax.Array, ver_m: jax.Array,
                agg_onehot: jax.Array, client_mask: jax.Array) -> VerifyOutcome:
         n = ver_x.shape[0]
-        # broadcast the aggregated params to a stacked [N, ...] pytree once
-        agg_stacked = jax.tree.map(
-            lambda t: jnp.broadcast_to(t, (n,) + t.shape), agg_params)
-
-        new_perf = jax.vmap(perf_of, in_axes=(None, 0, 0))(agg_params, ver_x, ver_m)
+        # `agg_params` is either ONE aggregated tree (leaves [...] — the
+        # single-global broadcast, reference semantics) or a PER-CLIENT
+        # stacked tree (leaves [N, ...] — the clustered/personalized
+        # broadcast, fedmse_tpu/cluster/: each client verifies ITS
+        # cluster's merge). The two differ by leaf rank, a trace-time
+        # static, so the single-global trace is untouched (bit-identity).
+        stacked_in = (jax.tree.leaves(agg_params)[0].ndim
+                      == jax.tree.leaves(states.params)[0].ndim)
+        if stacked_in:
+            agg_stacked = agg_params
+            new_perf = jax.vmap(perf_of)(agg_stacked, ver_x, ver_m)
+        else:
+            # broadcast the aggregated params to a stacked [N, ...] pytree
+            agg_stacked = jax.tree.map(
+                lambda t: jnp.broadcast_to(t, (n,) + t.shape), agg_params)
+            new_perf = jax.vmap(perf_of, in_axes=(None, 0, 0))(
+                agg_params, ver_x, ver_m)
 
         is_agg = agg_onehot > 0
         attempted = (client_mask > 0) & ~is_agg  # broadcast receivers

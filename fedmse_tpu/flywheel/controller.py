@@ -78,7 +78,7 @@ class FlywheelController:
                  quorum: int = 2, cooldown_polls: int = 8,
                  min_rows: int = 16, valid_frac: float = 0.25,
                  epochs: Optional[int] = None, clear_on_swap: bool = True,
-                 background: bool = False):
+                 background: bool = False, cluster=None):
         self.batcher = batcher
         self.monitor = monitor
         self.buffer = buffer
@@ -108,6 +108,21 @@ class FlywheelController:
         # background=True runs _finetune on a lazy single-worker executor
         # (module docstring); the pending future gates re-triggering
         self.background = background
+        # clustered fine-tune (fedmse_tpu/cluster/): a ClusterSpec scopes
+        # the fine-tune's merges per cluster. The assignment is PINNED
+        # from the serving roster's cluster column (each gateway must
+        # fine-tune toward the model it serves under), so the fine-tune
+        # engine never re-fits — and the hot swap that installs the
+        # result is per-cluster by construction: each gateway's stacked
+        # row is its cluster's fine-tuned merge.
+        self.cluster = cluster
+        roster0 = getattr(batcher.engine, "roster", None)
+        if cluster is not None and not cluster.is_null and (
+                roster0 is None or roster0.cluster is None):
+            raise ValueError(
+                "a clustered flywheel needs the serving roster's cluster "
+                "column (ServingRoster(cluster=...)): the fine-tune must "
+                "merge under the SAME assignment the engine serves")
         self._executor: Optional[ThreadPoolExecutor] = None
         self._pending = None  # (future, finetune, flagged, t0)
         n = batcher.engine.num_gateways
@@ -234,6 +249,8 @@ class FlywheelController:
                 "finetune_async": self.background,
                 "finetune_metrics": ft_metrics,
                 "buffer": self.buffer.occupancy(),
+                "cluster_k": (None if self.cluster is None
+                              else self.cluster.k),
             })
         # post-swap hygiene: streaks restart (the monitor was rebaselined
         # inside the swap and arms its own cooldown_updates), the
@@ -304,10 +321,28 @@ class FlywheelController:
             run=_FINETUNE_RUN_OFFSET + len(self.events),
             data_seed=self.cfg.data_seed,
             run_seed_stride=self.cfg.run_seed_stride)
+        cluster_kw = {}
+        if self.cluster is not None and not self.cluster.is_null:
+            # merge per cluster under the SERVED assignment (pinned — the
+            # roster's cluster column). Re-validated HERE, not just at
+            # __init__: a later roster hot swap may have installed a
+            # roster without the column, and silently re-fitting a fresh
+            # assignment would merge models no gateway serves under.
+            roster = getattr(self.batcher.engine, "roster", None)
+            if roster is None or roster.cluster is None:
+                raise ValueError(
+                    "clustered flywheel fine-tune: the serving roster no "
+                    "longer carries a cluster column (a roster swap "
+                    "dropped it?); the fine-tune must merge under the "
+                    "SAME assignment the engine serves — install a "
+                    "ServingRoster(cluster=...) before the next trigger")
+            cluster_kw = {"cluster": self.cluster,
+                          "cluster_assignment": roster.cluster}
         engine = RoundEngine(self.model, ft_cfg, finetune.data,
                              n_real=self.buffer.num_gateways, rngs=rngs,
                              model_type=self.model_type,
-                             update_type=self.update_type, fused=True)
+                             update_type=self.update_type, fused=True,
+                             **cluster_kw)
         warm = self._warm_start(eligible)
         # warm's host leaves can zero-copy-ALIAS the live serving
         # engine's resident params (device_get + asarray on CPU), and

@@ -126,7 +126,8 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
                     mesh=None,
                     resume: Optional[CheckpointManager] = None,
                     save_checkpoints: bool = False,
-                    attack=None, chaos=None, elastic=None) -> Dict:
+                    attack=None, chaos=None, elastic=None,
+                    cluster=None) -> Dict:
     """One (model_type, update_type, run): the reference round loop
     (src/main.py:267-365) + final evaluation (src/main.py:368-374).
     `attack` (an AttackSpec) simulates a malicious aggregator tampering
@@ -135,9 +136,12 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     fedmse_tpu/chaos/) injects client churn / stragglers / aggregator
     crashes / broadcast loss into the fused schedule. `elastic` (an
     ElasticSpec, federation/elastic.py) makes membership itself dynamic —
-    joins recycle retired client slots, leaves retire them. All three
-    compose — Byzantine peers PLUS transient faults PLUS a fleet that is
-    never the same twice is the deployment's actual threat model."""
+    joins recycle retired client slots, leaves retire them. `cluster`
+    (a ClusterSpec, fedmse_tpu/cluster/) splits the federation into K
+    cluster-level global models by latent similarity, optionally with
+    per-gateway decoders kept local. All of them compose — Byzantine
+    peers PLUS transient faults PLUS a fleet that is never the same
+    twice is the deployment's actual threat model."""
     if cfg.state_layout == "tiered":
         # cohort-compacted host tiering (federation/tiered.py, DESIGN.md
         # §16): the fleet lives in host RAM and only the round's cohort is
@@ -148,7 +152,7 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
             cfg, data, n_real, model_type, update_type, run, writer=writer,
             early_stop=early_stop, device_names=device_names, mesh=mesh,
             resume=resume, save_checkpoints=save_checkpoints, attack=attack,
-            chaos=chaos, elastic=elastic)
+            chaos=chaos, elastic=elastic, cluster=cluster)
     rngs = ExperimentRngs(run=run, data_seed=cfg.data_seed,
                           run_seed_stride=cfg.run_seed_stride)
     model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
@@ -171,7 +175,8 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     engine = RoundEngine(model, cfg, data, n_real=n_real, rngs=rngs,
                          model_type=model_type, update_type=update_type,
                          fused=cfg.fused_rounds, poison_fn=poison_fn,
-                         chaos=chaos, elastic=elastic, mesh=mesh)
+                         chaos=chaos, elastic=elastic, mesh=mesh,
+                         cluster=cluster)
     if mesh is not None:
         # states were born sharded (state.init_client_states out_shardings);
         # shard_federation re-places them with the same canonical layout
@@ -195,20 +200,44 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
     # None default — resuming them under churn fails with a clear message
     # instead of deep-Orbax confusion (checkpointing/io.py extra_defaults)
     elastic_sig = None if elastic is None else elastic.signature()
+    cluster_sig = None if cluster is None else cluster.signature()
     resume_expected = {"flatten_optimizer": cfg.flatten_optimizer,
-                       "elastic": elastic_sig}
-    resume_defaults = {"flatten_optimizer": False, "elastic": None}
+                       "elastic": elastic_sig,
+                       "cluster": cluster_sig}
+    resume_defaults = {"flatten_optimizer": False, "elastic": None,
+                       "cluster": None}
 
     def resume_extra(next_round: int) -> Dict:
         gen = engine.generation_at(next_round)
-        return {"flatten_optimizer": cfg.flatten_optimizer,
-                "elastic": elastic_sig,
-                # the slot-pool roster at the snapshot round — what a
-                # serving front (or a post-mortem) reads as the fleet's
-                # state without re-expanding the membership timeline
-                "elastic_generation": None if gen is None else gen.tolist()}
+        extra = {"flatten_optimizer": cfg.flatten_optimizer,
+                 "elastic": elastic_sig,
+                 "cluster": cluster_sig,
+                 # the slot-pool roster at the snapshot round — what a
+                 # serving front (or a post-mortem) reads as the fleet's
+                 # state without re-expanding the membership timeline
+                 "elastic_generation": None if gen is None else gen.tolist()}
+        if cluster is not None and not cluster.is_null \
+                and engine.cluster_assignment is not None:
+            # the assignment the snapshot's states were MERGED under —
+            # a resume re-pins it (and a K change fails with a clear
+            # message, cluster/assign.assignment_from_extra)
+            extra.update({
+                "cluster_k": cluster.k,
+                "cluster_assignment": engine.cluster_assignment.tolist(),
+                "cluster_fitted_round": int(engine._cluster_fitted_round)})
+        return extra
 
     if resume is not None and resume.exists(tag):
+        if cluster is not None and not cluster.is_null:
+            # validate + recover the recorded assignment BEFORE the Orbax
+            # restore: a K change must name the cluster mismatch, not
+            # surface as a deep tree error (cluster/assign.py)
+            from fedmse_tpu.cluster import assignment_from_extra
+            saved_extra = resume.extra(tag)
+            vec = assignment_from_extra(saved_extra, cluster, n_real)
+            if vec is not None:
+                engine.set_cluster_assignment(
+                    vec, saved_extra.get("cluster_fitted_round", 0))
         engine.states, engine.host, start_round, prev_tracking = \
             resume.restore(tag, engine.states,
                            expected_extra=resume_expected,
@@ -529,7 +558,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                    use_mesh: bool = False,
                    save_checkpoints: bool = True,
                    resume_dir: Optional[str] = None,
-                   attack=None, chaos=None, elastic=None,
+                   attack=None, chaos=None, elastic=None, cluster=None,
                    batch_runs: bool = False,
                    serve: bool = False, serve_rows: int = 2048,
                    serve_warmup: bool = False,
@@ -600,6 +629,9 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                            "dense-layout only)")
         if not (cfg.fused_rounds and cfg.fused_schedule):
             reasons.append("fused_rounds/fused_schedule disabled")
+        if cluster is not None and not cluster.is_null:
+            reasons.append("--cluster-k (per-run assignment fits are "
+                           "sequential-driver only)")
         if reasons:
             logger.warning("--batch-runs disabled (%s); running runs "
                            "sequentially", "; ".join(reasons))
@@ -640,7 +672,7 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                     writer=writer, early_stop=early_stop,
                     device_names=device_names, mesh=mesh, resume=resume,
                     save_checkpoints=save_checkpoints, attack=attack,
-                    chaos=chaos, elastic=elastic)
+                    chaos=chaos, elastic=elastic, cluster=cluster)
                 best_metrics[model_type][update_type] = max(
                     best_metrics[model_type][update_type], out["best_final"])
                 all_results[f"{model_type}/{update_type}/run{run}"] = {
@@ -658,6 +690,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
         out["chaos"] = dataclasses.asdict(chaos)
     if elastic is not None:  # ... and the membership timeline (elastic.py)
         out["elastic"] = dataclasses.asdict(elastic)
+    if cluster is not None:  # ... and the clustering (fedmse_tpu/cluster/)
+        out["cluster"] = dataclasses.asdict(cluster)
     if serve:
         if not save_checkpoints:
             logger.warning("--serve needs the checkpointed ClientModel tree"
@@ -825,6 +859,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--elastic-initial-members", type=float, default=1.0,
                    help="fraction of slots occupied at round 0 (< 1 leaves "
                         "headroom for joins from the start)")
+    # clustered + personalized federation (fedmse_tpu/cluster/): K
+    # cluster-level global models, gateways grouped by Gaussian-JS
+    # similarity of their latent statistics; composes with every other
+    # axis (elastic joins recycle from the NEAREST cluster's incumbents)
+    p.add_argument("--cluster-k", type=int, default=0,
+                   help="number of cluster-level global models (0/1 = the "
+                        "single-global federation; > 1 compiles the masked "
+                        "per-cluster merge into the fused schedule)")
+    p.add_argument("--cluster-personalize", action="store_true",
+                   help="layer-mask personalization: the encoder is "
+                        "federated (per cluster, or globally at k<=1), "
+                        "each gateway's decoder stays LOCAL — the "
+                        "broadcast a client verifies and loads is "
+                        "cluster-encoder + own-decoder")
+    p.add_argument("--cluster-refit-every", type=int, default=0,
+                   help="assignment re-fit cadence in rounds (0 = fit "
+                        "once at round 0; the fused schedule re-fits at "
+                        "dispatch-chunk granularity)")
     add_cli_overrides(p)
     return p
 
@@ -901,14 +953,23 @@ def main(argv: Optional[List[str]] = None) -> Dict:
             f"{cfg.experiment_name}_elastic-l{elastic.leave_p:g}"
             f"j{elastic.join_p:g}p{elastic.preempt_p:g}"
             f"s{elastic.start_round}{stop_tag}"))
+    cluster = None
+    if args.cluster_k > 1 or args.cluster_personalize:
+        from fedmse_tpu.cluster import ClusterSpec
+        cluster = ClusterSpec(k=max(1, args.cluster_k),
+                              personalize=args.cluster_personalize,
+                              refit_every=args.cluster_refit_every)
+        # same isolation rule as attacked/chaotic/elastic artifacts
+        cfg = cfg.replace(experiment_name=(
+            f"{cfg.experiment_name}_cluster-{cluster.signature()}"))
     # dataset IO comes AFTER the eager spec validation above: a malformed
-    # --attack-*/--chaos-*/--elastic-* flag fails loudly before any file
-    # is touched
+    # --attack-*/--chaos-*/--elastic-*/--cluster-* flag fails loudly
+    # before any file is touched
     dataset = DatasetConfig.from_json(args.dataset_config, args.data_root)
     return run_experiment(cfg, dataset, use_mesh=args.use_mesh,
                           save_checkpoints=not args.no_save,
                           resume_dir=args.resume_dir, attack=attack,
-                          chaos=chaos, elastic=elastic,
+                          chaos=chaos, elastic=elastic, cluster=cluster,
                           batch_runs=args.batch_runs,
                           serve=args.serve, serve_rows=args.serve_rows,
                           serve_warmup=args.serve_warmup,

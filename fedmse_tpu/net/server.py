@@ -416,17 +416,23 @@ def build_synthetic_router(n_gateways: int = 10, dim: int = 115,
                            model_type: str = "hybrid",
                            headroom: float = 0.9,
                            calibrate: bool = True,
-                           warmup: bool = True) -> Router:
+                           warmup: bool = True,
+                           return_factory: bool = False):
     """A self-contained serving plane over a synthetic federation — the
     bench_serve recipe (paper-dimension models, independent inits,
     centroids fit on synthetic normals) wrapped in replicas + admission.
     Scoring throughput is training-quality-independent, so this is the
     deployment every measurement/worker process reconstructs from the
-    (seed, dims) tuple alone."""
+    (seed, dims) tuple alone.
+
+    `return_factory=True` additionally returns a LocalReplica factory
+    building warmed replicas of the SAME deployment — the
+    `NetFront(replica_factory=...)` hook live autoscale apply grows the
+    fleet through (_autoscale_tick)."""
     import jax
 
     from fedmse_tpu.models import init_stacked_params, make_model
-    from fedmse_tpu.net.router import make_local_replicas
+    from fedmse_tpu.net.router import LocalReplica, make_local_replicas
     from fedmse_tpu.serving import ServingEngine, fit_calibration
 
     rng = np.random.default_rng(seed)
@@ -459,7 +465,18 @@ def build_synthetic_router(n_gateways: int = 10, dim: int = 115,
         probe = rng.normal(size=(max_batch, dim)).astype(np.float32)
         probe_g = rng.integers(0, n_gateways, max_batch).astype(np.int32)
         router.calibrate_capacity(probe, probe_g)
-    return router
+    if not return_factory:
+        return router
+
+    def replica_factory(i: int) -> LocalReplica:
+        eng = factory(i)
+        if warmup:
+            eng.warmup()  # a scale-up must not pay XLA compile mid-load
+        return LocalReplica(eng, max_batch=max_batch,
+                            latency_budget_ms=latency_budget_ms,
+                            calibration=calibration, name=f"replica{i}")
+
+    return router, replica_factory
 
 
 def main(argv=None) -> None:
@@ -480,21 +497,68 @@ def main(argv=None) -> None:
                    help="serve without a capacity bucket (a replica "
                         "worker behind a front-tier router: the FRONT "
                         "owns admission, workers must not double-shed)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="attach the SLO autoscaler (net/autoscale.py) "
+                        "with LIVE apply: the drive loop's scale ticks "
+                        "add/remove warmed local replicas and resize "
+                        "buckets through the replica factory; every "
+                        "decision + what was actually applied lands in "
+                        "stats()['autoscale_events']")
+    p.add_argument("--autoscale-max-replicas", type=int, default=4)
+    p.add_argument("--autoscale-interval-s", type=float, default=0.5)
+    p.add_argument("--autoscale-target-util", type=float, default=0.6,
+                   help="supply is kept at demand/target_utilization; "
+                        "scale-down engages below a third of it")
+    p.add_argument("--autoscale-cooldown-s", type=float, default=3.0,
+                   help="hysteresis after an applied change — must ride "
+                        "out the arrival-EMA dip a scale-up's replica "
+                        "warmup causes on a busy box")
+    p.add_argument("--autoscale-capacity-derate", type=float, default=1.0,
+                   help="multiply the calibration-probed per-replica "
+                        "capacity by this fraction in the autoscaler's "
+                        "supply model: the probe runs against a "
+                        "QUIESCENT server, and effective capacity under "
+                        "concurrent load generators / co-located "
+                        "processes is lower (the same overstatement "
+                        "sequential probes have — admission.py)")
     args = p.parse_args(argv)
 
     from fedmse_tpu.utils.platform import enable_compilation_cache
     enable_compilation_cache()  # warmup reuses prior runs' binaries
 
-    router = build_synthetic_router(
+    router, replica_factory = build_synthetic_router(
         n_gateways=args.gateways, dim=args.dim, replicas=args.replicas,
         max_batch=args.max_batch, latency_budget_ms=args.budget_ms,
         tiers=args.tiers, seed=args.seed,
-        calibrate=not args.no_admission)
+        calibrate=not args.no_admission, return_factory=True)
     if args.no_admission:
         router.admission = None
+    autoscaler = None
+    if args.autoscale:
+        from fedmse_tpu.net.autoscale import BackendSpec, SLOAutoscaler
+        adm = router.admission
+        # per-replica supply from the calibration probe (measured, not
+        # modeled): the probed bucket rate is the fleet's, split evenly
+        per_replica = ((adm.capacity_rows_per_sec / len(router.replicas))
+                       if adm is not None
+                       and adm.capacity_rows_per_sec else 50_000.0)
+        per_replica *= args.autoscale_capacity_derate
+        autoscaler = SLOAutoscaler(
+            budget_ms=args.budget_ms,
+            backends=[BackendSpec("cpu", rows_per_sec=per_replica,
+                                  usd_per_hour=0.10,
+                                  max_replicas=args.autoscale_max_replicas)],
+            min_bucket=64, max_bucket=args.max_batch,
+            target_utilization=args.autoscale_target_util,
+            scale_down_utilization=args.autoscale_target_util / 3.0,
+            cooldown_s=args.autoscale_cooldown_s)
 
     async def run():
-        front = NetFront(router, host=args.host, port=args.port)
+        front = NetFront(router, host=args.host, port=args.port,
+                         autoscaler=autoscaler,
+                         replica_factory=(replica_factory
+                                          if args.autoscale else None),
+                         autoscale_interval_s=args.autoscale_interval_s)
         await front.start()
         print(json.dumps({"listening": True, "host": args.host,
                           "port": front.port,

@@ -22,6 +22,9 @@ contract pinned:
   * `mahalanobis_sq` — host-side numpy quadratic form diffᵀ Σ⁻¹ diff
     (similarity.py's closed-form Gaussian KL), f64 like the rest of that
     offline-analytics path.
+  * `quadratic_form` — the SAME quadratic form as a jax op with the f32
+    accumulation contract (the on-device Gaussian-JS assignment metric of
+    fedmse_tpu/cluster/, parity-pinned against the numpy oracle above).
 """
 
 from __future__ import annotations
@@ -67,3 +70,14 @@ def mahalanobis_sq(diff: np.ndarray, cov_inv: np.ndarray) -> float:
     the Gaussian-KL analytics path, utils/similarity.py)."""
     diff = np.asarray(diff, dtype=np.float64)
     return float(diff.T @ np.asarray(cov_inv, dtype=np.float64) @ diff)
+
+
+def quadratic_form(diff: jax.Array, cov_inv: jax.Array) -> jax.Array:
+    """diffᵀ Σ⁻¹ diff on device, f32 accumulation/output whatever the
+    operand dtype — the jax port of `mahalanobis_sq` for the clustered-
+    federation assignment metric (fedmse_tpu/cluster/similarity.py). The
+    contraction runs `preferred_element_type=f32` like every other score
+    surface here; the numpy/f64 version above stays the parity oracle."""
+    diff = diff.astype(ACCUM)
+    return jnp.einsum("i,ij,j->", diff, cov_inv.astype(ACCUM), diff,
+                      preferred_element_type=ACCUM)

@@ -89,10 +89,20 @@ class ServingRoster:
     occupied, and by which tenant generation. Installed at engine build
     (`roster=`) or hot-swapped between dispatches
     (`swap_state(roster=...)` / `ContinuousBatcher.swap(roster=...)`) —
-    host-side metadata, so a roster change never touches the jit cache."""
+    host-side metadata, so a roster change never touches the jit cache.
+
+    `cluster` (optional [N] int32, fedmse_tpu/cluster/) records which
+    cluster-level global model each gateway slot serves under a
+    clustered federation: the routing itself is already materialized in
+    the stacked params (gateway g's row IS its cluster's model —
+    cluster.cluster_models gathers the [K, ...] trees into the [N, ...]
+    layout), so the column is provenance the swap pipeline carries and
+    validates, not a new dispatch path. UNKNOWN_GATEWAY semantics are
+    untouched — membership, not clustering, decides who serves."""
 
     member: np.ndarray      # [N] bool — slot currently serves a tenant
     generation: np.ndarray  # [N] int64 — tenant generation per slot
+    cluster: Optional[np.ndarray] = None  # [N] int32 — cluster per slot
 
     def __post_init__(self):
         object.__setattr__(self, "member",
@@ -104,6 +114,15 @@ class ServingRoster:
             raise ValueError(
                 f"roster member {self.member.shape} and generation "
                 f"{self.generation.shape} must describe the same slots")
+        if self.cluster is not None:
+            object.__setattr__(
+                self, "cluster",
+                np.ascontiguousarray(self.cluster, dtype=np.int32))
+            if self.cluster.shape != self.member.shape:
+                raise ValueError(
+                    f"roster cluster column {self.cluster.shape} must "
+                    f"describe the same slots as member "
+                    f"{self.member.shape}")
 
     @property
     def num_gateways(self) -> int:

@@ -1,0 +1,501 @@
+"""Clustered + personalized federation (fedmse_tpu/cluster/, DESIGN.md §19)
+with the acceptance contracts pinned:
+
+  * the jax Gaussian-KL/JS port matches the numpy oracle
+    (utils/similarity.py) at float32 tolerance — the assignment metric's
+    parity pin;
+  * a null ClusterSpec (k=1, no personalization) lowers to the EXACT
+    single-global program: states, metrics and artifacts bit-identical
+    on CPU (by construction — the cluster branches do not trace);
+  * assignments are padding/layout-invariant (absolute gateway ids,
+    PARITY.md §8) and the JS k-medoids fit is deterministic;
+  * verification/broadcast scope to the voter's cluster: after an
+    accepted round every client holds ITS cluster's merge, clusters
+    never bleed into each other, and personalization keeps per-gateway
+    decoders local;
+  * elastic joins recycle from the NEAREST cluster's incumbent mean;
+  * serving routes each gateway to its cluster model
+    (cluster.cluster_models parity vs a per-cluster oracle) and a
+    cluster-model hot swap is zero-retrace (_cache_size pin) with the
+    roster's cluster column riding along;
+  * checkpoint round-trip of the assignment, with a CLEAR error on a K
+    change.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedmse_tpu.cluster import (ClusterAssignment, ClusterSpec,
+                                assignment_from_extra, cluster_models,
+                                fit_assignments, fit_medoids, gaussian_js,
+                                gaussian_kl, make_latent_stats_fn,
+                                pairwise_js, personalized_broadcast)
+from fedmse_tpu.config import CompatConfig, ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients
+from fedmse_tpu.data.synthetic import synthetic_clients
+from fedmse_tpu.federation import ElasticSpec, RoundEngine
+from fedmse_tpu.models import make_model
+from fedmse_tpu.utils.seeding import ExperimentRngs
+from fedmse_tpu.utils.similarity import js_divergence, kl_divergence
+
+pytestmark = pytest.mark.cluster
+
+DIM = 12
+N = 6
+
+
+def build_cfg(**kw):
+    return ExperimentConfig(
+        dim_features=DIM, network_size=N, epochs=2, batch_size=8,
+        hidden_neus=8, latent_dim=4,
+        compat=CompatConfig(vote_tie_break=False), **kw)
+
+
+def build_data(cfg, pad_to=None, seed=3):
+    clients = synthetic_clients(n_clients=N, dim=DIM, n_normal=120,
+                                n_abnormal=60, seed=seed, noniid=True)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(run=0).data_rng)
+    return stack_clients(clients, dev_x, cfg.batch_size,
+                         pad_clients_to=pad_to)
+
+
+def build_engine(cfg, data, cluster=None, elastic=None, run=0,
+                 update_type="mse_avg"):
+    m = make_model("hybrid", DIM, cfg.hidden_neus, cfg.latent_dim,
+                   shrink_lambda=cfg.shrink_lambda)
+    return RoundEngine(m, cfg, data, n_real=N, rngs=ExperimentRngs(run=run),
+                       model_type="hybrid", update_type=update_type,
+                       fused=True, cluster=cluster, elastic=elastic)
+
+
+def _rand_gaussians(rng, g, latent):
+    means = rng.normal(size=(g, latent)).astype(np.float32)
+    q = rng.normal(size=(g, latent, latent))
+    covs = (np.einsum("gij,gkj->gik", q, q) / latent
+            + 0.1 * np.eye(latent)).astype(np.float32)
+    return means, covs
+
+
+# ----------------------------------------------------------------- spec ----
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="k must be"):
+        ClusterSpec(k=0)
+    with pytest.raises(ValueError, match="refit_every"):
+        ClusterSpec(k=2, refit_every=-1)
+    # the KDE seam is documented, not wired: asking for it names PARITY §9
+    with pytest.raises(ValueError, match="PARITY.md"):
+        ClusterSpec(k=2, metric="kde")
+    with pytest.raises(ValueError, match="shared module"):
+        ClusterSpec(k=2, personalize=True, shared_modules=())
+    assert ClusterSpec(k=1).is_null
+    assert not ClusterSpec(k=1, personalize=True).is_null
+    assert ClusterSpec(k=2).signature() != ClusterSpec(k=4).signature()
+
+
+# ------------------------------------------------ similarity parity pin ----
+
+def test_kl_js_jax_matches_numpy_oracle(rng):
+    """The satellite parity pin: the on-device Gaussian-KL/JS port agrees
+    with the numpy implementation (utils/similarity.py — the oracle, f64
+    quadratic form) at float32 tolerance on random SPD covariances."""
+    means, covs = _rand_gaussians(rng, 6, 5)
+    for i in range(6):
+        for j in range(6):
+            ref_kl = kl_divergence(means[i].astype(np.float64),
+                                   covs[i].astype(np.float64),
+                                   means[j].astype(np.float64),
+                                   covs[j].astype(np.float64))
+            got_kl = float(gaussian_kl(means[i], covs[i], means[j], covs[j]))
+            assert abs(ref_kl - got_kl) <= 1e-3 * max(1.0, abs(ref_kl))
+            ref_js = js_divergence(means[i].astype(np.float64),
+                                   covs[i].astype(np.float64),
+                                   means[j].astype(np.float64),
+                                   covs[j].astype(np.float64))
+            got_js = float(gaussian_js(means[i], covs[i], means[j], covs[j]))
+            assert abs(ref_js - got_js) <= 1e-3 * max(1.0, abs(ref_js))
+    # the batched pairwise matrix is the same math, one dispatch
+    mat = np.asarray(pairwise_js(means, covs))
+    assert mat.shape == (6, 6)
+    assert abs(mat[1, 4] - float(gaussian_js(means[1], covs[1],
+                                             means[4], covs[4]))) < 1e-4
+    # JS is symmetric and ~0 on the diagonal
+    np.testing.assert_allclose(mat, mat.T, atol=1e-3)
+    assert np.abs(np.diag(mat)).max() < 1e-3
+
+
+# ---------------------------------------------------------------- fitter ----
+
+def test_fit_medoids_groups_and_determinism(rng):
+    """Two well-separated synthetic groups cluster cleanly, the fit is a
+    pure function of the matrix, and the pooled-Gaussian consistency
+    metric (the churn-composition acceptance rate) is perfect here."""
+    g = 8
+    means = np.zeros((g, 3), np.float32)
+    means[4:] += 25.0  # two far groups
+    covs = np.tile(0.5 * np.eye(3, dtype=np.float32), (g, 1, 1))
+    means += rng.normal(scale=0.1, size=means.shape).astype(np.float32)
+    fit = fit_assignments(means, covs, k=2)
+    a = fit.assignment
+    assert len(set(a[:4])) == 1 and len(set(a[4:])) == 1
+    assert a[0] != a[4]
+    fit2 = fit_assignments(means, covs, k=2)
+    assert np.array_equal(a, fit2.assignment)  # deterministic
+    assert fit.consistency() == 1.0
+    # k >= G degenerates to singletons without error
+    a_all, _ = fit_medoids(np.asarray(pairwise_js(
+        jax.numpy.asarray(means), jax.numpy.asarray(covs))), k=16)
+    assert len(set(a_all.tolist())) == g
+
+
+def test_assignment_padding_invariance():
+    """PARITY §8 for clusters: the same fleet padded to a wider client
+    axis fits the IDENTICAL assignment — absolute gateway ids, mask-
+    weighted probe (pad rows carry exact-zero weight)."""
+    cfg = build_cfg()
+    data = build_data(cfg)
+    data_pad = build_data(cfg, pad_to=8)
+    eng = build_engine(cfg, data, cluster=ClusterSpec(k=2))
+    eng_pad = build_engine(cfg, data_pad, cluster=ClusterSpec(k=2))
+    eng._ensure_cluster_fit(0)
+    eng_pad._ensure_cluster_fit(0)
+    assert np.array_equal(eng.cluster_assignment,
+                          eng_pad.cluster_assignment)
+
+
+# ----------------------------------------------------- K=1 bitwise pin ----
+
+def test_k1_null_spec_bitwise_identical():
+    """ClusterSpec(k=1) lowers to the exact pre-cluster program: final
+    states AND the per-round artifact stream are bit-identical to an
+    engine built without a spec (same executable by construction)."""
+    cfg = build_cfg(num_rounds=3)
+    data = build_data(cfg)
+    plain = build_engine(cfg, data)
+    null = build_engine(cfg, data, cluster=ClusterSpec(k=1))
+    r_plain, _, _ = plain.run_schedule_chunk(0, 3)
+    r_null, _, _ = null.run_schedule_chunk(0, 3)
+    for a, b in zip(jax.tree.leaves(plain.states),
+                    jax.tree.leaves(null.states)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for ra, rb in zip(r_plain, r_null):
+        assert ra.aggregator == rb.aggregator
+        assert np.array_equal(ra.client_metrics, rb.client_metrics,
+                              equal_nan=True)
+        assert np.array_equal(ra.min_valid, rb.min_valid, equal_nan=True)
+        if ra.agg_weights is not None:
+            assert np.array_equal(ra.agg_weights, rb.agg_weights)
+    assert null.cluster_assignment is None  # the null spec never fits
+
+
+# ----------------------------------------- per-cluster merge scoping ----
+
+def _cluster_rows_equal(tree, idx):
+    """True iff all rows `idx` of every leaf are identical."""
+    for leaf in jax.tree.leaves(tree):
+        rows = np.asarray(leaf)[idx]
+        if not np.allclose(rows, rows[0], rtol=0, atol=0):
+            return False
+    return True
+
+
+def test_per_cluster_verification_and_broadcast_scoping():
+    """After an accepted full-participation round at K=2, every client
+    holds exactly ITS cluster's merge: rows agree within a cluster and
+    differ across clusters — cluster B's params never bleed into A."""
+    cfg = build_cfg(num_rounds=1, num_participants=1.0)
+    data = build_data(cfg)
+    eng = build_engine(cfg, data, cluster=ClusterSpec(k=2))
+    res = eng.run_round_fused(0)
+    assert res.aggregator is not None
+    a = eng.cluster_assignment
+    assert len(set(a.tolist())) == 2
+    params = eng.states.params
+    for c in (0, 1):
+        assert _cluster_rows_equal(params, np.flatnonzero(a == c))
+    leaf0 = np.asarray(jax.tree.leaves(params)[0])
+    assert not np.allclose(leaf0[np.flatnonzero(a == 0)[0]],
+                           leaf0[np.flatnonzero(a == 1)[0]])
+    # the winning voter's weights normalize WITHIN each cluster
+    w = res.agg_weights[:N]
+    for c in (0, 1):
+        np.testing.assert_allclose(w[a == c].sum(), 1.0, rtol=1e-5)
+
+
+def test_personalization_keeps_decoder_local():
+    """personalize=True: encoders converge to the cluster merge, decoders
+    stay per-gateway (the broadcast is cluster-encoder + own-decoder)."""
+    cfg = build_cfg(num_rounds=1, num_participants=1.0)
+    data = build_data(cfg)
+    eng = build_engine(cfg, data,
+                       cluster=ClusterSpec(k=2, personalize=True))
+    res = eng.run_round_fused(0)
+    assert res.aggregator is not None
+    a = eng.cluster_assignment
+    params = eng.states.params
+    for c in (0, 1):
+        idx = np.flatnonzero(a == c)
+        assert _cluster_rows_equal(params["encoder"], idx)
+        if len(idx) > 1:  # decoders must NOT have merged
+            leaf = np.asarray(jax.tree.leaves(params["decoder"])[0])
+            assert not np.allclose(leaf[idx[0]], leaf[idx[1]])
+
+
+def test_personalized_broadcast_helper():
+    agg = {"encoder": {"w": np.ones((4, 3))}, "decoder": {"w": np.full((4, 3), 2.0)}}
+    local = {"encoder": {"w": np.zeros((4, 3))}, "decoder": {"w": np.zeros((4, 3))}}
+    out = personalized_broadcast(agg, local, ("encoder",))
+    assert (np.asarray(out["encoder"]["w"]) == 1.0).all()
+    assert (np.asarray(out["decoder"]["w"]) == 0.0).all()
+    with pytest.raises(ValueError, match="not in the param tree"):
+        personalized_broadcast(agg, local, ("head",))
+
+
+# --------------------------------------------- elastic join inheritance ----
+
+def test_elastic_join_recycles_from_nearest_cluster():
+    """A joining slot inherits ITS cluster's incumbent mean, not the
+    fleet mean: drive the fused body with a crafted membership slice (no
+    election possible, so nothing else moves the joiner's params)."""
+    from fedmse_tpu.federation.elastic import MembershipMasks
+
+    cfg = build_cfg(num_rounds=2)
+    data = build_data(cfg)
+    eng = build_engine(cfg, data, cluster=ClusterSpec(k=2),
+                       elastic=ElasticSpec(leave_p=0.0, join_p=0.0))
+    eng._ensure_cluster_fit(0)
+    a = eng.cluster_assignment
+    joiner = int(np.flatnonzero(a == a[0])[1])  # a peer of client 0
+    pre = jax.tree.map(lambda t: np.asarray(t).copy(), eng.states.params)
+
+    member = np.ones(N, np.float32)
+    joined = np.zeros(N, np.float32)
+    joined[joiner] = 1.0
+    masks = MembershipMasks(
+        member=jax.numpy.asarray(member), joined=jax.numpy.asarray(joined),
+        left=jax.numpy.asarray(np.zeros(N, np.float32)),
+        generation=jax.numpy.asarray(joined.astype(np.int32)))
+    eng._build_fused()
+    sel = [int(np.flatnonzero(a != a[joiner])[0])]  # lone voter, no cand
+    sel_idx, sel_mask = eng._selection_arrays(sel)
+    states, _, out = eng._fused_round(
+        eng.states, eng.data, eng._ver_x, eng._ver_m,
+        jax.numpy.asarray(sel_idx), jax.numpy.asarray(sel_mask),
+        eng._agg_count_padded(), jax.random.key(0),
+        jax.numpy.asarray(0, jax.numpy.int32), elastic_in=masks,
+        **eng._cluster_kwargs(0))
+    assert int(out.aggregator) < 0  # nothing broadcast this round
+    # the joiner's params == the mean of its cluster's OTHER members'
+    # pre-round params (it joined, so it is not its own incumbent)
+    own = np.flatnonzero((a == a[joiner])
+                         & (np.arange(N) != joiner))
+    got = jax.tree.leaves(states.params)
+    want = jax.tree.leaves(pre)
+    fleet_differs = False
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g)[joiner],
+                                   w[own].mean(axis=0), rtol=2e-5,
+                                   atol=1e-6)
+        # ... and NOT the fleet mean (the clusters hold distinct inits);
+        # zero-init bias leaves are trivially equal, so the contrast
+        # only needs SOME leaf (the kernels) to differ
+        fleet = np.delete(w, joiner, axis=0).mean(axis=0)
+        if not np.allclose(np.asarray(g)[joiner], fleet, rtol=1e-4,
+                           atol=1e-7):
+            fleet_differs = True
+    assert fleet_differs
+
+
+# ----------------------------------------------------------- serving ----
+
+def test_serving_cluster_routing_parity_and_zero_retrace():
+    """cluster_models gathers [K, ...] cluster trees into the stacked
+    per-gateway layout: scores match a per-cluster oracle, the swap that
+    installs them is zero-retrace (_cache_size pin), and the roster
+    carries the cluster column."""
+    from fedmse_tpu.models import init_stacked_params
+    from fedmse_tpu.serving import ServingEngine, ServingRoster
+
+    rng = np.random.default_rng(0)
+    model = make_model("autoencoder", DIM)
+    params = init_stacked_params(model, jax.random.key(0), N)
+    eng = ServingEngine.from_federation(model, "autoencoder", params,
+                                        max_bucket=32)
+    eng.warmup()
+    cache = eng._score_fn._cache_size()
+    rows = rng.normal(size=(24, DIM)).astype(np.float32)
+    gws = (np.arange(24) % N).astype(np.int32)
+    base = eng.score(rows, gws)
+
+    # K=2 cluster models: gather per gateway, install as a hot swap with
+    # the cluster column riding the roster
+    assignment = np.asarray([0, 1, 0, 1, 0, 1], np.int32)
+    cl_params = jax.tree.map(
+        lambda t: np.stack([np.asarray(t)[0], np.asarray(t)[3]]), params)
+    routed = cluster_models(cl_params, assignment)
+    roster = ServingRoster(member=np.ones(N, bool),
+                           generation=np.zeros(N, np.int64),
+                           cluster=assignment)
+    eng2 = ServingEngine.from_federation(model, "autoencoder", params,
+                                         max_bucket=32, roster=roster)
+    eng2.warmup()
+    cache2 = eng2._score_fn._cache_size()
+    eng2.swap_state(params=routed, roster=roster)
+    got = eng2.score(rows, gws)
+    assert eng2._score_fn._cache_size() == cache2  # zero retrace
+    assert eng._score_fn._cache_size() == cache
+    assert eng2.roster.cluster is not None
+
+    # oracle: each row scored by its gateway's CLUSTER model directly
+    for c in (0, 1):
+        single = jax.tree.map(lambda t, c=c: np.asarray(t)[c][None],
+                              cl_params)
+        oracle = ServingEngine(model, "autoencoder", single,
+                               multi_tenant=True, max_bucket=32)
+        sel = np.flatnonzero(assignment[gws] == c)
+        np.testing.assert_allclose(
+            got[sel], oracle.score(rows[sel], np.zeros(len(sel), np.int32)),
+            rtol=1e-5, atol=1e-6)
+    # the swap changed what gateways serve (different cluster models)
+    assert not np.allclose(base, got)
+
+    # roster validation: a mis-shaped cluster column fails loudly
+    with pytest.raises(ValueError, match="cluster column"):
+        ServingRoster(member=np.ones(N, bool),
+                      generation=np.zeros(N, np.int64),
+                      cluster=np.zeros(N + 1, np.int32))
+
+
+# ------------------------------------------------------- checkpointing ----
+
+def test_checkpoint_roundtrip_and_k_change_error(tmp_path):
+    """The assignment rides the checkpoint extra: a resume re-pins it
+    (bit-identical continuation), and a K change fails with a message
+    naming the cluster mismatch, not an Orbax tree error."""
+    from fedmse_tpu.checkpointing import CheckpointManager
+
+    cfg = build_cfg(num_rounds=2, fused_schedule_chunk=1)
+    data = build_data(cfg)
+    spec = ClusterSpec(k=2)
+    eng = build_engine(cfg, data, cluster=spec)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    eng.run_round_fused(0)
+    extra = {"cluster": spec.signature(), "cluster_k": spec.k,
+             "cluster_assignment": eng.cluster_assignment.tolist(),
+             "cluster_fitted_round": 0}
+    mgr.save("t", eng.states, eng.host, 1, extra=extra)
+
+    # round-trip: the recorded assignment validates and recovers
+    vec = assignment_from_extra(mgr.extra("t"), spec, N)
+    assert np.array_equal(vec, eng.cluster_assignment)
+
+    # K change: clear mismatch error (the acceptance-named guard)
+    with pytest.raises(ValueError, match="cluster_k=2"):
+        assignment_from_extra(mgr.extra("t"), ClusterSpec(k=4), N)
+    # ... and the signature guard in the restore path names cluster too
+    eng4 = build_engine(cfg, data, cluster=ClusterSpec(k=4))
+    with pytest.raises(ValueError, match="cluster"):
+        mgr.restore("t", eng4.states,
+                    expected_extra={"cluster": ClusterSpec(k=4).signature()},
+                    extra_defaults={"cluster": None})
+
+    # a pre-cluster snapshot (no cluster keys) simply re-fits
+    mgr.save("old", eng.states, eng.host, 1, extra={})
+    assert assignment_from_extra(mgr.extra("old"), spec, N) is None
+
+    # pinning an out-of-range assignment fails eagerly
+    with pytest.raises(ValueError, match="re-tenants"):
+        eng.set_cluster_assignment(np.asarray([0, 1, 2, 0, 1, 2]))
+
+
+def test_assignment_rides_engine_pin():
+    """set_cluster_assignment pins: the engine never re-fits over it and
+    the padded cluster_in vector reflects it."""
+    cfg = build_cfg(num_rounds=1)
+    data = build_data(cfg)
+    eng = build_engine(cfg, data, cluster=ClusterSpec(k=2, refit_every=1))
+    pin = np.asarray([1, 0, 1, 0, 1, 0], np.int32)
+    eng.set_cluster_assignment(pin)
+    eng.run_round_fused(0)
+    assert np.array_equal(eng.cluster_assignment, pin)
+
+
+# ---------------------------------------------------- stats plumbing ----
+
+def test_latent_stats_masked_rows(rng):
+    """The stats program honors the row mask: masked-out rows cannot move
+    a gateway's latent mean/cov (the ragged-shard contract)."""
+    model = make_model("autoencoder", DIM, 8, 4)
+    from fedmse_tpu.models import init_client_params
+    probe = init_client_params(model, jax.random.key(0))
+    stats_fn = make_latent_stats_fn(model)
+    x = rng.normal(size=(2, 40, DIM)).astype(np.float32)
+    m = np.ones((2, 40), np.float32)
+    m[:, 30:] = 0.0
+    x_junk = x.copy()
+    x_junk[:, 30:] = 1e6  # garbage in the masked tail
+    mean_a, cov_a = stats_fn(probe, x, m)
+    mean_b, cov_b = stats_fn(probe, x_junk, m)
+    np.testing.assert_allclose(np.asarray(mean_a), np.asarray(mean_b))
+    np.testing.assert_allclose(np.asarray(cov_a), np.asarray(cov_b))
+
+
+def test_cli_cluster_end_to_end(tmp_path_factory, tmp_path):
+    """Driver wiring: --cluster-k runs, tags its artifact tree, records
+    the assignment in resume checkpoints, resumes under it, and refuses
+    a K change with the clear cluster message."""
+    import json
+
+    from fedmse_tpu.config import DatasetConfig
+    from fedmse_tpu.main import main as cli_main
+    from tests.test_data import _write_client_csvs
+
+    root = tmp_path_factory.mktemp("cluster_shards")
+    _write_client_csvs(str(root), N, dim=DIM, n_normal=80, n_abnormal=30)
+    cfg_path = root / "config.json"
+    with open(cfg_path, "w") as f:
+        json.dump(DatasetConfig.for_client_dirs(str(root), N).to_json(), f)
+
+    def cli(extra):
+        return cli_main([
+            "--dataset-config", str(cfg_path),
+            "--model-types", "hybrid", "--update-types", "avg",
+            "--network-size", str(N), "--dim-features", str(DIM),
+            "--epochs", "1", "--batch-size", "8", "--no-save",
+            "--global-patience", "99", "--fused-schedule-chunk", "2",
+            "--checkpoint-dir", str(tmp_path / "c"),
+            "--experiment-name", "cl",
+            "--resume-dir", str(tmp_path / "r")] + extra)
+
+    out = cli(["--cluster-k", "2", "--num-rounds", "2"])
+    assert out["cluster"]["k"] == 2
+    import glob
+    host_files = glob.glob(str(tmp_path / "r" / "*.host.json"))
+    assert len(host_files) == 1
+    extra = json.load(open(host_files[0]))["extra"]
+    assert extra["cluster_k"] == 2
+    assert len(extra["cluster_assignment"]) == N
+
+    # resume continues (round 3 only) under the recorded assignment
+    out = cli(["--cluster-k", "2", "--num-rounds", "3"])
+    assert len(out["results"]["hybrid/avg/run0"]["round_times"]) == 1
+
+    # a K change refuses with the cluster-naming message
+    with pytest.raises(ValueError, match="cluster"):
+        cli(["--cluster-k", "4", "--num-rounds", "4"])
+
+
+def test_cluster_assignment_extra_roundtrip(rng):
+    means, covs = _rand_gaussians(rng, N, 4)
+    fit = fit_assignments(means, covs, k=3, fitted_round=5)
+    extra = fit.to_extra()
+    assert extra["cluster_k"] == 3
+    back = ClusterAssignment.from_arrays(3, np.asarray(
+        extra["cluster_assignment"], np.int32), means, covs,
+        fitted_round=extra["cluster_fitted_round"])
+    assert np.array_equal(back.assignment, fit.assignment)
+    assert back.fitted_round == 5
+    assert fit.padded(10).shape == (10,)
+    assert (fit.padded(10)[N:] == 0).all()
