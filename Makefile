@@ -10,7 +10,7 @@ LIB := fedmse_tpu/native/libfedmse_io.so
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
         serve-bench chaos-sweep churn-sweep pipeline-bench precision-bench \
         shard-bench knn-bench cohort-bench flywheel-sweep net-bench \
-        cluster-sweep tpu-check
+        cluster-sweep podscale-bench tpu-check
 
 native: $(LIB)
 
@@ -129,6 +129,15 @@ net-bench:
 cluster-sweep:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		python cluster_sweep.py --out CLUSTER_r15.json
+
+# pod-scale host-sharded federation bench (federation/tiered.py
+# host_sharded, DESIGN.md §20): 1M-gateway round over a 2-process worker
+# pair, RSS-flat cells (250k/H=2 vs 500k/H=4) and the single-process AUC
+# pin (writes BENCH_PODSCALE_r16_cpu.json; spawns its own hermetic-CPU
+# workers, so runs from any parent env)
+podscale-bench:
+	env -u PALLAS_AXON_POOL_IPS python bench.py --podscale-bench \
+		--out BENCH_PODSCALE_r16_cpu.json
 
 tpu-check:
 	python tpu_check.py

@@ -1053,6 +1053,185 @@ def measure_cohort(cfg, grid=((10_000, (64, 512)), (100_000, (64, 512))),
     return out
 
 
+def _podscale_worker() -> None:
+    """Entry for ONE pod-scale bench worker (spawned by measure_podscale;
+    argv: `bench.py <port> <pid> --podscale-worker <cell-json>`): joins the
+    localhost coordinator, contributes 8/nprocs virtual CPU devices to the
+    pod mesh, tiers ONLY its host block of the fleet with host-LOCAL data
+    (local_data=True — this process never materializes another host's
+    rows; the RSS-flat claim is measured, not assumed), runs the cell's
+    rounds and writes per-worker telemetry (sec/round, prefetch gaps,
+    ru_maxrss) into the cell's outdir."""
+    import resource
+
+    port, pid = sys.argv[1], int(sys.argv[2])
+    cell = json.loads(sys.argv[sys.argv.index("--podscale-worker") + 1])
+    nprocs = int(cell["nprocs"])
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={8 // nprocs}")
+    from fedmse_tpu.utils.platform import (enable_compilation_cache,
+                                           force_cpu_platform)
+    enable_compilation_cache()
+    force_cpu_platform()
+    from fedmse_tpu.parallel import initialize_multihost
+    initialize_multihost(coordinator_address=f"localhost:{port}",
+                         num_processes=nprocs, process_id=pid)
+
+    from fedmse_tpu.config import CompatConfig, ExperimentConfig
+    from fedmse_tpu.federation import TieredRoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.parallel import client_mesh, process_tier_blocks
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    n, c, rounds = cell["n"], cell["cohort"], cell["rounds"]
+    dim, hid, lat = 8, 6, 3
+    mesh = client_mesh()
+    lo, hi = process_tier_blocks(n, mesh)[pid]
+    # shared_last_client_val would need the LAST client's val rows on
+    # every host — unsupported (by design) under the host-sharded tier
+    cfg = ExperimentConfig(
+        dim_features=dim, hidden_neus=hid, latent_dim=lat,
+        network_size=n, epochs=1, batch_size=4, num_rounds=rounds,
+        num_participants=c / n, state_layout="tiered",
+        compat=CompatConfig(shared_last_client_val=False))
+    data = _bulk_host_federation(hi - lo, dim, cfg.batch_size, seed=17)
+    model = make_model("hybrid", dim, hid, lat, cfg.shrink_lambda)
+    t0 = time.time()
+    eng = TieredRoundEngine(
+        model, cfg, data, n_real=n,
+        rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+        model_type="hybrid", update_type="mse_avg", mesh=mesh,
+        local_data=True)
+    init_sec = time.time() - t0
+    assert eng.sharded and not eng._fleet_local, "cell must span hosts"
+    assert eng.cohort == c, (eng.cohort, c)
+    secs = []
+    eng.run_rounds(0, rounds, lambda r, s: secs.append(s) and False)
+    row = {
+        "pid": pid, "nprocs": nprocs, "shard_rows": hi - lo,
+        "tier_init_sec": round(init_sec, 2),
+        "sec_per_round_warm": round(min(secs[1:] or secs), 4),
+        "sec_per_round_all": [round(s, 4) for s in secs],
+        "host_tier_bytes": eng.store.host_bytes(),
+        "prefetch": eng.stats.summary(),
+        "ru_maxrss_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
+        **eng.cohort_bytes(),
+    }
+    with open(os.path.join(cell["outdir"],
+                           f"{cell['name']}_w{pid}.json"), "w") as f:
+        json.dump(row, f)
+    print(f"PODBENCH_OK {cell['name']} pid={pid}", flush=True)
+
+
+def measure_podscale(fleet: int = 1_000_000, rounds: int = 3):
+    """Pod-scale federation (ISSUE 16 tentpole metric; DESIGN.md §20):
+    REAL multi-process cells over the gloo CPU collective seam, every
+    worker a separate OS process with its own tier shard and host-local
+    data. Cells:
+
+      * pod_1m_h2 — the headline: a `fleet`-gateway (default 1M) round on
+        a 2-process virtual pod, cohort 512; sec/round (max over workers
+        — the pod advances at the slowest host) + prefetch-gap telemetry;
+      * rss_250k_h2 / rss_500k_h4 — the RSS-flat pair: fleet DOUBLES
+        (250k -> 500k) while rows/host stay 125k; per-worker peak RSS
+        must stay flat (ratio <= 1.15) — per-host memory scales with the
+        shard, not the fleet;
+      * quality_pin — the 12-client pod scenario the test suite runs
+        (tests/multihost_worker.py mode 'podtier') vs the SAME scenario
+        single-process: |best AUC delta| <= 2e-3.
+    """
+    import tempfile
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+    from multihost_launcher import launch_worker_pair
+
+    tmp = tempfile.mkdtemp(prefix="podscale_bench_")
+    cells = {}
+
+    def run_cell(name, n, nprocs, cohort, cell_rounds, timeout=540):
+        cell = {"name": name, "n": n, "nprocs": nprocs, "cohort": cohort,
+                "rounds": cell_rounds, "outdir": tmp}
+        t0 = time.time()
+        launch_worker_pair(os.path.abspath(__file__),
+                           args=("--podscale-worker", json.dumps(cell)),
+                           n_processes=nprocs, timeout=timeout)
+        wall = time.time() - t0
+        rows = []
+        for pid in range(nprocs):
+            with open(os.path.join(tmp, f"{name}_w{pid}.json")) as f:
+                rows.append(json.load(f))
+        cells[name] = {
+            "n_gateways": n, "nprocs": nprocs, "cohort": cohort,
+            "rounds": cell_rounds, "rows_per_host": rows[0]["shard_rows"],
+            "wall_sec_incl_spawn": round(wall, 1),
+            # the pod advances at the slowest host
+            "sec_per_round_warm": max(r["sec_per_round_warm"]
+                                      for r in rows),
+            "max_worker_rss_mb": max(r["ru_maxrss_mb"] for r in rows),
+            "prefetch_overlapped": bool(all(r["prefetch"]["overlapped"]
+                                            for r in rows)),
+            "workers": rows,
+        }
+        print(json.dumps({"cell": name,
+                          **{k: cells[name][k] for k in
+                             ("sec_per_round_warm", "max_worker_rss_mb",
+                              "prefetch_overlapped")}}), flush=True)
+        return cells[name]
+
+    one_m = run_cell("pod_1m_h2", fleet, 2, 512, rounds)
+    flat_a = run_cell("rss_250k_h2", 250_000, 2, 256, 2)
+    flat_b = run_cell("rss_500k_h4", 500_000, 4, 256, 2)
+    rss_ratio = round(flat_b["max_worker_rss_mb"]
+                      / flat_a["max_worker_rss_mb"], 3)
+
+    # quality pin: the suite's 12-client pod scenario, real 2-process run
+    # vs the same scenario single-process (same seed, same data)
+    from multihost_launcher import match_all
+    from multihost_worker import podtier_config, podtier_federation
+    from fedmse_tpu.federation.tiered import run_tiered_combination
+
+    qdir = tempfile.mkdtemp(prefix="podscale_q_")
+    outs = launch_worker_pair(
+        os.path.join(REPO_ROOT, "tests", "multihost_worker.py"),
+        args=("podtier",), extra_env={"PODSCALE_OUTDIR": qdir})
+    match_all(outs, r"PODTIER_OK pid=\d+")
+    pod = np.load(os.path.join(qdir, "pod_result_0.npz"))
+    pcfg, pdim, pn = podtier_config()
+    ref = run_tiered_combination(pcfg, podtier_federation(pcfg, pdim, pn),
+                                 pn, "hybrid", "mse_avg", 0)
+    auc_delta = abs(float(pod["best_final"]) - float(ref["best_final"]))
+    cells["quality_pin"] = {
+        "n_gateways": pn, "nprocs": 2,
+        "pod_best_auc": round(float(pod["best_final"]), 6),
+        "single_process_best_auc": round(float(ref["best_final"]), 6),
+        "auc_delta": round(auc_delta, 6),
+    }
+
+    acceptance = {
+        "bar": "1M-gateway round completes on a 2-process virtual pod "
+               "with prefetch overlap; per-worker peak RSS flat "
+               "(<= 1.15x) when the fleet doubles at fixed 125k "
+               "rows/host; 2-process AUC within 2e-3 of single-process",
+        "one_m_rounds_completed": len(one_m["workers"][0]
+                                      ["sec_per_round_all"]) == rounds,
+        "one_m_overlap_met": one_m["prefetch_overlapped"],
+        "rss_ratio_500k_over_250k": rss_ratio,
+        "rss_flat_met": bool(rss_ratio <= 1.15),
+        "auc_delta": cells["quality_pin"]["auc_delta"],
+        "auc_met": bool(auc_delta <= 2e-3),
+    }
+    acceptance["met"] = bool(
+        acceptance["one_m_rounds_completed"]
+        and acceptance["one_m_overlap_met"] and acceptance["rss_flat_met"]
+        and acceptance["auc_met"])
+    return {"cells": cells, "acceptance": acceptance}
+
+
 def optax_adam(lr):
     """Deferred optax import (bench.py keeps jax imports inside main)."""
     import optax
@@ -1096,7 +1275,8 @@ def build_data(cfg, n_clients: int = 10, dataset=None):
 def main():
     shard_bench = "--shard-bench" in sys.argv
     cohort_bench = "--cohort-bench" in sys.argv
-    if shard_bench or cohort_bench:
+    podscale_bench = "--podscale-bench" in sys.argv
+    if shard_bench or cohort_bench or podscale_bench:
         # hermetic CPU + 8 virtual devices, pinned BEFORE any jax import
         # (like the tests and serve-bench): the shard and cohort benches
         # are memory-layout/scale measurements, never TPU-tunnel ones
@@ -1214,6 +1394,45 @@ def main():
         line = json.dumps(out)
         print(line)
         dest = _flag("--out", f"BENCH_SHARD_r08_{device.platform}.json")
+        with open(dest, "w") as f:
+            f.write(line + "\n")
+        return
+
+    if podscale_bench:
+        # pod-scale host-sharded federation (ISSUE 16): real multi-process
+        # cells (2 and 4 workers over the gloo seam) — the 1M-gateway
+        # round, the RSS-flat fleet-doubling pair, and the 2-process-vs-
+        # single-process AUC pin. One JSON line, written to
+        # BENCH_PODSCALE_r16_<platform>.json (or --out).
+        fleet = _int_flag("--podscale-clients", 1_000_000)
+        device = jax.devices()[0]
+        out = {
+            "metric": f"{fleet}-gateway federated round on a multi-process "
+                      f"virtual pod (host-sharded tier, host-local data, "
+                      f"gloo CPU collectives)",
+            "value": None,  # filled from the 1M cell's warm sec/round
+            "unit": "s/round (max over workers, warm)",
+            "device": str(device),
+            "platform": device.platform,
+            "mode": "pod-scale host-sharded tier (federation/tiered.py "
+                    "host_sharded, DESIGN.md §20)",
+            "data_source": "bulk-synthetic host-LOCAL federation (dim 8; "
+                           "each worker draws only its shard's rows — "
+                           "the cells measure residency and the "
+                           "collective seam, not data science)",
+            "timing_note": "1-core box: all workers share one core, so "
+                           "sec/round is an upper bound — on real pod "
+                           "hosts the workers run on disjoint sockets. "
+                           "Worker spawn + jax.distributed init (~20 "
+                           "s/process) is excluded from sec/round and "
+                           "reported as wall_sec_incl_spawn.",
+        }
+        out.update(measure_podscale(fleet=fleet))
+        out["value"] = out["cells"]["pod_1m_h2"]["sec_per_round_warm"]
+        out.update(capture_provenance())
+        line = json.dumps(out)
+        print(line)
+        dest = _flag("--out", f"BENCH_PODSCALE_r16_{device.platform}.json")
         with open(dest, "w") as f:
             f.write(line + "\n")
         return
@@ -1549,4 +1768,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--podscale-worker" in sys.argv:
+        _podscale_worker()  # spawned by measure_podscale; env is set
+        # inside, BEFORE any jax import (bench.py defers jax to main)
+    else:
+        main()

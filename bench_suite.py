@@ -49,6 +49,15 @@ repo-grown axes):
      tiered shedding engaging only under synthetic overload, every row
      statused exactly once (full protocol: make net-bench ->
      BENCH_NET_r15_cpu.json)
+ 17. clustered + personalized federation (fedmse_tpu/cluster/, DESIGN.md
+     §19): reduced typed 2-type grid — K=2 clustered vs single-global
+     AUC separation plus the K=1 bitwise pin (full protocol:
+     make cluster-sweep -> CLUSTER_r15.json)
+ 18. pod-scale host-sharded federation (federation/tiered.py, DESIGN.md
+     §20): the reduced 2-process guard — each worker tiers only its own
+     half of the fleet, rounds run over cross-host cohort assembly, and
+     the per-process result digests must agree (full protocol:
+     make podscale-bench -> BENCH_PODSCALE_r16_cpu.json)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -443,6 +452,39 @@ def scen_cluster():
                         "vs single-global, K=1 bitwise pin", **row}
 
 
+def scen_podscale():
+    """Scenario 18: pod-scale host-sharded federation (ISSUE 16,
+    federation/tiered.py host_sharded, DESIGN.md §20) — the reduced
+    2-process guard: each worker tiers ONLY the half of a 12-gateway
+    fleet its devices own, rounds run over cross-host cohort assembly,
+    and the per-process PODTIER_OK digests (best / mean final AUC /
+    aggregation-count vector) must be identical — control-plane
+    agreement through the collective seam. The committed standalone
+    artifact (make podscale-bench -> BENCH_PODSCALE_r16_cpu.json)
+    carries the 1M-gateway cell, the RSS-flat bar and the
+    single-process AUC pin."""
+    import re
+
+    tests_dir = os.path.join(REPO_ROOT, "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from multihost_launcher import launch_worker_pair
+
+    worker = os.path.join(tests_dir, "multihost_worker.py")
+    t0 = time.time()
+    outs = launch_worker_pair(worker, args=("podtier",))
+    sec = round(time.time() - t0, 2)
+    pat = r"PODTIER_OK pid=\d+ (best=[\d.]+ mean=[\d.]+ agg=\[[^\]]*\])"
+    digests = [m.group(1) if m else None
+               for m in (re.search(pat, o) for o in outs)]
+    ok = all(digests) and len(set(digests)) == 1
+    return {"scenario": "pod-scale host-sharded tier: 2-process worker "
+                        "pair, 12 gateways, cross-host cohort rounds, "
+                        "per-process digest agreement",
+            "worker_pair_sec": sec, "digests": digests,
+            "acceptance_met": bool(ok)}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -465,9 +507,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-17")
-        if not 1 <= only <= 17:
-            sys.exit(f"--only expects a scenario number 1-17, got {only}")
+            sys.exit("--only expects a scenario number 1-18")
+        if not 1 <= only <= 18:
+            sys.exit(f"--only expects a scenario number 1-18, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -567,6 +609,9 @@ def main():
 
     if only in (None, 17):
         emit(scen_cluster())
+
+    if only in (None, 18):
+        emit(scen_podscale())
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

@@ -57,6 +57,14 @@ BURST = (4, 6)          # leave burst window [start, stop)
 GRID_CLIENTS = 500
 ALPHA = 0.5
 
+# Ceiling on the per-slot MAX joiner deficit vs the static baseline
+# (ISSUE 16 satellite: CHURN_r10 recorded the reading — 8.3e-3 observed —
+# without a bar). The cohort-mean bars stay at 2e-3; a single late-joining
+# slot on a hard non-IID shard may lag the baseline by more, but past 1e-2
+# the rejoin inheritance (incumbent-mean params, elastic.py) is not doing
+# its job. Gated in the artifact as per_slot_max_gap_within_ceiling.
+PER_SLOT_MAX_GAP_CEILING = 1e-2
+
 
 def build_grid(cfg, n_clients, alpha=ALPHA, label_shift=0.0):
     """The non-IID churn grid: Dirichlet(alpha) feature skew (+ optional
@@ -208,6 +216,201 @@ def zero_recompile_10k(cfg):
     return out
 
 
+def podscale_main():
+    """`--podscale` (ISSUE 16): the churn semantics re-run at 100k
+    gateways UNDER THE HOST-SHARDED TIER (federation/tiered.py
+    host_sharded=True — stratified per-block selection, lane-plan cohort
+    assembly, the shard store's absolute-id gather/scatter; the
+    single-host block is the fleet, so the existing bars apply bitwise —
+    the cross-host half of the seam is exercised by the 2-process
+    BENCH_PODSCALE cells and tests/test_podscale.py). Rows: static
+    baseline, null-elastic (bitwise pin), steady churn, leave-burst +
+    rejoin with BOTH joiner bars (cohort means within 2e-3; per-slot max
+    within PER_SLOT_MAX_GAP_CEILING), scoped to cohort-covered slots —
+    see the in-line note at the gap computation. Writes
+    CHURN_PODSCALE.json (--out)."""
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
+    enable_compilation_cache()
+    capture_provenance()
+    import numpy as np
+    import jax
+    from bench import _bulk_host_federation
+    from fedmse_tpu.chaos import (joiner_incumbent_gap, membership_metrics,
+                                  resilience_metrics)
+    from fedmse_tpu.config import CompatConfig, ExperimentConfig
+    from fedmse_tpu.federation import ElasticSpec, TieredRoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.parallel import client_mesh
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    out_path = "CHURN_PODSCALE.json"
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    n = 100_000
+    if "--clients" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--clients") + 1])
+    rounds, burst = 10, (3, 5)
+    cohort = n
+    dim, hid, lat = 8, 6, 3
+    # FULL participation — the regime CHURN_r10's joiner bars are stated
+    # over, at 200x the fleet: every member trains every round, so
+    # joiners and the baseline's same slots both CONVERGE and the
+    # per-slot comparison reads churn recovery. At sparse cohorts the
+    # same comparison reads participation instead (a joiner adopts the
+    # member-mean model while the baseline slot holds raw init until
+    # selected — one weak visit never washes that out); the sparse-cohort
+    # sharded path is measured by BENCH_PODSCALE and pinned by
+    # tests/test_podscale.py.
+    cfg = ExperimentConfig(
+        dim_features=dim, hidden_neus=hid, latent_dim=lat, network_size=n,
+        epochs=5, batch_size=16, num_rounds=rounds,
+        num_participants=1.0, state_layout="tiered",
+        host_sharded=True,
+        compat=CompatConfig(shared_last_client_val=False))
+    mesh = client_mesh()
+    data = _bulk_host_federation(n, dim, cfg.batch_size)
+    model = make_model("hybrid", dim, hid, lat, cfg.shrink_lambda)
+
+    def run(elastic, label, burst_kw=None):
+        eng = TieredRoundEngine(
+            model, cfg, data, n_real=n,
+            rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+            model_type="hybrid", update_type="mse_avg", mesh=mesh,
+            elastic=elastic, host_sharded=True)
+        assert eng.sharded and eng.cohort == cohort, (eng.cohort, cohort)
+        results, secs = [], []
+        t0 = time.time()
+        eng.run_rounds(0, rounds,
+                       lambda r, s: (results.append(r), secs.append(s))
+                       and False)
+        sec = (time.time() - t0) / rounds
+        final = np.asarray(eng.evaluate_final_streamed())
+        if final.ndim == 2:
+            final = final[:, 0]
+        gen = results[-1].generations
+        if results[-1].members is not None:
+            member = np.zeros(n, bool)
+            member[results[-1].members] = True
+            final = np.where(member, final, np.nan)
+        cov = np.zeros(n, bool)  # slots a cohort trained, current tenure
+        g_fin = (np.asarray(results[-1].generations)
+                 if results[-1].generations is not None else None)
+        for r in results:
+            sel = np.asarray(list(r.selected), dtype=int)
+            if g_fin is not None and r.generations is not None:
+                # a visit only counts if it trained the slot's FINAL
+                # occupant — a pre-recycle visit trained the leaver
+                sel = sel[np.asarray(r.generations)[sel] == g_fin[sel]]
+            cov[sel] = True
+        row = {"label": label, "n_gateways": n, "cohort": cohort,
+               "sec_per_round": round(sec, 4),
+               **resilience_metrics(results, **(burst_kw or {})),
+               "membership": membership_metrics(results)}
+        return row, final, gen, cov, eng
+
+    rows = []
+
+    def emit(row):
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    base_row, base_final, _, base_cov, base_eng = run(
+        None, "static-baseline-100k")
+    emit(base_row)
+    null_row, null_final, _, _, null_eng = run(ElasticSpec(),
+                                               "null-elastic-100k")
+    null_row["bit_identical_to_static"] = bool(
+        np.array_equal(base_final, null_final, equal_nan=True)
+        and all(np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(jax.tree.leaves(base_eng.store.host),
+                                jax.tree.leaves(null_eng.store.host))))
+    emit(null_row)
+    del base_eng, null_eng
+
+    row, _, _, _, _ = run(ElasticSpec(leave_p=0.1, join_p=0.3,
+                                      start_round=1),
+                          "steady-churn-0.1-100k")
+    emit(row)
+
+    b0, b1 = burst
+    row, burst_final, burst_gen, burst_cov, _ = run(
+        ElasticSpec(leave_p=0.3, join_p=0.6, leave_window=(b0, b1),
+                    join_window=(b1, None)),
+        "leave-burst-50pct-100k",
+        burst_kw={"burst_start": b0, "burst_stop": b1,
+                  "recover_eps": 2e-3})
+    # At 0.5% participation most slots are never cohort-trained (the
+    # tiered scatter only writes cohort rows), so the fleet-wide joiner
+    # readings would measure participation, not churn recovery: an
+    # untrained joiner vs a baseline slot the cohort DID train differs by
+    # the whole training effect. Scope both readings to cohort-covered
+    # slots — covered in BOTH runs for the per-slot baseline reading —
+    # which is the slot set CHURN_r10's full-participation bars are
+    # implicitly stated over.
+    gap = joiner_incumbent_gap(
+        np.where(burst_cov, burst_final, np.nan), burst_gen,
+        baseline_metrics=np.where(base_cov, base_final, np.nan))
+    row["joiner_gap"] = gap
+    row["joiner_gap_scope"] = {
+        "covered_elastic": int(burst_cov.sum()),
+        "covered_baseline": int(base_cov.sum()),
+        "covered_both": int((burst_cov & base_cov).sum()),
+    }
+    # Ceiling at fleet scale: per-slot AUC on the bulk builder's 8x8
+    # test rows is QUANTIZED at 1/64 ≈ 1.6e-2, so CHURN_r10's
+    # sub-quantization 1e-2 ceiling is unreadable here — one flipped
+    # ranking pair on ONE of 50k joiner slots overshoots it. The
+    # fleet-scale worst-slot bar is stated at the cell's resolution:
+    # <= 8 pair inversions (0.125). That still separates healthy from
+    # broken sharply — a stale or unreset joiner reads as a near-full
+    # inversion (0.77-0.91 observed while this path was being built).
+    t_pairs = (data.test_y[0] > 0).sum() * (data.test_y[0] == 0).sum()
+    pod_ceiling = max(PER_SLOT_MAX_GAP_CEILING, float(8.0 / t_pairs))
+    row["joiners_within_2e3_of_incumbents"] = bool(
+        gap.get("mean_gap") is not None and abs(gap["mean_gap"]) <= 2e-3
+        and gap.get("per_slot_gap_mean_vs_baseline") is not None
+        and gap["per_slot_gap_mean_vs_baseline"] <= 2e-3)
+    row["per_slot_max_gap_ceiling"] = pod_ceiling
+    row["per_slot_max_gap_ceiling_note"] = (
+        "max(1e-2, 8 pair inversions at the cell's 8x8-row AUC "
+        "resolution); CHURN_r10 carries the fine-grained 1e-2 ceiling")
+    row["per_slot_max_gap_within_ceiling"] = bool(
+        gap.get("per_slot_gap_vs_baseline") is not None
+        and gap["per_slot_gap_vs_baseline"] <= pod_ceiling)
+    emit(row)
+
+    device = jax.devices()[0]
+    acceptance = {
+        "bar": "100k-gateway churn under the host-sharded tier: "
+               "null-elastic bitwise to static, joiner cohort bars "
+               "within 2e-3, per-slot max within the documented "
+               "resolution-aware ceiling",
+        "null_bitwise": null_row["bit_identical_to_static"],
+        "joiner_bars_met": row["joiners_within_2e3_of_incumbents"],
+        "per_slot_ceiling_met": row["per_slot_max_gap_within_ceiling"],
+    }
+    acceptance["met"] = bool(all(acceptance[k] for k in
+                                 ("null_bitwise", "joiner_bars_met",
+                                  "per_slot_ceiling_met")))
+    out = {
+        "protocol": f"{n}-gateway bulk-synthetic fleet, host-sharded tier "
+                    f"(state_layout=tiered host_sharded=True, cohort "
+                    f"{cohort}), hybrid+mse_avg, {rounds} rounds; burst "
+                    f"window [{b0}, {b1}) at leave_p=0.3, rejoin from "
+                    f"{b1}; data science is not the point — the bars "
+                    f"pin that the elastic semantics survived the "
+                    f"sharded-tier rewrite at fleet scale",
+        "device": str(device), "platform": device.platform,
+        "rows": rows, "acceptance": acceptance,
+        **capture_provenance(),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"wrote": out_path,
+                      "acceptance_met": acceptance["met"]}))
+
+
 def main():
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -275,14 +478,19 @@ def main():
     row["joiner_gap"] = gap
     # the acceptance bar is stated over the joiner-vs-incumbent reading
     # (joiner cohort mean within 2e-3 of the incumbent cohort mean) with
-    # the deconfounded mean per-slot deficit agreeing; the per-slot MAX is
-    # reported alongside but not gated — under non-IID churn a single
-    # late-joining slot on a hard shard can lag by more than the cohort
-    # without the recovery mechanism being at fault
+    # the deconfounded mean per-slot deficit agreeing; the per-slot MAX
+    # gets its own looser documented ceiling (PER_SLOT_MAX_GAP_CEILING) —
+    # under non-IID churn a single late-joining slot on a hard shard can
+    # lag the cohort bars without the recovery mechanism being at fault,
+    # but an unbounded max would let one slot fail silently
     row["joiners_within_2e3_of_incumbents"] = bool(
         gap.get("mean_gap") is not None and abs(gap["mean_gap"]) <= 2e-3
         and gap.get("per_slot_gap_mean_vs_baseline") is not None
         and gap["per_slot_gap_mean_vs_baseline"] <= 2e-3)
+    row["per_slot_max_gap_ceiling"] = PER_SLOT_MAX_GAP_CEILING
+    row["per_slot_max_gap_within_ceiling"] = bool(
+        gap.get("per_slot_gap_vs_baseline") is not None
+        and gap["per_slot_gap_vs_baseline"] <= PER_SLOT_MAX_GAP_CEILING)
     emit(row)
 
     # ---- composition: churn x chaos x attack (the full threat model) ----
@@ -323,4 +531,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--podscale" in sys.argv:
+        podscale_main()
+    else:
+        main()

@@ -298,6 +298,200 @@ def quick_cell():
     }
 
 
+def _bulk_typed_federation(n: int, dim: int, batch: int, types: int,
+                           seed: int = 11):
+    """Bulk-drawn typed fleet for the 100k podscale cell: gateways come in
+    `types` device types with far-apart manifolds, and each gateway's
+    ANOMALIES are the NEXT type's normal traffic — the CLUSTER_r15
+    cross-type-contamination construction (minus the per-client python
+    loop that would take minutes at 100k): a single global model trained
+    on every type reconstructs the contaminating rows as well as the
+    legitimate ones, so only a cluster-scoped model can separate them.
+    Layout matches bench._bulk_host_federation."""
+    import numpy as np
+    from fedmse_tpu.data.stacking import FederatedData
+
+    rng = np.random.default_rng(seed)
+    f32 = np.float32
+    t_of = (np.arange(n) % types)
+    shifts = rng.normal(0, 4.0, (types, dim)).astype(f32)
+    # radius-match the type modes (CLUSTER_r15): equal distance from the
+    # origin, so reconstruction NORM alone cannot separate types
+    shifts *= (np.linalg.norm(shifts, axis=1, keepdims=True).mean()
+               / np.linalg.norm(shifts, axis=1, keepdims=True))
+    own = shifts[t_of]                                 # [n, dim]
+    other = shifts[(t_of + 1) % types]                 # the contaminator
+    B, nb = batch, 2
+
+    def at(mode, shape_tail):
+        return (rng.normal(0, 1.0, (n, *shape_tail)).astype(f32)
+                + mode.reshape(n, *([1] * (len(shape_tail) - 1)), dim))
+
+    train = at(own, (nb, B, dim))
+    v_rows = 4
+    valid = at(own, (v_rows, dim))
+    valid_xb = np.zeros((n, nb, B, dim), f32)
+    valid_xb[:, 0, :v_rows] = valid
+    valid_mb = np.zeros((n, nb, B), f32)
+    valid_mb[:, 0, :v_rows] = 1.0
+    t_half = 8
+    test = np.concatenate([at(own, (t_half, dim)),
+                           at(other, (t_half, dim))], axis=1)
+    test_y = np.concatenate([np.zeros((n, t_half), f32),
+                             np.ones((n, t_half), f32)], axis=1)
+    dev_types = rng.integers(0, types, 256)
+    dev_x = (rng.normal(0, 1.0, (256, dim)).astype(f32)
+             + shifts[dev_types])
+    return FederatedData(
+        train_xb=train, train_mb=np.ones((n, nb, B), f32),
+        valid_xb=valid_xb, valid_mb=valid_mb,
+        valid_x=valid, valid_m=np.ones((n, v_rows), f32),
+        test_x=test, test_m=np.ones((n, 2 * t_half), f32),
+        test_y=test_y, dev_x=dev_x,
+        client_mask=np.ones((n,), f32)), t_of
+
+
+def podscale_main():
+    """`--podscale` (ISSUE 16): the clustered-federation semantics re-run
+    at 100k gateways UNDER THE HOST-SHARDED TIER (federation/tiered.py
+    host_sharded=True; the single-host block covers the fleet, so the
+    existing bars apply bitwise — the cross-host seam is covered by
+    BENCH_PODSCALE and tests/test_podscale.py). Rows: the K=1 bitwise
+    pin, the typed-fleet assignment (purity vs the generating types,
+    through the fit_sample-capped medoid fit), and clustered K=4 vs
+    single-global AUC under FULL participation — the regime CLUSTER_r15's
+    delta bar is stated over (every slot holds a converged merge at
+    eval; at sparse cohorts the per-slot read measures participation
+    staleness, which BENCH_PODSCALE/test_podscale cover). Writes
+    CLUSTER_PODSCALE.json (--out)."""
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
+    enable_compilation_cache()
+    capture_provenance()
+    import numpy as np
+    import jax
+    from fedmse_tpu.cluster import ClusterSpec
+    from fedmse_tpu.config import CompatConfig, ExperimentConfig
+    from fedmse_tpu.federation import TieredRoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.parallel import client_mesh
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    out_path = "CLUSTER_PODSCALE.json"
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    n = 100_000
+    if "--clients" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--clients") + 1])
+    types, rounds = 4, 6
+    cohort = n
+    dim, hid, lat = 8, 6, 3
+    cfg = ExperimentConfig(
+        dim_features=dim, hidden_neus=hid, latent_dim=lat, network_size=n,
+        epochs=2, batch_size=16, num_rounds=rounds,
+        num_participants=1.0, state_layout="tiered",
+        host_sharded=True,
+        compat=CompatConfig(shared_last_client_val=False))
+    mesh = client_mesh()
+    data, t_of = _bulk_typed_federation(n, dim, cfg.batch_size, types)
+    model = make_model("hybrid", dim, hid, lat, cfg.shrink_lambda)
+
+    def run(spec, rounds_=rounds):
+        eng = TieredRoundEngine(
+            model, cfg, data, n_real=n,
+            rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+            model_type="hybrid", update_type="mse_avg", mesh=mesh,
+            cluster=spec, host_sharded=True)
+        assert eng.sharded and eng.cohort == cohort, (eng.cohort, cohort)
+        results, secs = [], []
+        eng.run_rounds(0, rounds_,
+                       lambda r, s: (results.append(r), secs.append(s))
+                       and False)
+        final = np.asarray(eng.evaluate_final_streamed())
+        if final.ndim == 2:
+            final = final[:, 0]
+        return eng, final, results, secs
+
+    rows = []
+
+    def emit(row):
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # ---- K=1 bitwise pin (ClusterSpec(k=1) lowers to no spec) ----
+    e_none, f_none, _, _ = run(None, rounds_=2)
+    e_k1, f_k1, _, _ = run(ClusterSpec(k=1), rounds_=2)
+    k1_bit = bool(
+        np.array_equal(f_none, f_k1, equal_nan=True)
+        and all(np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(jax.tree.leaves(e_none.store.host),
+                                jax.tree.leaves(e_k1.store.host))))
+    emit({"label": "k1-bitwise-pin-100k", "n_gateways": n,
+          "rounds": 2, "states_bit_identical": k1_bit})
+    del e_none, e_k1
+
+    # ---- single global vs clustered K=4 on the typed fleet ----
+    e_s, f_s, res_s, secs_s = run(None)
+    e_c, f_c, res_c, secs_c = run(ClusterSpec(k=types))
+    assignment = np.asarray(e_c.cluster_assignment)
+    # assignment purity vs the generating types: majority-type fraction
+    # per cluster, size-weighted (the sweep's >= 0.9 matching idiom)
+    purity = float(sum(
+        np.bincount(t_of[assignment == c], minlength=types).max()
+        for c in range(types) if (assignment == c).any()) / n)
+    # identical selection streams (the spec changes aggregation, not the
+    # draw): compare on the gateways a cohort ever covered
+    sel = np.zeros(n, bool)
+    for r in res_s:
+        sel[list(r.selected)] = True
+    assert all(list(a.selected) == list(b.selected)
+               for a, b in zip(res_s, res_c))
+    delta = float(np.nanmean(f_c[sel]) - np.nanmean(f_s[sel]))
+    emit({"label": "typed-100k-k4-vs-single", "n_gateways": n,
+          "types": types, "cohort": cohort, "rounds": rounds,
+          "sec_per_round_single": round(min(secs_s[1:] or secs_s), 4),
+          "sec_per_round_clustered": round(min(secs_c[1:] or secs_c), 4),
+          "cluster_sizes": np.bincount(assignment,
+                                       minlength=types).tolist(),
+          "assignment_purity": round(purity, 4),
+          "cohort_covered_gateways": int(sel.sum()),
+          "single_auc_covered": round(float(np.nanmean(f_s[sel])), 4),
+          "clustered_auc_covered": round(float(np.nanmean(f_c[sel])), 4),
+          "delta_auc_covered": round(delta, 4)})
+
+    device = jax.devices()[0]
+    acceptance = {
+        "bar": "100k gateways under the host-sharded tier: K=1 bitwise "
+               "to no-spec, assignment purity >= 0.9 vs the generating "
+               "types, clustered K=4 beats single-global by >= 0.1 AUC "
+               "on the cohort-covered gateways (the sweep's delta bar, "
+               "scoped to rows a cohort actually trained)",
+        "k1_bit_identical": k1_bit,
+        "purity": round(purity, 4),
+        "purity_met": bool(purity >= 0.9),
+        "delta_auc": round(delta, 4),
+        "delta_met": bool(delta >= 0.1),
+    }
+    acceptance["met"] = bool(acceptance["k1_bit_identical"]
+                             and acceptance["purity_met"]
+                             and acceptance["delta_met"])
+    out = {
+        "protocol": f"{n}-gateway bulk typed fleet ({types} device types, "
+                    f"far-apart manifolds), host-sharded tier "
+                    f"(state_layout=tiered host_sharded=True, cohort "
+                    f"{cohort}), hybrid+mse_avg, {rounds} rounds x 2 "
+                    f"epochs; the bars pin that the clustered semantics "
+                    f"survived the sharded-tier rewrite at fleet scale",
+        "device": str(device), "platform": device.platform,
+        "rows": rows, "acceptance": acceptance,
+        **capture_provenance(),
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"wrote": out_path,
+                      "acceptance_met": acceptance["met"]}))
+
+
 def main():
     from fedmse_tpu.utils.platform import (capture_provenance,
                                            enable_compilation_cache)
@@ -427,4 +621,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--podscale" in sys.argv:
+        podscale_main()
+    else:
+        main()

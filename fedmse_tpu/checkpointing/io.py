@@ -244,6 +244,26 @@ class CheckpointManager:
             # be restored against a newer round_index
             os.remove(self._path(tag) + ".tracking.npz")
 
+    @staticmethod
+    def _validate_extra(tag: str, saved: Dict,
+                        expected_extra: Optional[Dict],
+                        extra_defaults: Optional[Dict]) -> None:
+        """Layout-changing config must fail with the flag's NAME, not a
+        tree-structure mismatch deep in the array restore (see restore's
+        docstring; shared with the pod-sharded restore path)."""
+        for key, want in (expected_extra or {}).items():
+            if key in saved:
+                recorded = saved[key]
+            elif extra_defaults is not None and key in extra_defaults:
+                recorded = extra_defaults[key]
+            else:
+                continue  # no recorded value and no known default
+            if recorded != want:
+                raise ValueError(
+                    f"checkpoint {tag!r} was written with {key}="
+                    f"{recorded!r} but this run uses {key}={want!r};"
+                    f" resume with the matching setting or start fresh")
+
     def restore(self, tag: str, states_like: ClientStates,
                 expected_extra: Optional[Dict] = None,
                 extra_defaults: Optional[Dict] = None,
@@ -277,18 +297,7 @@ class CheckpointManager:
         if expected_extra:
             with open(self._path(tag) + ".host.json") as f:
                 saved = json.load(f).get("extra", {})
-            for key, want in expected_extra.items():
-                if key in saved:
-                    recorded = saved[key]
-                elif extra_defaults is not None and key in extra_defaults:
-                    recorded = extra_defaults[key]
-                else:
-                    continue  # no recorded value and no known default
-                if recorded != want:
-                    raise ValueError(
-                        f"checkpoint {tag!r} was written with {key}="
-                        f"{recorded!r} but this run uses {key}={want!r};"
-                        f" resume with the matching setting or start fresh")
+            self._validate_extra(tag, saved, expected_extra, extra_defaults)
         target = {
             "states": dataclasses.asdict(states_like),
             "round_index": np.asarray(0),
@@ -324,6 +333,149 @@ class CheckpointManager:
     def exists(self, tag: str) -> bool:
         return os.path.exists(self._path(tag)) and \
             os.path.exists(self._path(tag) + ".host.json")
+
+    # ------------------- pod-sharded snapshots (DESIGN §20) ------------ #
+    #
+    # A host-sharded tier never materializes the fleet on any one host, so
+    # its snapshot cannot be the dense Orbax payload above. Instead each
+    # process writes ONLY its tier rows as one flat npz shard
+    # (`{tag}.podshard{j}of{H}.npz`, keystr-flattened like
+    # save_client_models), process 0 writes the `{tag}.pod.json` manifest
+    # (shard blocks + host counters + extra), and a cross-process barrier
+    # makes the set atomic-enough for resume (a torn save is detected by
+    # exists_sharded requiring every shard file the manifest names).
+    # Restore is LAYOUT-INTERCHANGEABLE: any process may ask for any row
+    # range [start, stop) — H' processes re-slice an H-process save by
+    # reading only overlapping shards, and (0, n_real) reassembles the
+    # dense fleet for a single-process tiered or dense engine
+    # (tests/test_podscale.py byte-compares both directions).
+
+    def _shard_path(self, tag: str, j: int, h: int) -> str:
+        return self._path(tag) + f".podshard{j}of{h}.npz"
+
+    def save_shard(self, tag: str, states: ClientStates, host: HostState,
+                   round_index: int, start: int, stop: int,
+                   blocks: Sequence, extra: Optional[Dict] = None,
+                   tracking: Optional[np.ndarray] = None) -> None:
+        """Write THIS process's tier rows [start, stop) (one of `blocks`,
+        the pod's canonical host blocks in mesh process order) plus — on
+        process 0 — the manifest and tracking curve. Collective: every
+        process must call it (there is a barrier at the end)."""
+        blocks = [tuple(b) for b in blocks]
+        if (start, stop) not in blocks:
+            raise ValueError(f"({start}, {stop}) is not one of the pod's "
+                             f"tier blocks {blocks}")
+        h = len(blocks)
+        j = blocks.index((start, stop))
+        leaves, _ = jax.tree_util.tree_flatten_with_path(
+            dataclasses.asdict(states))
+        arrays = {jax.tree_util.keystr(path): np.asarray(leaf)
+                  for path, leaf in leaves}
+        for k, v in arrays.items():
+            if v.shape[0] != stop - start:
+                raise ValueError(
+                    f"shard leaf {k} carries {v.shape[0]} rows; block "
+                    f"({start}, {stop}) holds {stop - start}")
+        path = self._shard_path(tag, j, h)
+        tmp = path + ".tmp.npz"  # .npz suffix so np.savez appends nothing
+        np.savez(tmp, **arrays)
+        os.replace(tmp, path)
+        if jax.process_index() == 0:
+            meta = {
+                "n_real": blocks[-1][1],
+                "blocks": [list(b) for b in blocks],
+                "aggregation_count": host.aggregation_count.tolist(),
+                "votes_received": host.votes_received.tolist(),
+                "rounds_aggregated": host.rounds_aggregated,
+                "round_index": int(round_index),
+                "extra": extra or {},
+            }
+            mtmp = self._path(tag) + ".pod.json.tmp"
+            with open(mtmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(mtmp, self._path(tag) + ".pod.json")
+            tpath = self._path(tag) + ".pod.tracking.npz"
+            if tracking is not None:
+                np.savez(tpath + ".tmp.npz", tracking=tracking)
+                os.replace(tpath + ".tmp.npz", tpath)
+            elif os.path.exists(tpath):
+                os.remove(tpath)  # same staleness rule as save()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            # the snapshot is only durable once EVERY shard landed; the
+            # barrier also keeps a fast process from overwriting its next
+            # shard while a slow one still writes this round's
+            multihost_utils.sync_global_devices(
+                f"ckpt_shard_{tag}_{round_index}")
+
+    def restore_sharded(self, tag: str, states_like: ClientStates,
+                        start: int, stop: int,
+                        expected_extra: Optional[Dict] = None,
+                        extra_defaults: Optional[Dict] = None):
+        """Reassemble rows [start, stop) of a pod-sharded snapshot from the
+        overlapping shard files — the saving pod's H and the restoring
+        layout are independent (H' processes, a single-process tier, or
+        the dense engine at (0, n_real)). Returns (states, host,
+        round_index, tracking) with host-owned numpy leaves, like
+        restore(layout='tiered')."""
+        with open(self._path(tag) + ".pod.json") as f:
+            meta = json.load(f)
+        self._validate_extra(tag, meta.get("extra", {}), expected_extra,
+                             extra_defaults)
+        blocks = [tuple(b) for b in meta["blocks"]]
+        h = len(blocks)
+        if not (0 <= start < stop <= meta["n_real"]):
+            raise ValueError(f"rows [{start}, {stop}) outside the "
+                             f"checkpointed fleet [0, {meta['n_real']})")
+        leaves_like, treedef = jax.tree_util.tree_flatten_with_path(
+            dataclasses.asdict(states_like))
+        keys = [jax.tree_util.keystr(path) for path, _ in leaves_like]
+        parts: Dict[str, List[np.ndarray]] = {k: [] for k in keys}
+        for j, (lo, hi) in enumerate(blocks):
+            o_lo, o_hi = max(lo, start), min(hi, stop)
+            if o_lo >= o_hi:
+                continue  # shard j owns no requested rows: never read
+            path = self._shard_path(tag, j, h)
+            with np.load(path) as z:
+                missing = [k for k in keys if k not in z.files]
+                if missing:
+                    raise ValueError(
+                        f"{path} lacks state leaves {missing[:3]}"
+                        f"{'...' if len(missing) > 3 else ''}; was it "
+                        f"saved under a different state layout?")
+                for k in keys:
+                    parts[k].append(z[k][o_lo - lo: o_hi - lo])
+        stacked = [np.concatenate(parts[k], axis=0) for k in keys]
+        states = jax.tree_util.tree_unflatten(treedef, stacked)
+        host = HostState(
+            aggregation_count=np.asarray(meta["aggregation_count"]),
+            votes_received=np.asarray(meta["votes_received"]),
+            rounds_aggregated=[tuple(x) for x in meta["rounds_aggregated"]],
+        )
+        tracking = None
+        tpath = self._path(tag) + ".pod.tracking.npz"
+        if os.path.exists(tpath):
+            tracking = np.load(tpath)["tracking"]
+        return (ClientStates(**states), host, int(meta["round_index"]),
+                tracking)
+
+    def exists_sharded(self, tag: str) -> bool:
+        """True iff the manifest AND every shard it names are on disk (a
+        kill between shard writes and the barrier leaves a torn set that
+        must not resume)."""
+        mpath = self._path(tag) + ".pod.json"
+        if not os.path.exists(mpath):
+            return False
+        with open(mpath) as f:
+            h = len(json.load(f)["blocks"])
+        return all(os.path.exists(self._shard_path(tag, j, h))
+                   for j in range(h))
+
+    def pod_extra(self, tag: str) -> Dict:
+        """The pod manifest's recorded `extra` (the sharded counterpart of
+        `extra()`)."""
+        with open(self._path(tag) + ".pod.json") as f:
+            return json.load(f).get("extra", {})
 
     def extra(self, tag: str) -> Dict:
         """The snapshot's recorded `extra` dict WITHOUT restoring the

@@ -238,14 +238,36 @@ class ClusterAssignment:
 
 
 def fit_assignments(means, covs, k: int, fitted_round: int = 0,
-                    max_iter: int = 32) -> ClusterAssignment:
+                    max_iter: int = 32, sample: int = 0
+                    ) -> ClusterAssignment:
     """JS k-medoids over per-gateway latent statistics -> the carried
     `ClusterAssignment` (module docstring steps 3-4). The [G, G] matrix
-    is ONE device dispatch; the medoid loop is host control flow."""
+    is ONE device dispatch; the medoid loop is host control flow.
+
+    `sample` > 0 caps the medoid fit at pod scale (the CLARA idiom,
+    ClusterSpec.fit_sample): when G > sample, the dense [G, G] matrix is
+    quadratic-infeasible, so medoids are fitted (seed + Lloyd) on a
+    deterministic stride subsample of `sample` gateways, and EVERY
+    gateway is then assigned by Gaussian JS to the k medoid Gaussians —
+    one [G, k] `js_to_references` dispatch. Deterministic like the dense
+    fit (the stride is a pure function of G), and G <= sample stays the
+    exact dense path bitwise."""
     means = np.asarray(means, np.float32)
     covs = np.asarray(covs, np.float32)
-    js = np.asarray(pairwise_js(jnp.asarray(means), jnp.asarray(covs)))
-    assignment, _ = fit_medoids(js, k, max_iter=max_iter)
+    g = means.shape[0]
+    if sample and g > sample:
+        idx = np.round(np.linspace(0, g - 1, sample)).astype(np.int64)
+        js = np.asarray(pairwise_js(jnp.asarray(means[idx]),
+                                    jnp.asarray(covs[idx])))
+        _, medoids_s = fit_medoids(js, k, max_iter=max_iter)
+        medoids = idx[medoids_s]
+        ref = np.asarray(js_to_references(
+            jnp.asarray(means), jnp.asarray(covs),
+            jnp.asarray(means[medoids]), jnp.asarray(covs[medoids])))
+        assignment = np.argmin(ref, axis=1).astype(np.int32)
+    else:
+        js = np.asarray(pairwise_js(jnp.asarray(means), jnp.asarray(covs)))
+        assignment, _ = fit_medoids(js, k, max_iter=max_iter)
     return ClusterAssignment.from_arrays(k, assignment, means, covs,
                                          fitted_round=fitted_round)
 
@@ -264,7 +286,8 @@ def fit_from_states(model, spec: ClusterSpec, stacked_params,
                            None if train_m is None else jnp.asarray(train_m))
     return fit_assignments(np.asarray(means)[:n_real],
                            np.asarray(covs)[:n_real], spec.k,
-                           fitted_round=fitted_round)
+                           fitted_round=fitted_round,
+                           sample=spec.fit_sample)
 
 
 def assignment_from_extra(extra: Dict, spec: ClusterSpec,

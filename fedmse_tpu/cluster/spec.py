@@ -56,10 +56,21 @@ class ClusterSpec:
     refit_every: int = 0
     metric: str = "js"
     shared_modules: Tuple[str, ...] = ("encoder",)
+    # medoid-fit scale cap (the CLARA idiom): fleets larger than this fit
+    # medoids on a deterministic stride subsample and assign everyone by
+    # JS to the k medoid Gaussians (O(G*k)) — the dense [G, G] pairwise
+    # matrix is quadratic and infeasible at pod scale (100k gateways =
+    # 40 GB). Fleets <= fit_sample keep the exact dense fit, so every
+    # pre-existing grid is bitwise unchanged. 0 = always dense.
+    fit_sample: int = 4096
 
     def __post_init__(self):
         if self.k < 1:
             raise ValueError(f"k must be >= 1, got {self.k}")
+        if self.fit_sample < 0:
+            raise ValueError(
+                f"fit_sample must be >= 0 (0 = always dense pairwise), "
+                f"got {self.fit_sample}")
         if self.refit_every < 0:
             raise ValueError(
                 f"refit_every must be >= 0 (0 = fit once), got "
@@ -88,5 +99,8 @@ class ClusterSpec:
         resumed assignments with a clear message instead of a deep-Orbax
         shape error."""
         shared = ".".join(self.shared_modules)
-        return (f"k{self.k}p{int(self.personalize)}r{self.refit_every}"
-                f"m{self.metric}s{shared}")
+        sig = (f"k{self.k}p{int(self.personalize)}r{self.refit_every}"
+               f"m{self.metric}s{shared}")
+        if self.fit_sample != 4096:  # default stays compatible with
+            sig += f"f{self.fit_sample}"  # ... pre-fit_sample checkpoints
+        return sig

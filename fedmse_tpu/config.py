@@ -365,6 +365,17 @@ class ExperimentConfig:
     #              read NaN that round); at num_participants=1.0 the two
     #              layouts are bit-identical (tests/test_tiered.py).
     state_layout: str = "dense"
+    # host-sharded tiers (federation/tiered.py, DESIGN.md §20): with
+    # state_layout='tiered', each process tiers ONLY the clients its mesh
+    # devices own (TieredShardStore) — per-host RSS stays flat as the
+    # fleet grows at fixed shard width, the pod-scale contract. Forced ON
+    # whenever the client mesh spans processes (a plain tier cannot
+    # scatter a pod-global slab); this flag additionally turns it on for
+    # single-process runs, where the one shard covers the fleet and the
+    # engine is bitwise the plain tiered one (tests/test_podscale.py) —
+    # the debuggable-on-one-host form of the pod path. Ignored under
+    # state_layout='dense'.
+    host_sharded: bool = False
     # optax.flatten around Adam: folds the per-leaf update (12 small
     # elementwise ops per step across the param tree; the training loop
     # runs ~275 serial steps per round inside the fused program) into ONE

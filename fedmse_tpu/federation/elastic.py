@@ -270,7 +270,8 @@ def apply_membership_transitions(store, member: np.ndarray,
                                  joined: np.ndarray,
                                  left: np.ndarray,
                                  assignment: Optional[np.ndarray] = None,
-                                 k: int = 1) -> None:
+                                 k: int = 1,
+                                 merge_partials=None) -> None:
     """Apply one round's slot-pool ENTRY transitions to a host tier
     (federation/state.TieredClientStore; DESIGN.md §16): under the tiered
     layout joins and leaves mutate host rows directly instead of riding
@@ -291,15 +292,33 @@ def apply_membership_transitions(store, member: np.ndarray,
     makes the inheritance cluster-scoped: a joiner recycles from ITS
     cluster's incumbent mean (the dense clustered program's
     clustered_incumbent_means rule), falling back to the fleet mean when
-    its cluster has no incumbents this round."""
+    its cluster has no incumbents this round.
+
+    Host-sharded tiers (DESIGN.md §20): `store` may be a
+    `TieredShardStore` holding only rows [start, stop) of the fleet. The
+    masks stay FLEET-width (every process expands the identical
+    timeline), the incumbent-mean einsums reduce over the LOCAL columns
+    only, and `merge_partials` (parallel.multihost.allgather_tree_sum)
+    sums the per-host partials into the fleet mean — one small
+    collective that every process must enter whenever the fleet has
+    joiners this round, whether or not any land in its shard. Writes
+    then touch local rows only. Unsharded (start=0, stop=n, no merge)
+    this is bitwise the original full-fleet einsum."""
     member = np.asarray(member) > 0
     joined_b = np.asarray(joined) > 0
     left_b = np.asarray(left) > 0
+    n = len(member)
+    start = getattr(store, "start", 0)
+    stop = getattr(store, "stop", n)
     host = store.host
     if joined_b.any():
         incumbents = (member & ~joined_b).astype(np.float32)
         fleet_w = incumbents / max(float(incumbents.sum()), 1.0)
-        rows = np.flatnonzero(joined_b)
+        rows = np.flatnonzero(joined_b)              # fleet-wide joiners
+        in_shard = (rows >= start) & (rows < stop)
+        local_rows = rows[in_shard] - start
+        p_leaves = jax.tree.leaves(host.params)
+        g_leaves = jax.tree.leaves(host.prev_global)
         if assignment is not None and k > 1:
             assignment = np.asarray(assignment)
             sheet = np.zeros((k, len(incumbents)), np.float32)
@@ -310,33 +329,38 @@ def apply_membership_transitions(store, member: np.ndarray,
             sheet /= np.maximum(counts, 1.0)[:, None]
             w_rows = np.where(has[assignment[rows], None],
                               sheet[assignment[rows]], fleet_w[None, :])
-            for p_leaf, g_leaf in zip(jax.tree.leaves(host.params),
-                                      jax.tree.leaves(host.prev_global)):
-                mean = np.einsum(
-                    "jn,n...->j...", w_rows,
-                    p_leaf.astype(np.float32)).astype(p_leaf.dtype)
-                p_leaf[rows] = mean
-                g_leaf[rows] = mean
+            partials = [np.einsum("jn,n...->j...", w_rows[:, start:stop],
+                                  leaf.astype(np.float32))
+                        for leaf in p_leaves]
+            if merge_partials is not None:
+                partials = merge_partials(partials)
+            for p_leaf, g_leaf, mean32 in zip(p_leaves, g_leaves, partials):
+                mean = np.asarray(mean32)[in_shard].astype(p_leaf.dtype)
+                p_leaf[local_rows] = mean
+                g_leaf[local_rows] = mean
         else:
             # the joiner's model AND its prev_global are the incumbent
             # mean of the PARAMS (fused.py sets both from mean_params)
-            for p_leaf, g_leaf in zip(jax.tree.leaves(host.params),
-                                      jax.tree.leaves(host.prev_global)):
-                mean = np.einsum(
-                    "n,n...->...", fleet_w,
-                    p_leaf.astype(np.float32)).astype(p_leaf.dtype)
-                p_leaf[rows] = mean
-                g_leaf[rows] = mean
+            partials = [np.einsum("n,n...->...", fleet_w[start:stop],
+                                  leaf.astype(np.float32))
+                        for leaf in p_leaves]
+            if merge_partials is not None:
+                partials = merge_partials(partials)
+            for p_leaf, g_leaf, mean32 in zip(p_leaves, g_leaves, partials):
+                mean = np.asarray(mean32).astype(p_leaf.dtype)
+                p_leaf[local_rows] = mean
+                g_leaf[local_rows] = mean
         for leaf in jax.tree.leaves(host.hist_params):
-            leaf[rows] = 0
-        host.hist_perf[rows] = 0.0
-        host.hist_seen[rows] = False
-        host.rejected[rows] = 0
+            leaf[local_rows] = 0
+        host.hist_perf[local_rows] = 0.0
+        host.hist_seen[local_rows] = False
+        host.rejected[local_rows] = 0
     reset_opt = joined_b | left_b
     if reset_opt.any():
         rows = np.flatnonzero(reset_opt)
+        local_rows = rows[(rows >= start) & (rows < stop)] - start
         for leaf in jax.tree.leaves(host.opt_state):
-            leaf[rows] = 0
+            leaf[local_rows] = 0
 
 
 def membership_at(masks: MembershipMasks, round_index: int,

@@ -302,6 +302,111 @@ class TieredClientStore:
         return int(cohort * per_client)
 
 
+class TieredShardStore(TieredClientStore):
+    """Host-SHARDED tier: this process holds only rows [start, stop) of the
+    global client axis — the §12 host-local contract (each process stacks
+    only the rows its devices own) extended from the data plane to the
+    host tier itself (DESIGN.md §20; ROADMAP item 2's pod-scale half).
+
+    The API stays ABSOLUTE-id keyed (PARITY.md §8): `gather(ids)` and
+    `scatter(ids, slab)` take the same global client ids the unsharded
+    tier takes, and the shard translates them to local rows internally —
+    an id outside [start, stop) gathers as a zero row (it is some OTHER
+    host's lane; its true bytes are donated by their owner at the
+    cross-host cohort assembly, parallel/mesh.place_cohort) and scatters
+    as a no-op. A single shard covering the fleet ([0, n_clients)) is
+    bitwise the unsharded tier: same fold_in(rng, absolute_i) init draws,
+    same gather/scatter arithmetic — the host-sharded-vs-plain bit-parity
+    pin's construction (tests/test_podscale.py)."""
+
+    def __init__(self, host: ClientStates, n_clients: int, start: int,
+                 stop: int):
+        if not (0 <= start < stop <= n_clients):
+            raise ValueError(f"shard [{start}, {stop}) outside the "
+                             f"[0, {n_clients}) client axis")
+        super().__init__(host, n_clients)
+        self.start = start
+        self.stop = stop
+
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def create_shard(model, tx: optax.GradientTransformation, rng: jax.Array,
+                     n_clients: int, start: int, stop: int,
+                     init_chunk: int = 4096) -> "TieredShardStore":
+        """Initialize ONLY rows [start, stop), with the same
+        `fold_in(rng, absolute_i)` keys as the full-tier `create` — row i
+        of the shard is bitwise row i of the unsharded tier (and of the
+        dense init), so H processes building disjoint shards together
+        hold exactly the fleet the single-host tier would."""
+        from fedmse_tpu.models.autoencoder import init_client_params
+
+        def chunk_init(idx: jax.Array) -> ClientStates:
+            keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(idx)
+            params = jax.vmap(lambda r: init_client_params(model, r))(keys)
+            opt_state = jax.vmap(tx.init)(params)
+            c = idx.shape[0]
+            return ClientStates(
+                params=params, opt_state=opt_state,
+                prev_global=jax.tree.map(lambda t: t.copy(), params),
+                hist_params=jax.tree.map(jnp.zeros_like, params),
+                hist_perf=jnp.zeros((c,), jnp.float32),
+                hist_seen=jnp.zeros((c,), bool),
+                rejected=jnp.zeros((c,), jnp.int32))
+
+        chunk_init = jax.jit(chunk_init)
+        rows = stop - start
+        chunk = min(init_chunk, rows)
+        shapes = jax.eval_shape(chunk_init,
+                                jax.ShapeDtypeStruct((chunk,), jnp.int32))
+        host = jax.tree.map(
+            lambda s: np.zeros((rows,) + s.shape[1:], s.dtype), shapes)
+        host_leaves = jax.tree.leaves(host)
+        for lo in range(0, rows, chunk):
+            hi = min(lo + chunk, rows)
+            # fixed-width dispatch on ABSOLUTE ids (one executable; the
+            # tail chunk pads with repeated ids, surplus dropped on host)
+            idx = np.arange(start + lo, start + lo + chunk, dtype=np.int32)
+            idx[hi - lo:] = start + lo
+            slab = jax.device_get(chunk_init(jnp.asarray(idx)))
+            for h, s in zip(host_leaves, jax.tree.leaves(slab)):
+                h[lo:hi] = s[: hi - lo]
+        return TieredShardStore(host, n_clients, start, stop)
+
+    @staticmethod
+    def from_dense_slice(states: ClientStates, n_clients: int, start: int,
+                         stop: int) -> "TieredShardStore":
+        """Adopt rows [start, stop) of a dense-width snapshot — the
+        layout-interchangeable restore path (a dense or tiered checkpoint
+        restores into any shard topology)."""
+        host = jax.tree.map(lambda t: np.array(np.asarray(t)[start:stop]),
+                            states)
+        return TieredShardStore(host, n_clients, start, stop)
+
+    # ------------------------------------------------------------------ #
+
+    def _localize(self, ids: np.ndarray) -> np.ndarray:
+        """Absolute -> local row translation; out-of-shard ids become -1
+        (zero pad lanes under `gather_rows`, dropped by `scatter`)."""
+        ids = np.asarray(ids)
+        local = ids - self.start
+        local[(ids < self.start) | (ids >= self.stop)] = -1
+        return local
+
+    def gather(self, ids: np.ndarray, place=None) -> ClientStates:
+        return super().gather(self._localize(ids), place)
+
+    def scatter(self, ids: np.ndarray, slab: ClientStates) -> None:
+        local = self._localize(ids)
+        mine = local >= 0
+        if not mine.any():
+            return
+        rows = local[mine]
+        for h, s in zip(jax.tree.leaves(self.host),
+                        jax.tree.leaves(jax.device_get(slab))):
+            h[rows] = s[mine]
+
+
 def gather_rows(leaf: np.ndarray, ids: np.ndarray, place=None):
     """The ONE home of the padded cohort-row gather invariant
     (federation/tiered.py state/data/verification slices all route

@@ -79,9 +79,14 @@ from fedmse_tpu.federation.rounds import (RoundResult, _PROGRAM_CACHE,
                                           absorb_fused_out,
                                           split_metric_columns)
 from fedmse_tpu.federation.state import (ClientStates, HostState,
-                                         TieredClientStore, gather_rows)
-from fedmse_tpu.parallel.mesh import (host_fetch_async, pad_to_multiple,
-                                      place_cohort)
+                                         TieredClientStore,
+                                         TieredShardStore, gather_rows)
+from fedmse_tpu.parallel.mesh import (host_fetch, host_fetch_async,
+                                      local_shard_rows,
+                                      mesh_process_indices, pad_to_multiple,
+                                      place_cohort, process_tier_blocks)
+from fedmse_tpu.parallel.multihost import (allgather_blocks,
+                                           allgather_tree_sum)
 from fedmse_tpu.utils.logging import get_logger
 from fedmse_tpu.utils.seeding import ExperimentRngs
 
@@ -136,7 +141,8 @@ class TieredRoundEngine:
     def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
                  n_real: int, rngs: ExperimentRngs, model_type: str,
                  update_type: str, poison_fn=None, chaos=None, elastic=None,
-                 mesh=None, init_chunk: int = 4096, cluster=None):
+                 mesh=None, init_chunk: int = 4096, cluster=None,
+                 host_sharded: bool = False, local_data: bool = False):
         if cfg.metric == "time":
             raise ValueError("metric='time' is host-side wall-clock and "
                              "cannot run inside the fused cohort program")
@@ -163,24 +169,88 @@ class TieredRoundEngine:
         self._programs = programs
         self.evaluate_all = programs["evaluate_all"]
 
+        # ---- host-sharded tier topology (DESIGN.md §20): each process
+        # tiers ONLY the clients its devices own. Mandatory when the mesh
+        # spans processes (a plain tier cannot scatter a pod-global slab);
+        # optional single-process, where the one block covers the fleet
+        # and every path below degenerates bitwise to the plain tier
+        # (tests/test_podscale.py parity pin). ----
+        if mesh is not None and any(
+                d.process_index != jax.process_index()
+                for d in mesh.devices.flat):
+            host_sharded = True
+        if host_sharded and mesh is None:
+            raise ValueError("host_sharded tiers need a client mesh (the "
+                             "shard topology is derived from it)")
+        self.sharded = host_sharded
+        if host_sharded:
+            self._procs = mesh_process_indices(mesh)
+            self._blocks = process_tier_blocks(n_real, mesh)
+            if mesh.devices.size % len(self._procs) != 0:
+                raise ValueError("host-sharded tiers need equal device "
+                                 "counts per process on the mesh")
+            self._block_idx = self._procs.index(jax.process_index())
+            self.shard_start, self.shard_stop = self._blocks[self._block_idx]
+        else:
+            self._procs = [jax.process_index()]
+            self._blocks = [(0, n_real)]
+            self._block_idx = 0
+            self.shard_start, self.shard_stop = 0, n_real
+        self._fleet_local = (self.shard_start, self.shard_stop) == (0, n_real)
+
         # ---- host tier: data + state, keyed by absolute client id ----
         # (the incoming FederatedData may be device arrays — small-N driver
         # path — or host numpy; either way the tier owns host copies and
-        # only cohort slices ever go back to device)
+        # only cohort slices ever go back to device. Sharded tiers keep
+        # only the LOCAL rows [shard_start, shard_stop) — with
+        # `local_data=True` the caller already stacked just those rows,
+        # the per-host-RSS-flat path of the podscale bench.)
+        lo, hi = self.shard_start, self.shard_stop
+        rows = (slice(0, hi - lo) if local_data else slice(lo, hi))
         self.host_data = FederatedData(**{
             f.name: (getattr(data, f.name) if f.name == "dev_x"
                      else np.asarray(jax.device_get(getattr(data, f.name)))
-                     [:n_real])
+                     [rows])
             for f in dataclasses.fields(FederatedData)})
+        if self.host_data.train_xb.shape[0] != hi - lo:
+            raise ValueError(
+                f"host data carries {self.host_data.train_xb.shape[0]} "
+                f"client rows; this shard needs {hi - lo}")
         self._dev_x = jnp.asarray(data.dev_x)
-        self.store = TieredClientStore.create(
-            model, self.tx, rngs.next_jax(), n_real, init_chunk=init_chunk)
+        if host_sharded:
+            self.store = TieredShardStore.create_shard(
+                model, self.tx, rngs.next_jax(), n_real, lo, hi,
+                init_chunk=init_chunk)
+        else:
+            self.store = TieredClientStore.create(
+                model, self.tx, rngs.next_jax(), n_real,
+                init_chunk=init_chunk)
         self.host = HostState.create(n_real)
+        # fleet-width mirror of the rejected counters (identical on every
+        # process: updated from the allgathered round outputs) — the shard
+        # holds only local rows, but RoundResult reports the fleet
+        self._rejected_full = (None if self._fleet_local
+                               else np.zeros(n_real, np.int32))
 
-        # ---- fixed cohort width: the selection size, padded to the mesh ----
-        n_sel = max(1, int(cfg.num_participants * n_real))
-        self.cohort = (pad_to_multiple(n_sel, mesh.devices.size)
-                       if mesh is not None else n_sel)
+        # ---- fixed cohort width: the selection size, padded to the mesh.
+        # Sharded: the cohort is H equal lane blocks, one per host, each
+        # the per-host selection padded to the host's devices — so every
+        # host's lanes land on its own devices and the local tier gather
+        # fills exactly the lanes this process donates at placement. ----
+        if host_sharded:
+            self._sel_counts = [
+                max(1, int(cfg.num_participants * (b_hi - b_lo)))
+                for b_lo, b_hi in self._blocks]
+            self._lane_width = pad_to_multiple(
+                max(self._sel_counts),
+                mesh.devices.size // len(self._procs))
+            self.cohort = self._lane_width * len(self._procs)
+        else:
+            n_sel = max(1, int(cfg.num_participants * n_real))
+            self.cohort = (pad_to_multiple(n_sel, mesh.devices.size)
+                           if mesh is not None else n_sel)
+            self._sel_counts = None
+            self._lane_width = self.cohort
         self._place = place_cohort(mesh, self.cohort,
                                    cfg.client_axis_name)
         # constant-across-rounds verification tensors (dev / quirk-6 modes
@@ -202,61 +272,99 @@ class TieredRoundEngine:
         self._sync_gather = elastic is not None
 
         # clustered federation over the tier (fedmse_tpu/cluster/): the
-        # assignment is fitted ONCE, lazily at the first round (so a
-        # resume that re-pins the checkpointed assignment never pays the
-        # full-fleet stats pass for a fit it would discard) — per-gateway
-        # latent stats computed in cohort-width device chunks over the
-        # host tier (no [N, ...] device materialization), keyed by
-        # absolute id so the cohort gather below carries exact per-slot
-        # cluster columns. Cadence refits are a dense-layout feature for
-        # now: the tier's probe would re-stream the whole fleet per refit
-        # (DESIGN §19).
+        # assignment is fitted lazily at the first round (so a resume
+        # that re-pins the checkpointed assignment never pays the
+        # full-fleet stats pass for a fit it would discard) and REFIT on
+        # the dense engine's cadence (`refit_every`, rounds.py
+        # _ensure_cluster_fit) — per-gateway latent stats computed in
+        # chunked device passes over the host tier (no [N, ...] device
+        # materialization), keyed by absolute id so the cohort gather
+        # below carries exact per-slot cluster columns at ANY shard
+        # layout. Sharded tiers probe with the fleet-mean of the CURRENT
+        # params via a partial-sum allgather and merge per-host stats
+        # blocks, so every process fits the identical assignment.
         self.cluster = cluster
         self._cluster_vec = None
+        self._cluster_fitted_round = 0
         self.cluster_fit = None
-        if cluster is not None and not cluster.is_null \
-                and cluster.refit_every > 0:
-            logger.warning(
-                "state_layout=tiered fits the cluster assignment once; "
-                "refit_every=%d is inert here", cluster.refit_every)
 
         self._fused_round = None
         self.stats = TieredStats()
 
     # ------------------------------------------------------------------ #
 
-    def _ensure_cluster(self) -> None:
-        """Fit the assignment if clustering is on and nothing pinned it
-        (a resume pins the checkpointed vector before the first round)."""
-        if self.cluster is None or self.cluster.is_null \
-                or self._cluster_vec is not None:
+    def _ensure_cluster(self, round_index: int = 0) -> None:
+        """Fit (or cadence-refit) the assignment — the dense engine's
+        due-logic (rounds._ensure_cluster_fit): fit when nothing pinned a
+        vector (a resume pins the checkpointed one before the first
+        round), refit when `refit_every` rounds have passed since the
+        round the incumbent vector was fitted at."""
+        if self.cluster is None or self.cluster.is_null:
+            return
+        due = (self._cluster_vec is None
+               or (self.cluster.refit_every > 0
+                   and round_index - self._cluster_fitted_round
+                   >= self.cluster.refit_every))
+        if not due:
             return
         self._cluster_vec = self._fit_cluster().assignment
+        self._cluster_fitted_round = round_index
+
+    def _cluster_probe(self):
+        """The stats probe: fleet-mean of the tier's CURRENT params (at
+        round 0 the incumbent init mean; at a cadence refit, the mean of
+        the trained states — same probe the dense refit computes from its
+        dense axis). Sharded tiers sum local rows and merge partials over
+        the control plane; the fleet-local path is the original bitwise
+        np.mean."""
+        params = self.store.host.params
+        if self._fleet_local:
+            return jax.tree.map(
+                lambda t: jnp.asarray(t.astype(np.float32).mean(axis=0)
+                                      .astype(t.dtype)), params)
+        partial = jax.tree.map(
+            lambda t: t.astype(np.float32).sum(axis=0), params)
+        total = allgather_tree_sum(partial)
+        return jax.tree.map(
+            lambda t, s: jnp.asarray((s / self.n_real).astype(t.dtype)),
+            params, total)
 
     def _fit_cluster(self):
-        """Latent stats over the host tier in cohort-width chunks -> JS
-        k-medoids (cluster/assign.py). The probe is the host-side mean of
-        the tier's init params (the incumbent mean at round 0)."""
+        """Latent stats over the host tier in fixed-width device chunks ->
+        JS k-medoids (cluster/assign.py). Sharded: each host streams ONLY
+        its tier rows (locally placed — no collective inside the chunk
+        loop), then the per-host mean/cov blocks are reassembled
+        fleet-wide (`allgather_blocks`) so `fit_assignments` — a
+        deterministic host computation — produces the identical
+        assignment on every process."""
         from fedmse_tpu.cluster import (ClusterAssignment, fit_assignments,
                                         make_latent_stats_fn)
-        host = self.store.host
-        probe = jax.tree.map(
-            lambda t: jnp.asarray(t.astype(np.float32).mean(axis=0)
-                                  .astype(t.dtype)), host.params)
         stats_fn = make_latent_stats_fn(self.model)
-        c, n, hd = self.cohort, self.n_real, self.host_data
+        probe = self._cluster_probe()
+        hd = self.host_data
         means, covs = [], []
+        if self._fleet_local:
+            c, n = self.cohort, self.n_real
+            place = jnp.asarray
+        else:
+            c, n = self._lane_width, self.shard_stop - self.shard_start
+            place = lambda leaf: jnp.array(leaf, copy=True)  # noqa: E731
         for start in range(0, n, c):
             stop = min(start + c, n)
             ids = np.arange(start, start + c, dtype=np.int32)
             ids[stop - start:] = start  # fixed-width chunk (one executable)
             rows = np.minimum(ids, n - 1)
-            m, v = stats_fn(probe, jnp.asarray(hd.train_xb[rows]),
-                            jnp.asarray(hd.train_mb[rows]))
+            m, v = stats_fn(probe, place(hd.train_xb[rows]),
+                            place(hd.train_mb[rows]))
             means.append(np.asarray(m)[: stop - start])
             covs.append(np.asarray(v)[: stop - start])
-        fit = fit_assignments(np.concatenate(means), np.concatenate(covs),
-                              self.cluster.k)
+        means = np.concatenate(means)
+        covs = np.concatenate(covs)
+        if not self._fleet_local:
+            means = allgather_blocks(means, self._blocks, self._procs)
+            covs = allgather_blocks(covs, self._blocks, self._procs)
+        fit = fit_assignments(means, covs, self.cluster.k,
+                              sample=self.cluster.fit_sample)
         self.cluster_fit: ClusterAssignment = fit
         logger.info("tiered cluster fit: k=%d sizes=%s", self.cluster.k,
                     np.bincount(fit.assignment,
@@ -323,6 +431,14 @@ class TieredRoundEngine:
                                     (c,) + self.host_data.dev_x.shape)
             ver_m = np.ones((c, ver_x.shape[1]), np.float32)
         elif cfg.compat.shared_last_client_val:
+            if not self._fleet_local:
+                # the quirk's ONE shared tensor is client n_real-1's, which
+                # only the last shard holds; shipping it host-to-host for a
+                # reference quirk is not worth a control-plane seam
+                raise ValueError(
+                    "compat.shared_last_client_val needs the last client's "
+                    "validation rows on every host; host-sharded tiers "
+                    "support verification_method='val' or 'dev'")
             last_x = self.host_data.valid_x[self.n_real - 1]
             last_m = self.host_data.valid_m[self.n_real - 1]
             ver_x = np.broadcast_to(last_x, (c,) + last_x.shape)
@@ -336,28 +452,72 @@ class TieredRoundEngine:
 
     def select_clients(self) -> List[int]:
         """Identical draw (same host stream, same order) as the dense
-        engine's (src/main.py:270-273)."""
-        n_sel = max(1, int(self.cfg.num_participants * self.n_real))
-        return self.rngs.select_rng.sample(range(self.n_real), n_sel)
+        engine's (src/main.py:270-273). Host-sharded pods stratify the
+        draw by tier block — per-block samples from the ONE shared
+        select stream, in block order, so every process derives the
+        identical selection without exchanging a byte; at H=1 the single
+        block covers the fleet and the draw is bitwise the plain one."""
+        if len(self._blocks) == 1:
+            n_sel = max(1, int(self.cfg.num_participants * self.n_real))
+            return self.rngs.select_rng.sample(range(self.n_real), n_sel)
+        out: List[int] = []
+        for (lo, hi), n_sel in zip(self._blocks, self._sel_counts):
+            out.extend(self.rngs.select_rng.sample(range(lo, hi), n_sel))
+        return out
 
     def _plan(self, round_index: int,
               selected: Optional[List[int]] = None,
               key: Optional[jax.Array] = None) -> CohortPlan:
+        """Every process computes the IDENTICAL plan (shared host
+        streams), so the cohort layout needs no cross-host agreement.
+        Sharded layout: H lane blocks of width `_lane_width`, block j
+        holding host j's sorted selected ids at base j*width with a -1
+        pad tail — each host's lanes land on its own devices, which is
+        what makes the cohort gather a purely local tier read. One
+        block degenerates to the plain sorted-prefix layout."""
         if selected is None:
             selected = self.select_clients()
         if key is None:
             key = self.rngs.next_jax()
+        sel = np.asarray(selected, np.int32)
         ids = np.full(self.cohort, -1, np.int32)
-        srt = np.sort(np.asarray(selected, np.int32))
-        ids[: len(srt)] = srt
-        sel_pos = np.searchsorted(srt, np.asarray(selected, np.int32)
-                                  ).astype(np.int32)
+        if len(self._blocks) == 1:
+            srt = np.sort(sel)
+            ids[: len(srt)] = srt
+            sel_pos = np.searchsorted(srt, sel).astype(np.int32)
+        else:
+            sel_pos = np.empty(len(sel), np.int32)
+            w = self._lane_width
+            for j, (lo, hi) in enumerate(self._blocks):
+                in_blk = (sel >= lo) & (sel < hi)
+                blk = sel[in_blk]
+                if blk.size > w:
+                    raise ValueError(
+                        f"block {j} selected {blk.size} clients for "
+                        f"{w} lanes")
+                srt = np.sort(blk)
+                base = j * w
+                ids[base: base + srt.size] = srt
+                sel_pos[in_blk] = (base + np.searchsorted(srt, blk)
+                                   ).astype(np.int32)
         mask = (ids >= 0).astype(np.float32)
         return CohortPlan(round_index=round_index, selected=list(selected),
                           ids=ids, sel_pos=sel_pos, mask=mask, key=key)
 
+    def _local_ids(self, ids: np.ndarray) -> np.ndarray:
+        """Absolute cohort ids -> rows of the LOCAL host_data/tier slice;
+        lanes owned by other hosts (and pad lanes) map to -1, which
+        `gather_rows` zero-fills — and the pod placement never reads
+        them (each process's devices own exactly its lane block)."""
+        if self._fleet_local:
+            return ids
+        local = np.asarray(ids) - self.shard_start
+        local[(ids < self.shard_start) | (ids >= self.shard_stop)] = -1
+        return local
+
     def _gather_data(self, plan: CohortPlan) -> FederatedData:
-        kw = {name: gather_rows(getattr(self.host_data, name), plan.ids,
+        rows = self._local_ids(plan.ids)
+        kw = {name: gather_rows(getattr(self.host_data, name), rows,
                                 self._place)
               for name in _COHORT_DATA_FIELDS}
         return FederatedData(dev_x=self._dev_x,
@@ -366,8 +526,9 @@ class TieredRoundEngine:
     def _gather_ver(self, plan: CohortPlan):
         if self._const_ver is not None:
             return self._const_ver
-        return (gather_rows(self.host_data.valid_x, plan.ids, self._place),
-                gather_rows(self.host_data.valid_m, plan.ids, self._place))
+        rows = self._local_ids(plan.ids)
+        return (gather_rows(self.host_data.valid_x, rows, self._place),
+                gather_rows(self.host_data.valid_m, rows, self._place))
 
     def _prefetch(self, plan: CohortPlan) -> PrefetchedCohort:
         """Issue round `plan.round_index`'s cohort gather + H2D NOW (while
@@ -458,14 +619,24 @@ class TieredRoundEngine:
         else:
             member_full = np.ones(n, np.float32)
             gen_full = np.zeros(n, np.int32)
+        if self._fleet_local:
+            # the tier holds every client's CURRENT rejected counter (the
+            # scatter below already landed this round's cohort updates)
+            rejected_full = self.store.host.rejected[:n]
+        else:
+            # the shard holds only local rows; the fleet-width mirror is
+            # refreshed from the allgathered cohort outputs (identical on
+            # every process — the harvested bundle's counters ARE the
+            # values the scatter just landed for the cohort rows)
+            self._rejected_full[rows] = np.asarray(
+                out.rejected)[real].astype(np.int32)
+            rejected_full = self._rejected_full
         full = FusedRoundOut(
             aggregator=np.int32(ids[agg_c] if agg_c >= 0 else -1),
             metrics=metrics,
             scores=scatter(out.scores, np.nan),
             weights=scatter(out.weights, 0.0),
-            # the tier holds every client's CURRENT rejected counter (the
-            # scatter below already landed this round's cohort updates)
-            rejected=self.store.host.rejected[:n],
+            rejected=rejected_full,
             min_valid=scatter(out.min_valid, np.nan),
             tracking=scatter(out.tracking, np.nan,
                              np.asarray(out.tracking).shape[1:]),
@@ -495,6 +666,20 @@ class TieredRoundEngine:
             jnp.asarray(plan.round_index, jnp.int32),
             **self._mask_kwargs(plan))
 
+    def _scatter_slab(self, plan: CohortPlan, new_slab) -> None:
+        """Land a round's output slab in the tier. Pod mode: the slab is a
+        pod-global array and this process only tiers its own lane block —
+        `local_shard_rows` pulls exactly the addressable rows (no
+        collective, no other host's bytes) and the shard store writes the
+        block's real lanes. Fleet-local single-process: the original
+        full-slab scatter, untouched."""
+        if jax.process_count() == 1:
+            self.store.scatter(plan.ids, new_slab)
+            return
+        lo = self._block_idx * self._lane_width
+        self.store.scatter(plan.ids[lo: lo + self._lane_width],
+                           local_shard_rows(new_slab))
+
     def run_round(self, round_index: int,
                   selected: Optional[List[int]] = None,
                   key: Optional[jax.Array] = None) -> RoundResult:
@@ -502,15 +687,15 @@ class TieredRoundEngine:
         prefetched loop is pinned against; also the replay entry point)."""
         if self._fused_round is None:
             self._build_fused()
-        self._ensure_cluster()
+        self._ensure_cluster(round_index)
         plan = self._plan(round_index, selected, key)
         self._entry_transitions(round_index)
         pf = self._prefetch(plan)
         slab = pf.slab if pf.slab is not None else \
             self.store.gather(plan.ids, place=self._place)
         new_slab, _, out = self._dispatch(pf, slab)
-        out = jax.device_get(out)
-        self.store.scatter(plan.ids, new_slab)
+        out = host_fetch(out)
+        self._scatter_slab(plan, new_slab)
         return self._absorb(out, plan)
 
     def _entry_transitions(self, round_index: int) -> None:
@@ -522,7 +707,9 @@ class TieredRoundEngine:
             self._elastic_np.joined[round_index][: self.n_real],
             self._elastic_np.left[round_index][: self.n_real],
             assignment=self._cluster_vec,
-            k=1 if self.cluster is None else self.cluster.k)
+            k=1 if self.cluster is None else self.cluster.k,
+            merge_partials=None if self._fleet_local
+            else allgather_tree_sum)
 
     def run_rounds(self, start_round: int, num_rounds: int,
                    consume) -> TieredStats:
@@ -538,7 +725,7 @@ class TieredRoundEngine:
         the same contract as the pipelined chunk executor's)."""
         if self._fused_round is None:
             self._build_fused()
-        self._ensure_cluster()
+        self._ensure_cluster(start_round)
         stats = self.stats
         end = start_round + num_rounds
         if num_rounds <= 0:
@@ -550,6 +737,13 @@ class TieredRoundEngine:
         k = start_round
         while k < end:
             plan = pf.plan
+            # cadence refit at round entry (satellite of DESIGN §20): the
+            # probe reads the tier's CURRENT params — everything through
+            # round k-1 is already scattered, nothing is in flight. The
+            # prefetched slab/data carry no cluster columns, so a refit
+            # here re-keys this round's `cluster_in` without invalidating
+            # the prefetch.
+            self._ensure_cluster(k)
             # wait-for-prefetch telemetry: ~0 when the H2D overlapped the
             # previous round's compute (the acceptance's prefetch gap)
             t0 = time.time()
@@ -603,7 +797,7 @@ class TieredRoundEngine:
                 # blocking H2D shows up in prefetch_gap_s, not here
                 stats.overlapped_issue.append(
                     next_pf.t_issue_end <= t_harvest_done)
-            self.store.scatter(plan.ids, new_slab)
+            self._scatter_slab(plan, new_slab)
             result = self._absorb(out, plan)
             stats.rounds += 1
             sec = time.time() - t0
@@ -623,27 +817,43 @@ class TieredRoundEngine:
     # ------------------------------------------------------------------ #
 
     def evaluate_final_streamed(self) -> np.ndarray:
-        """Final evaluation of EVERY client in cohort-width device chunks —
+        """Final evaluation of EVERY client in fixed-width device chunks —
         the dense driver's full-fleet `evaluate_all` without materializing
         a `[N, ...]` device tree. One executable (fixed chunk width; the
-        tail chunk pads with repeated rows and drops the surplus)."""
-        c = self.cohort
-        n = self.n_real
+        tail chunk pads with repeated rows and drops the surplus).
+        Sharded: each host streams its OWN tier rows with local placement
+        (no collective in the loop), then the per-host metric blocks are
+        reassembled fleet-wide so every process returns the identical
+        full array — the driver's artifact/summary code is unchanged."""
+        hd = self.host_data
+        if self._fleet_local:
+            c, n = self.cohort, self.n_real
+            gather = lambda ids: self.store.gather(  # noqa: E731
+                ids, place=self._place)
+            place = self._place
+        else:
+            c, n = self._lane_width, self.shard_stop - self.shard_start
+            gather = lambda ids: self.store.gather(  # noqa: E731
+                ids + self.shard_start,
+                place=lambda leaf: jnp.array(leaf, copy=True))
+            place = lambda leaf: jnp.array(leaf, copy=True)  # noqa: E731
         outs = []
         for start in range(0, n, c):
             stop = min(start + c, n)
             ids = np.arange(start, start + c, dtype=np.int32)
             ids[stop - start:] = start
-            slab = self.store.gather(ids, place=self._place)
+            slab = gather(ids)
             rows = np.minimum(ids, n - 1)
-            hd = self.host_data
             m = np.asarray(jax.device_get(self.evaluate_all(
-                slab.params, self._place(hd.test_x[rows]),
-                self._place(hd.test_m[rows]), self._place(hd.test_y[rows]),
-                self._place(hd.train_xb[rows]),
-                self._place(hd.train_mb[rows]))))
+                slab.params, place(hd.test_x[rows]),
+                place(hd.test_m[rows]), place(hd.test_y[rows]),
+                place(hd.train_xb[rows]),
+                place(hd.train_mb[rows]))))
             outs.append(m[: stop - start])
-        return np.concatenate(outs, axis=0)
+        local = np.concatenate(outs, axis=0)
+        if self._fleet_local:
+            return local
+        return allgather_blocks(local, self._blocks, self._procs)
 
     def cohort_bytes(self) -> Dict[str, int]:
         """Device-resident byte accounting of the steady-state cohort loop
@@ -696,6 +906,11 @@ class TieredRoundEngine:
         tiered and dense runs write interchangeable checkpoints (a
         pre-PR-11 dense snapshot restores into the tier, and a tiered
         snapshot restores into a dense engine — checkpointing/io.py)."""
+        if not self._fleet_local:
+            raise ValueError(
+                "a host-sharded tier holds only its own rows; pod runs "
+                "checkpoint via CheckpointManager.save_shard / "
+                "restore_sharded (checkpointing/io.py)")
         if n_pad == self.n_real:
             return self.store.host
         def grow(leaf):
@@ -706,8 +921,25 @@ class TieredRoundEngine:
 
     def restore_states(self, states: ClientStates) -> None:
         """Adopt a restored (dense-width) snapshot into the tier."""
-        self.store = TieredClientStore.from_dense(
-            jax.tree.map(lambda t: np.asarray(t)[: self.n_real], states))
+        rows = jax.tree.map(lambda t: np.asarray(t)[: self.n_real], states)
+        if self.sharded:
+            self.store = TieredShardStore.from_dense_slice(
+                rows, self.n_real, self.shard_start, self.shard_stop)
+        else:
+            self.store = TieredClientStore.from_dense(rows)
+
+    def adopt_shard_states(self, states: ClientStates) -> None:
+        """Adopt THIS shard's restored rows (restore_sharded at this
+        engine's [shard_start, shard_stop)) into the tier — the pod
+        resume path, which never materializes the fleet anywhere."""
+        host = jax.tree.map(lambda t: np.array(np.asarray(t)), states)
+        lead = jax.tree.leaves(host)[0].shape[0]
+        if lead != self.shard_stop - self.shard_start:
+            raise ValueError(
+                f"shard snapshot carries {lead} rows; this shard tiers "
+                f"{self.shard_stop - self.shard_start}")
+        self.store = TieredShardStore(host, self.n_real,
+                                      self.shard_start, self.shard_stop)
 
 
 def _save_hybrid_latents_streamed(cfg, model, engine: TieredRoundEngine,
@@ -750,12 +982,13 @@ def run_tiered_combination(cfg: ExperimentConfig, data, n_real: int,
                            mesh=None, resume=None,
                            save_checkpoints: bool = False,
                            attack=None, chaos=None, elastic=None,
-                           cluster=None) -> Dict:
+                           cluster=None, local_data: bool = False) -> Dict:
     """`main.run_combination` for state_layout='tiered': same artifacts,
     same bookkeeping order, same early-stop/resume semantics — the round
     loop runs the cohort executor instead of the dense scanned schedule.
     Returns the same result dict shape (plus the prefetch telemetry under
-    'tiered_stats')."""
+    'tiered_stats'). `local_data=True` marks `data` as a host-local stack
+    (only this process's tier rows — the pod bench's RSS-flat path)."""
     from fedmse_tpu.checkpointing import (save_client_models,
                                           save_training_tracking)
     from fedmse_tpu.models import make_model
@@ -774,7 +1007,15 @@ def run_tiered_combination(cfg: ExperimentConfig, data, n_real: int,
                                model_type=model_type,
                                update_type=update_type, poison_fn=poison_fn,
                                chaos=chaos, elastic=elastic, mesh=mesh,
-                               cluster=cluster)
+                               cluster=cluster,
+                               host_sharded=getattr(cfg, "host_sharded",
+                                                    False),
+                               local_data=local_data)
+    pod = not engine._fleet_local
+    if pod and jax.process_index() != 0:
+        # all processes compute identical results (allgathered outputs,
+        # shared host streams); exactly one writes the shared artifacts
+        writer = None
 
     n_pad = data.num_clients_padded
     round_times: List[float] = []
@@ -799,23 +1040,46 @@ def run_tiered_combination(cfg: ExperimentConfig, data, n_real: int,
             extra.update({"cluster_k": cluster.k,
                           "cluster_assignment":
                           engine.cluster_assignment.tolist(),
-                          "cluster_fitted_round": 0})
+                          "cluster_fitted_round":
+                          engine._cluster_fitted_round})
         return extra
 
-    if resume is not None and resume.exists(tag):
+    pod_ckpt = resume is not None and resume.exists_sharded(tag)
+    if resume is not None and (pod_ckpt or resume.exists(tag)):
+        if pod and not pod_ckpt:
+            raise ValueError(
+                f"{tag!r} has a dense snapshot but this is a pod-sharded "
+                "run; restore it single-process (or convert with "
+                "CheckpointManager.restore_sharded) — a pod process "
+                "cannot hold the fleet")
+        recorded = resume.pod_extra(tag) if pod_ckpt else resume.extra(tag)
         if cluster is not None and not cluster.is_null:
             # resume under the RECORDED assignment (K change fails with
             # the clear cluster message — cluster/assign.py), not the
-            # construction-time fit from fresh init params
+            # construction-time fit from fresh init params; the recorded
+            # fit round keeps the refit cadence aligned across the resume
             from fedmse_tpu.cluster import assignment_from_extra
-            vec = assignment_from_extra(resume.extra(tag), cluster, n_real)
+            vec = assignment_from_extra(recorded, cluster, n_real)
             if vec is not None:
                 engine._cluster_vec = vec
-        states, engine.host, start_round, prev_tracking = resume.restore(
-            tag, engine.states_for_checkpoint(n_pad),
-            expected_extra=resume_expected, extra_defaults=resume_defaults,
-            layout="tiered")
-        engine.restore_states(states)
+                engine._cluster_fitted_round = int(
+                    recorded.get("cluster_fitted_round", 0))
+        if pod_ckpt:
+            # layout-interchangeable: whatever H wrote the shards, this
+            # process reads exactly its own [shard_start, shard_stop)
+            states, engine.host, start_round, prev_tracking = \
+                resume.restore_sharded(
+                    tag, engine.store.host, engine.shard_start,
+                    engine.shard_stop, expected_extra=resume_expected,
+                    extra_defaults=resume_defaults)
+            engine.adopt_shard_states(states)
+        else:
+            states, engine.host, start_round, prev_tracking = \
+                resume.restore(
+                    tag, engine.states_for_checkpoint(n_pad),
+                    expected_extra=resume_expected,
+                    extra_defaults=resume_defaults, layout="tiered")
+            engine.restore_states(states)
         if prev_tracking is not None:
             all_tracking.append(prev_tracking)
         logger.info("resumed %s (tiered) at round %d", tag, start_round)
@@ -836,11 +1100,21 @@ def run_tiered_combination(cfg: ExperimentConfig, data, n_real: int,
             writer.append_verification(run, result.round_index,
                                        result.verification_results)
         if resume is not None:
-            resume.save(tag, engine.states_for_checkpoint(n_pad),
-                        engine.host, result.round_index + 1,
-                        extra=resume_extra(result.round_index + 1),
-                        tracking=np.concatenate(all_tracking, axis=1)
+            tracking = (np.concatenate(all_tracking, axis=1)
                         if all_tracking else None)
+            if pod:
+                resume.save_shard(tag, engine.store.host, engine.host,
+                                  result.round_index + 1,
+                                  engine.shard_start, engine.shard_stop,
+                                  engine._blocks,
+                                  extra=resume_extra(
+                                      result.round_index + 1),
+                                  tracking=tracking)
+            else:
+                resume.save(tag, engine.states_for_checkpoint(n_pad),
+                            engine.host, result.round_index + 1,
+                            extra=resume_extra(result.round_index + 1),
+                            tracking=tracking)
         if early_stop is not None and uniform_decision(
                 early_stop.should_stop(result.client_metrics)):
             logger.info("Early stopping in global round!")
@@ -862,15 +1136,24 @@ def run_tiered_combination(cfg: ExperimentConfig, data, n_real: int,
                                           final_metrics_full, np.nan)
 
     if writer is not None and save_checkpoints and device_names:
-        save_client_models(writer, run, model_type, update_type,
-                           device_names, engine.store.host.params)
-        if all_tracking:
-            save_training_tracking(writer, run, model_type, update_type,
-                                   device_names,
-                                   np.concatenate(all_tracking, axis=1))
-        if model_type == "hybrid":
-            _save_hybrid_latents_streamed(cfg, model, engine, run,
-                                          update_type)
+        if pod:
+            # process 0 tiers only its own rows; the per-client dense
+            # export would need a fleet-wide state shuffle. Pod runs keep
+            # the sharded snapshot (save_shard) as the durable artifact.
+            logger.warning("pod-sharded run: skipping per-client model "
+                           "export (restore the sharded checkpoint "
+                           "single-process to produce ClientModel/)")
+        else:
+            save_client_models(writer, run, model_type, update_type,
+                               device_names, engine.store.host.params)
+            if all_tracking:
+                save_training_tracking(writer, run, model_type,
+                                       update_type, device_names,
+                                       np.concatenate(all_tracking,
+                                                      axis=1))
+            if model_type == "hybrid":
+                _save_hybrid_latents_streamed(cfg, model, engine, run,
+                                              update_type)
 
     out = {
         "final_metrics": final_metrics,

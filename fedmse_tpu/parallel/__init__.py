@@ -2,8 +2,12 @@ from fedmse_tpu.parallel.mesh import (
     client_mesh,
     host_fetch,
     host_fetch_async,
+    local_shard_rows,
+    mesh_process_indices,
+    my_tier_block,
     pad_to_multiple,
     process_client_rows,
+    process_tier_blocks,
     replicate,
     shard_clients,
     shard_clients_local,
@@ -15,10 +19,14 @@ from fedmse_tpu.parallel.collectives import (
     make_shardmap_aggregate,
     make_shardmap_divergence,
 )
+from fedmse_tpu.parallel.multihost import (allgather_blocks,
+                                            allgather_tree_sum)
 from fedmse_tpu.parallel.multihost import initialize as initialize_multihost
 from fedmse_tpu.parallel.multihost import uniform_decision
 
 __all__ = [
+    "allgather_blocks",
+    "allgather_tree_sum",
     "client_mesh",
     "host_fetch",
     "host_fetch_async",
@@ -28,8 +36,12 @@ __all__ = [
     "make_hierarchical_aggregate",
     "make_shardmap_aggregate",
     "make_shardmap_divergence",
+    "local_shard_rows",
+    "mesh_process_indices",
+    "my_tier_block",
     "pad_to_multiple",
     "process_client_rows",
+    "process_tier_blocks",
     "replicate",
     "shard_clients",
     "shard_clients_local",

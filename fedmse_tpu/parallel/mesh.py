@@ -99,6 +99,53 @@ def process_client_rows(n_pad: int, mesh: Mesh) -> Tuple[int, int]:
     return mine[0] * per, (mine[-1] + 1) * per
 
 
+def mesh_process_indices(mesh: Mesh) -> list:
+    """Process indices on the mesh, in DEVICE order (each process's devices
+    contiguous — validated like `process_client_rows`). Single-process
+    meshes return [process_index]. This order is the pod's canonical host
+    order: tier shard blocks, cohort lane blocks and control-plane
+    allgathers all follow it, so every process derives the identical
+    global layout from the mesh alone."""
+    seen: list = []
+    for d in mesh.devices.flat:
+        if not seen or seen[-1] != d.process_index:
+            seen.append(d.process_index)
+    if len(set(seen)) != len(seen):
+        raise ValueError(
+            f"mesh devices interleave processes ({seen}); host-sharded "
+            "tiers need each process's devices contiguous on the mesh")
+    return seen
+
+
+def process_tier_blocks(n_real: int, mesh: Mesh) -> list:
+    """Contiguous [start, stop) blocks of the REAL client axis, one per
+    mesh process in device order — which clients each host TIERS
+    (federation/state.TieredShardStore). Unlike `process_client_rows`
+    (device-granular, padded axis), tier blocks split the unpadded
+    n_real axis host-granularly: near-equal sizes, the first
+    `n_real % H` hosts take one extra row. A 1-process mesh gets the
+    whole axis — the degenerate block under which the host-sharded
+    engine is bitwise the plain tiered one."""
+    procs = mesh_process_indices(mesh)
+    h = len(procs)
+    if n_real < h:
+        raise ValueError(f"{n_real} clients cannot shard over {h} hosts")
+    base, rem = divmod(n_real, h)
+    blocks, lo = [], 0
+    for j in range(h):
+        hi = lo + base + (1 if j < rem else 0)
+        blocks.append((lo, hi))
+        lo = hi
+    return blocks
+
+
+def my_tier_block(n_real: int, mesh: Mesh) -> Tuple[int, int]:
+    """This process's [start, stop) tier block (see process_tier_blocks)."""
+    procs = mesh_process_indices(mesh)
+    return process_tier_blocks(n_real, mesh)[
+        procs.index(jax.process_index())]
+
+
 def shard_clients_local(tree: Any, mesh: Mesh, global_clients: int,
                         axis_name: str = "clients") -> Any:
     """Place a HOST-LOCAL stacked pytree (leading axis = only this process's
@@ -152,10 +199,25 @@ def place_cohort(mesh: Optional[Mesh], cohort: int,
     temporaries (use-after-free). The tiered round program is jitted
     WITHOUT donation for exactly this reason (tiered._build_fused war
     story); the owned-copy rule here is defense in depth so no future
-    consumer of a cohort placement can reintroduce the hazard."""
+    consumer of a cohort placement can reintroduce the hazard.
+
+    When the mesh spans processes, this IS the cross-host cohort
+    assembly (DESIGN.md §20): every process passes a full-shape [C, ...]
+    host array in which only ITS lane block holds real bytes (the
+    host-local tier gather zero-fills other hosts' lanes), and
+    `make_array_from_process_local_data` reads exactly the rows each
+    process's devices own — one placement call assembles the global
+    cohort slab from H disjoint local gathers, with no redundant H2D
+    and no host-side exchange (the collective seam first crossed inside
+    the round program itself)."""
     if mesh is None or cohort % mesh.devices.size != 0:
         return lambda leaf: jnp.array(leaf, copy=True)
     sharding = NamedSharding(mesh, P(axis_name))
+    if any(d.process_index != jax.process_index()
+           for d in mesh.devices.flat):
+        return lambda leaf: jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(leaf),
+            global_shape=np.shape(leaf))
     return lambda leaf: jax.device_put(jnp.array(leaf, copy=True), sharding)
 
 
@@ -164,6 +226,28 @@ def replicate(tree: Any, mesh: Mesh) -> Any:
     mesh."""
     return jax.tree.map(
         lambda leaf: _place(leaf, NamedSharding(mesh, P())), tree)
+
+
+def local_shard_rows(tree: Any) -> Any:
+    """This process's OWN leading-axis rows of a `P('clients')`-sharded
+    global pytree, as host numpy — no collective, no other host's bytes.
+
+    The host-sharded scatter's harvest seam (federation/tiered.py pod
+    mode): a round's output slab is a pod-global array, but each host
+    only needs the lanes it tiers — `addressable_shards` are exactly
+    those, concatenated in lane order. `host_fetch` (below) is the
+    opposite trade: EVERY host pays a process_allgather for the full
+    value; it stays reserved for the control-plane bundle, which every
+    host's bookkeeping genuinely needs."""
+    def fetch(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            shards = sorted(leaf.addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            return np.concatenate([np.asarray(s.data) for s in shards],
+                                  axis=0)
+        return np.asarray(jax.device_get(leaf))
+
+    return jax.tree.map(fetch, tree)
 
 
 def host_fetch(tree: Any) -> Any:

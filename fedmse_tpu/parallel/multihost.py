@@ -141,6 +141,46 @@ def initialize(coordinator_address: Optional[str] = None,
                         e)
 
 
+def allgather_tree_sum(tree):
+    """Sum a small host-numpy pytree ACROSS processes — the merged control
+    plane of the host-sharded tier (DESIGN.md §20): each process computes a
+    partial reduction over the tier rows it owns (elastic incumbent-mean
+    sums, cluster probe sums), and this one collective produces the
+    identical fleet total on every process. Summation order is fixed
+    (process-index order, axis 0 of the allgather stack), so the result is
+    deterministic and uniform — the same property `uniform_decision` gives
+    booleans, extended to partial reductions. Identity single-process: the
+    degenerate shard's partial IS the fleet value, bit-for-bit."""
+    if jax.process_count() == 1:
+        return tree
+    import numpy as np
+    from jax.experimental import multihost_utils
+    stacked = multihost_utils.process_allgather(tree)
+    return jax.tree.map(lambda l: np.asarray(l).sum(axis=0), stacked)
+
+
+def allgather_blocks(local, blocks, process_order):
+    """Reassemble per-process leading-axis blocks into the fleet-width
+    array, identically on every process. `local` is this process's rows
+    (block sizes may differ by one — `parallel.mesh.process_tier_blocks`);
+    `blocks[j]` is the [start, stop) owned by `process_order[j]` (mesh
+    device order). Ragged blocks ride one fixed-width allgather: each
+    process pads its rows to the widest block, and the pad tail is dropped
+    on reassembly. Identity single-process."""
+    import numpy as np
+    local = np.asarray(local)
+    if jax.process_count() == 1:
+        return local
+    from jax.experimental import multihost_utils
+    widest = max(hi - lo for lo, hi in blocks)
+    padded = np.zeros((widest,) + local.shape[1:], local.dtype)
+    padded[: local.shape[0]] = local
+    stacked = np.asarray(multihost_utils.process_allgather(padded))
+    return np.concatenate(
+        [stacked[p][: hi - lo]
+         for p, (lo, hi) in zip(process_order, blocks)], axis=0)
+
+
 def uniform_decision(flag: bool) -> bool:
     """Make a host-side control decision identical on every process.
 

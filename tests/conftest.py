@@ -45,6 +45,32 @@ def rng():
 
 
 @pytest.fixture(scope="session")
+def two_process_outputs(tmp_path_factory):
+    """ONE hardened 2-process worker-pair spawn (mode 'both': federated
+    round, mid-chunk early stop, host-sharded pod tier) serving every
+    two-process assertion in the suite (test_parallel.py multi-host tests,
+    test_podscale.py). Session-scoped and routed through
+    tests/multihost_launcher.py — fresh coordinator port per attempt plus a
+    bounded whole-pair retry — so the 3 in-suite environment flakes
+    documented in PR 11 (port steal between bind-close and coordinator
+    bind; cold-start blowing the fixed timeout under suite load) cannot
+    surface as tier-1 errors. Yields `.outs` (each process's combined
+    stdout+stderr) and `.outdir` (PODSCALE_OUTDIR: pod results + the
+    host-sharded checkpoint for cross-layout restores)."""
+    import collections
+    import os
+
+    from multihost_launcher import launch_worker_pair
+
+    outdir = tmp_path_factory.mktemp("podscale")
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    outs = launch_worker_pair(worker, args=("both",),
+                              extra_env={"PODSCALE_OUTDIR": str(outdir)})
+    Run = collections.namedtuple("TwoProcessRun", ["outs", "outdir"])
+    return Run(outs=outs, outdir=outdir)
+
+
+@pytest.fixture(scope="session")
 def mesh8():
     """The 8-virtual-device CPU `clients` mesh, session-shared.
 

@@ -1,0 +1,107 @@
+"""Hardened launcher for the 2-process multi-controller test workers.
+
+PR 11 documented 3 two-process in-suite ERRORS that pass standalone: the
+worker pair (tests/multihost_worker.py) is spawned mid-suite on a loaded
+1-core box, and the spawn seam is environment-fragile in two ways the
+old inline fixture could not absorb:
+
+  * the free coordinator port is found by bind-then-close, so another
+    process (or a previous worker's lingering socket in TIME_WAIT) can
+    steal it before `jax.distributed.initialize` binds — the pair then
+    dies on a coordinator connect error that no rerun of the test body
+    can fix, because the fixture never re-picked a port;
+  * under suite memory/CPU pressure the two interpreter+jax cold starts
+    (~20 s each standalone) can blow the fixed communicate() timeout.
+
+This module is the one home of the spawn protocol: fresh port PER
+ATTEMPT, scrubbed environment, and a bounded retry that relaunches the
+whole pair. A deterministic assertion failure inside a worker still
+fails — it reproduces on the retry and the final attempt's output is
+raised — so the retry only absorbs spawn-level environment flakes.
+Every multi-process fixture (tests/conftest.py two_process_outputs, the
+pod-scale checks in tests/test_podscale.py) goes through here, so
+tier-1 holds its 0-error bar in one in-suite run.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+# env that must not leak from the parent suite into workers: the workers
+# pick their own platform/device topology, and a pallas pool would make
+# jax probe remote devices during the coordinator handshake
+_SCRUB = ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS", "JAX_PLATFORMS")
+
+
+def free_port() -> int:
+    """A currently-free localhost port (best effort: freed on return)."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def worker_env(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    env = {k: v for k, v in os.environ.items() if k not in _SCRUB}
+    if extra:
+        env.update(extra)
+    return env
+
+
+def launch_worker_pair(script: str, args: Sequence[str] = (),
+                       n_processes: int = 2, timeout: int = 420,
+                       attempts: int = 2,
+                       extra_env: Optional[Dict[str, str]] = None
+                       ) -> List[str]:
+    """Run `script` once per process id against one fresh coordinator port
+    (worker argv: `script <port> <pid> *args`), returning each process's
+    combined stdout+stderr. On timeout or nonzero exit the WHOLE pair is
+    relaunched on a new port, up to `attempts` times; the final failure
+    raises with the last outputs attached."""
+    last = "no attempt ran"
+    for attempt in range(attempts):
+        port = free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, script, str(port), str(pid),
+             *map(str, args)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=worker_env(extra_env)) for pid in range(n_processes)]
+        outs: List[str] = []
+        failed = False
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                for q in procs:
+                    try:
+                        q.communicate(timeout=10)
+                    except Exception:
+                        pass
+                failed = True
+                last = (f"worker pair timed out after {timeout}s "
+                        f"(attempt {attempt + 1}/{attempts})")
+                outs = []
+                break
+            outs.append(out)
+            if p.returncode != 0:
+                failed = True
+        if not failed:
+            return outs
+        if outs:
+            last = "\n--- worker ---\n".join(o[-2000:] for o in outs)
+    raise RuntimeError(
+        f"multihost worker pair failed after {attempts} attempts:\n{last}")
+
+
+def match_all(outs: Sequence[str], ok_pattern: str):
+    """re.search `ok_pattern` in every worker output; assert all matched and
+    return the match objects (shared by every two-process assertion)."""
+    import re
+    results = [re.search(ok_pattern, o) for o in outs]
+    assert all(results), [o[-500:] for o in outs]
+    return results

@@ -20,15 +20,26 @@ MID-chunk — the rewind+replay must produce the per-round path's exact
 state on BOTH processes (the decision is broadcast from process 0,
 parallel/multihost.py::uniform_decision), validating that the fused
 schedule is safe as the multi-controller default.
-mode 'both': 'round' then 'midstop' in one process — the test suite uses
-this so both validations pay the worker-pair spawn (jax import +
-distributed init, ~20 s/process on this 1-core box) only once.
+mode 'both': 'round' then 'midstop' then 'podtier' in one process — the
+test suite uses this so every two-process validation pays the
+worker-pair spawn (jax import + distributed init, ~20 s/process on this
+1-core box) only once.
+mode 'podtier': the host-sharded tiered federation (DESIGN.md §20) over
+the real 2-process runtime — each process tiers only its 6 of 12
+clients, rounds run over the cross-host cohort assembly, and the pod
+writes a host-sharded checkpoint. With PODSCALE_OUTDIR set, results and
+the checkpoint land there for the parent's cross-process / vs-single-
+process assertions (tests/test_podscale.py).
 """
 
 import os
 import sys
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+if __name__ == "__main__":
+    # worker-process only: the parent suite imports this module for the
+    # shared podtier scenario (tests/test_podscale.py) and must keep its
+    # own 8-device flags
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
@@ -101,8 +112,9 @@ def run_midstop(pid: int) -> None:
 def main() -> None:
     port, pid = sys.argv[1], int(sys.argv[2])
     mode = sys.argv[3] if len(sys.argv) > 3 else "round"
-    if mode not in ("round", "midstop", "both"):  # a typo must fail loudly,
-        sys.exit(f"unknown mode {mode!r}")        # not silently run 'round'
+    if mode not in ("round", "midstop", "podtier", "both"):
+        sys.exit(f"unknown mode {mode!r}")  # a typo must fail loudly,
+        # not silently run 'round'
 
     from fedmse_tpu.parallel import initialize_multihost
     initialize_multihost(coordinator_address=f"localhost:{port}",
@@ -113,10 +125,14 @@ def main() -> None:
     if mode == "midstop":
         run_midstop(pid)
         return
+    if mode == "podtier":
+        run_podtier(pid)
+        return
 
     run_round(pid)
     if mode == "both":
         run_midstop(pid)
+        run_podtier(pid)
 
 
 def run_round(pid: int) -> None:
@@ -230,6 +246,73 @@ def run_hostlocal(pid: int, cfg, clients, dev_x, mesh, n_real: int,
     print(f"MULTIHOST_LOCAL_OK pid={pid} local_rows={local_rows} "
           f"global_rows={full_rows} local_bytes={local_bytes} "
           f"quant_err={max_err:.2e}", flush=True)
+
+
+def podtier_config():
+    """The pod-tier scenario, shared with the parent's single-process
+    reference run (tests/test_podscale.py): 12 clients, 2 hosts tiering
+    6 each, full participation so the H=1 and H=2 cohorts cover the
+    same fleet (the vs-single-process AUC bar compares like with
+    like)."""
+    from fedmse_tpu.config import CompatConfig, ExperimentConfig
+
+    dim, n_real = 8, 12
+    # shared_last_client_val (the reference quirk) needs the LAST client's
+    # validation rows on every host — unsupported (by design) when each
+    # host tiers only its own shard, so the pod scenario verifies on each
+    # client's own val rows
+    cfg = ExperimentConfig(dim_features=dim, hidden_neus=6, latent_dim=3,
+                           network_size=n_real, epochs=2, num_rounds=3,
+                           batch_size=4, num_participants=1.0,
+                           state_layout="tiered",
+                           compat=CompatConfig(shared_last_client_val=False))
+    return cfg, dim, n_real
+
+
+def podtier_federation(cfg, dim: int, n_real: int):
+    from fedmse_tpu.data import (build_dev_dataset, stack_clients,
+                                 synthetic_clients)
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    clients = synthetic_clients(n_clients=n_real, dim=dim, n_normal=40,
+                                n_abnormal=16)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(run=0).data_rng)
+    return stack_clients(clients, dev_x, cfg.batch_size)
+
+
+def run_podtier(pid: int) -> None:
+    """Host-sharded tiered federation (DESIGN.md §20) across the REAL
+    2-process runtime: stratified cohort selection, host-local tier
+    gathers assembled into the pod-global cohort slab, the lane-block
+    scatter back into each process's shard, and the pod-sharded
+    checkpoint (save_shard + barrier) every round."""
+    import numpy as np
+
+    from fedmse_tpu.checkpointing.io import CheckpointManager
+    from fedmse_tpu.federation.tiered import run_tiered_combination
+    from fedmse_tpu.parallel import client_mesh
+
+    cfg, dim, n_real = podtier_config()
+    data = podtier_federation(cfg, dim, n_real)
+    mesh = client_mesh()
+    outdir = os.environ.get("PODSCALE_OUTDIR")
+    resume = (CheckpointManager(os.path.join(outdir, "podckpt"))
+              if outdir else None)
+    out = run_tiered_combination(cfg, data, n_real, "hybrid", "mse_avg", 0,
+                                 mesh=mesh, resume=resume)
+    fm = np.asarray(out["final_metrics"])
+    assert fm.shape == (n_real,), fm.shape
+    assert np.all(np.isfinite(fm)), fm
+    if outdir:
+        np.savez(os.path.join(outdir, f"pod_result_{pid}.npz"),
+                 final_metrics=fm,
+                 best_final=np.float64(out["best_final"]),
+                 aggregation_count=np.asarray(out["aggregation_count"]))
+    # both processes must print the identical digest (allgathered
+    # outputs + shared host streams -> identical control plane)
+    print(f"PODTIER_OK pid={pid} best={out['best_final']:.6f} "
+          f"mean={float(np.nanmean(fm)):.6f} "
+          f"agg={out['aggregation_count']}", flush=True)
 
 
 if __name__ == "__main__":

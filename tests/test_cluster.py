@@ -150,6 +150,36 @@ def test_fit_medoids_groups_and_determinism(rng):
     assert len(set(a_all.tolist())) == g
 
 
+def test_fit_sample_caps_medoid_fit(rng):
+    """The pod-scale fit cap (ClusterSpec.fit_sample, the CLARA idiom):
+    with G > sample, medoids fit on a stride subsample and the fleet is
+    assigned by JS-to-medoid — same partition as the dense fit on
+    separated groups; G <= sample stays the exact dense path; the
+    signature only changes when the knob leaves its default (so
+    pre-fit_sample checkpoints keep resuming)."""
+    g = 60
+    means = np.zeros((g, 3), np.float32)
+    means[g // 2:] += 25.0
+    covs = np.tile(0.5 * np.eye(3, dtype=np.float32), (g, 1, 1))
+    means += rng.normal(scale=0.1, size=means.shape).astype(np.float32)
+    dense = fit_assignments(means, covs, k=2)
+    sub = fit_assignments(means, covs, k=2, sample=16)
+    # identical partition up to label permutation
+    agree = (sub.assignment == dense.assignment).mean()
+    assert agree in (0.0, 1.0), agree
+    assert len(set(sub.assignment[: g // 2])) == 1
+    assert sub.assignment[0] != sub.assignment[-1]
+    # sample >= G is the dense path, bitwise
+    same = fit_assignments(means, covs, k=2, sample=g)
+    assert np.array_equal(same.assignment, dense.assignment)
+    assert ClusterSpec().signature() == ClusterSpec(
+        fit_sample=4096).signature()
+    assert ClusterSpec(fit_sample=512).signature() != \
+        ClusterSpec().signature()
+    with pytest.raises(ValueError, match="fit_sample"):
+        ClusterSpec(fit_sample=-1)
+
+
 def test_assignment_padding_invariance():
     """PARITY §8 for clusters: the same fleet padded to a wider client
     axis fits the IDENTICAL assignment — absolute gateway ids, mask-

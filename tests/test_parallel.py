@@ -192,46 +192,10 @@ def test_full_round_on_global_mesh():
     assert np.all(np.isfinite(res.client_metrics))
 
 
-@pytest.fixture(scope="module")
-def two_process_outputs():
-    """Run tests/multihost_worker.py twice (mode 'both') against a localhost
-    coordinator and return both processes' full output. ONE worker-pair spawn
-    (jax import + jax.distributed init is ~20 s/process on this 1-core box)
-    serves every two-process assertion below."""
-    import socket
-    import subprocess
-    import sys
-
-    with socket.socket() as s:  # free localhost port for the coordinator
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
-
-    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS", "JAX_PLATFORMS")}
-    procs = [subprocess.Popen(
-                [sys.executable, worker, str(port), str(pid), "both"],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT, text=True, env=env)
-             for pid in (0, 1)]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(out)
-        assert p.returncode == 0, out[-2000:]
-    return outs
-
-
-def _match_both(outs, ok_pattern):
-    import re
-    results = [re.search(ok_pattern, o) for o in outs]
-    assert all(results), [o[-500:] for o in outs]
-    return results
+# two_process_outputs is the session fixture in conftest.py: ONE hardened
+# worker-pair spawn (tests/multihost_launcher.py — fresh port per attempt,
+# bounded whole-pair retry) serves these tests and test_podscale.py.
+from multihost_launcher import match_all as _match_both  # noqa: E402
 
 
 def test_two_process_federation(two_process_outputs):
@@ -242,7 +206,7 @@ def test_two_process_federation(two_process_outputs):
     make_array_from_process_local_data placement, and host_fetch's
     process_allgather, which single-process tests only exercise in
     degradation."""
-    results = _match_both(two_process_outputs,
+    results = _match_both(two_process_outputs.outs,
                           r"MULTIHOST_OK pid=\d+ (agg=\d+ mean=[\d.]+)")
     # both processes computed the identical global round
     assert results[0].group(1) == results[1].group(1)
@@ -255,7 +219,7 @@ def test_two_process_midchunk_early_stop(two_process_outputs):
     processes, with the stop decision broadcast from process 0
     (parallel/multihost.py::uniform_decision). This is the validation that
     lets fused_schedule default to True with no multi-process fallback."""
-    results = _match_both(two_process_outputs,
+    results = _match_both(two_process_outputs.outs,
                           r"MIDSTOP_OK pid=\d+ (rounds=\d+ mean=[\d.]+)")
     # the rewound+replayed schedule state agrees across processes
     assert results[0].group(1) == results[1].group(1)
@@ -271,7 +235,7 @@ def test_two_process_hostlocal_and_quantized(two_process_outputs):
     documented error bound. Both assertions run inside the worker —
     this test checks they fired on both processes and agreed."""
     results = _match_both(
-        two_process_outputs,
+        two_process_outputs.outs,
         r"MULTIHOST_LOCAL_OK pid=\d+ (local_rows=(\d+) global_rows=(\d+) "
         r"local_bytes=\d+ quant_err=[\d.e+-]+)")
     assert results[0].group(1) == results[1].group(1)
