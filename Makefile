@@ -10,7 +10,7 @@ LIB := fedmse_tpu/native/libfedmse_io.so
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
         serve-bench chaos-sweep churn-sweep pipeline-bench precision-bench \
         shard-bench knn-bench cohort-bench flywheel-sweep net-bench \
-        cluster-sweep podscale-bench tpu-check
+        cluster-sweep podscale-bench redteam-sweep tpu-check
 
 native: $(LIB)
 
@@ -138,6 +138,17 @@ cluster-sweep:
 podscale-bench:
 	env -u PALLAS_AXON_POOL_IPS python bench.py --podscale-bench \
 		--out BENCH_PODSCALE_r16_cpu.json
+
+# redteam attack-vs-defense grids (fedmse_tpu/redteam/, DESIGN.md §21):
+# cluster-assignment mimicry + insider poison vs hysteresis, flywheel
+# slow-drift self-poisoning vs reservoir admission hardening, sybil
+# join-blitz election capture vs the tenure gate, and the verification
+# recovery-waiver abuse probe vs config.recovery_budget — each with the
+# defenses-off bitwise pin and bounded clean cost (writes
+# REDTEAM_r17.json; hermetic CPU like the tests)
+redteam-sweep:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python redteam_sweep.py --out REDTEAM_r17.json
 
 tpu-check:
 	python tpu_check.py

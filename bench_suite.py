@@ -58,6 +58,11 @@ repo-grown axes):
      half of the fleet, rounds run over cross-host cohort assembly, and
      the per-process result digests must agree (full protocol:
      make podscale-bench -> BENCH_PODSCALE_r16_cpu.json)
+ 19. redteam adversary/defense guard (fedmse_tpu/redteam/, DESIGN.md
+     §21): the defenses-off bitwise pin, mimicry capture at blend 0.8
+     (plain refit flips, hysteresis holds) and the reservoir
+     margin-floor admission bound (full protocol:
+     make redteam-sweep -> REDTEAM_r17.json)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -485,6 +490,24 @@ def scen_podscale():
             "acceptance_met": bool(ok)}
 
 
+def scen_redteam():
+    """Scenario 19: redteam adversary/defense guard (ISSUE 17,
+    fedmse_tpu/redteam/, DESIGN.md §21) — the reduced cells: the
+    defenses-off bitwise pin (a null RedteamSpec must cost literally
+    nothing), one mimicry capture point (blend 0.8: plain refit flips
+    the forgers into the victim cluster, hysteresis 0.5 holds) and the
+    reservoir margin-floor admission bound. The committed standalone
+    artifact (make redteam-sweep -> REDTEAM_r17.json) carries the full
+    blend grids, the slow-drift loop, the sybil join-blitz and the
+    recovery-waiver abuse probe."""
+    from redteam_sweep import quick_cell
+
+    row = quick_cell()
+    return {"scenario": "redteam guard: defenses-off bitwise pin, "
+                        "mimicry blend 0.8 vs hysteresis, margin-floor "
+                        "admission", **row}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -507,9 +530,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-18")
-        if not 1 <= only <= 18:
-            sys.exit(f"--only expects a scenario number 1-18, got {only}")
+            sys.exit("--only expects a scenario number 1-19")
+        if not 1 <= only <= 19:
+            sys.exit(f"--only expects a scenario number 1-19, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -612,6 +635,9 @@ def main():
 
     if only in (None, 18):
         emit(scen_podscale())
+
+    if only in (None, 19):
+        emit(scen_redteam())
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

@@ -11,25 +11,35 @@ construction."""
 from fedmse_tpu.cluster.assign import (ClusterAssignment,
                                        assignment_from_extra,
                                        cluster_gaussians, fit_assignments,
-                                       fit_from_states, fit_medoids,
+                                       fit_assignments_gmm, fit_from_states,
+                                       fit_gateway_gmms, fit_medoids,
+                                       gateway_latent_stats,
                                        incumbent_mean_params,
-                                       make_latent_stats_fn, nearest_cluster)
+                                       make_latent_rows_fn,
+                                       make_latent_stats_fn,
+                                       moment_match_gmms, nearest_cluster,
+                                       refit_with_hysteresis)
 from fedmse_tpu.cluster.merge import (cluster_models, cluster_one_hot,
                                       clustered_incumbent_means,
                                       clustered_tree_mean,
                                       gather_cluster_rows,
                                       make_clustered_aggregate_fn,
                                       normalize_sheet, personalized_broadcast)
-from fedmse_tpu.cluster.similarity import (gaussian_js, gaussian_kl,
-                                           js_to_references, pairwise_js)
+from fedmse_tpu.cluster.similarity import (gaussian_js, gaussian_kl, gmm_js,
+                                           gmm_kl, js_to_references,
+                                           pairwise_gmm_js, pairwise_js)
 from fedmse_tpu.cluster.spec import ClusterSpec
 
 __all__ = [
     "ClusterAssignment", "ClusterSpec", "assignment_from_extra",
     "cluster_gaussians", "cluster_models", "cluster_one_hot",
     "clustered_incumbent_means", "clustered_tree_mean", "fit_assignments",
-    "fit_from_states", "fit_medoids", "gather_cluster_rows", "gaussian_js",
-    "gaussian_kl", "incumbent_mean_params", "js_to_references",
-    "make_clustered_aggregate_fn", "make_latent_stats_fn", "nearest_cluster",
-    "normalize_sheet", "pairwise_js", "personalized_broadcast",
+    "fit_assignments_gmm", "fit_from_states", "fit_gateway_gmms",
+    "fit_medoids", "gather_cluster_rows", "gateway_latent_stats",
+    "gaussian_js", "gaussian_kl", "gmm_js", "gmm_kl",
+    "incumbent_mean_params", "js_to_references",
+    "make_clustered_aggregate_fn", "make_latent_rows_fn",
+    "make_latent_stats_fn", "moment_match_gmms", "nearest_cluster",
+    "normalize_sheet", "pairwise_gmm_js", "pairwise_js",
+    "personalized_broadcast", "refit_with_hysteresis",
 ]

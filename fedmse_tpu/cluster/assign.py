@@ -41,7 +41,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fedmse_tpu.cluster.similarity import js_to_references, pairwise_js
+from fedmse_tpu.cluster.similarity import (js_to_references, pairwise_gmm_js,
+                                           pairwise_js)
 from fedmse_tpu.cluster.spec import ClusterSpec
 from fedmse_tpu.federation.state import client_mean_weights
 
@@ -103,6 +104,131 @@ def make_latent_stats_fn(model):
         return means, covs
 
     return stats
+
+
+def make_latent_rows_fn(model):
+    """Build the jitted per-gateway latent-ROWS program (the 'gmm'
+    metric's input: the EM fit needs the rows themselves, not just their
+    first two moments):
+
+    fn(probe_params, train_x) -> latents [G, S, L] f32
+
+    `train_x` is batch-major [G, NB, B, D] or flat [G, S, D]; the row
+    mask travels host-side (fit_gateway_gmms applies it)."""
+
+    @jax.jit
+    def rows(probe_params, train_x):
+        if train_x.ndim == 4:
+            train_x = train_x.reshape(train_x.shape[0], -1,
+                                      train_x.shape[-1])
+
+        def one(x):
+            latent, _ = model.apply({"params": probe_params}, x)
+            return latent.astype(jnp.float32)
+
+        return jax.vmap(one)(train_x)
+
+    return rows
+
+
+def _fit_gmm_rows(x: np.ndarray, components: int, iters: int
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Deterministic fixed-iteration EM over one gateway's latent rows
+    [S, L] (f64). No RNG stream: init partitions the rows into quantile
+    blocks along the principal axis of their covariance (eigh and stable
+    argsort are deterministic), then runs exactly `iters` EM steps —
+    a pure function of the rows, like `fit_medoids` is of its matrix.
+    Returns (w [M], mus [M, L], covs [M, L, L]); when the gateway has
+    fewer rows than components, surplus components pad with exact-zero
+    weight + identity covariance (dropped by the variational KL's
+    weighted logsumexp and by moment matching alike)."""
+    x = np.asarray(x, np.float64)
+    s, l = x.shape
+    mc = max(1, min(components, s))
+    mean = x.mean(axis=0)
+    d = x - mean
+    cov = d.T @ d / s + COV_EPS * np.eye(l)
+    # principal-axis quantile init (module-docstring determinism rule)
+    _, evecs = np.linalg.eigh(cov)
+    order = np.argsort(d @ evecs[:, -1], kind="stable")
+    w = np.zeros(components)
+    mus = np.zeros((components, l))
+    covs = np.tile(np.eye(l), (components, 1, 1))
+    for c, block in enumerate(np.array_split(order, mc)):
+        xb = x[block]
+        w[c] = len(block) / s
+        mus[c] = xb.mean(axis=0)
+        db = xb - mus[c]
+        covs[c] = db.T @ db / max(1, len(block)) + COV_EPS * np.eye(l)
+    for _ in range(iters):
+        # E-step: responsibilities from exact component log-densities
+        log_r = np.full((s, components), -np.inf)
+        for c in range(mc):
+            if w[c] <= 0.0:
+                continue
+            sign, logdet = np.linalg.slogdet(covs[c])
+            del sign  # ridge keeps covs[c] PD
+            dc = x - mus[c]
+            maha = np.einsum("sl,lk,sk->s", dc, np.linalg.inv(covs[c]), dc)
+            log_r[:, c] = (np.log(w[c]) - 0.5 *
+                           (maha + logdet + l * np.log(2.0 * np.pi)))
+        log_r -= log_r.max(axis=1, keepdims=True)
+        r = np.exp(log_r)
+        r /= r.sum(axis=1, keepdims=True)
+        # M-step (ridge keeps thin components invertible; an emptied
+        # component keeps zero weight and drops out of the E-step)
+        nk = r.sum(axis=0)
+        for c in range(mc):
+            if nk[c] <= 1e-12:
+                w[c] = 0.0
+                continue
+            w[c] = nk[c] / s
+            mus[c] = r[:, c] @ x / nk[c]
+            dc = x - mus[c]
+            covs[c] = ((r[:, c, None] * dc).T @ dc / nk[c]
+                       + COV_EPS * np.eye(l))
+    return w, mus, covs
+
+
+def fit_gateway_gmms(latents: np.ndarray, row_mask: Optional[np.ndarray],
+                     components: int = 2, iters: int = 8
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-gateway deterministic GMM fit over latents [G, S, L] (host
+    f64, fit-time analytics): returns (weights [G, M], means [G, M, L],
+    covs [G, M, L, L]). `row_mask` [G, S] drops padded rows before the
+    fit (host-side mask application — the masked-moment idiom of
+    make_latent_stats_fn moved to row selection, which EM needs)."""
+    latents = np.asarray(latents, np.float64)
+    g = latents.shape[0]
+    l = latents.shape[-1]
+    w = np.zeros((g, components))
+    mus = np.zeros((g, components, l))
+    covs = np.tile(np.eye(l), (g, components, 1, 1))
+    for i in range(g):
+        rows = latents[i]
+        if row_mask is not None:
+            rows = rows[np.asarray(row_mask[i]) > 0]
+        if not len(rows):
+            rows = np.zeros((1, l))  # degenerate gateway: unit Gaussian
+        w[i], mus[i], covs[i] = _fit_gmm_rows(rows, components, iters)
+    return w, mus, covs
+
+
+def moment_match_gmms(weights: np.ndarray, means: np.ndarray,
+                      covs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Collapse per-gateway GMMs to single moment-matched Gaussians
+    (mixture mean; within-component covariance + between-component mean
+    spread — the `cluster_gaussians` law applied at mixture level), so a
+    'gmm'-fitted ClusterAssignment carries the same [G, L]/[G, L, L]
+    stats every downstream consumer already reads."""
+    weights = np.asarray(weights, np.float64)
+    means = np.asarray(means, np.float64)
+    covs = np.asarray(covs, np.float64)
+    mm_mean = np.einsum("gm,gml->gl", weights, means)
+    spread = means - mm_mean[:, None, :]
+    mm_cov = (np.einsum("gm,gmlk->glk", weights, covs)
+              + np.einsum("gm,gml,gmk->glk", weights, spread, spread))
+    return mm_mean.astype(np.float32), mm_cov.astype(np.float32)
 
 
 def fit_medoids(js: np.ndarray, k: int, max_iter: int = 32
@@ -272,21 +398,113 @@ def fit_assignments(means, covs, k: int, fitted_round: int = 0,
                                          fitted_round=fitted_round)
 
 
-def fit_from_states(model, spec: ClusterSpec, stacked_params,
-                    train_x, train_m, client_mask, n_real: int,
-                    fitted_round: int = 0,
-                    stats_fn=None) -> ClusterAssignment:
-    """The engines' one-call fit: incumbent-mean probe -> latent stats ->
-    JS k-medoids. `stats_fn` (make_latent_stats_fn(model)) may be passed
-    in so repeated refits reuse one compiled program."""
+def fit_assignments_gmm(weights, mus, covs, k: int, fitted_round: int = 0,
+                        max_iter: int = 32, gmm_iters: int = 8,
+                        components: int = 2,
+                        row_mask=None) -> ClusterAssignment:
+    """Variational mixture-JS k-medoids over per-gateway latent GMMs (the
+    'gmm' metric's `fit_assignments`). Accepts either fitted GMM params
+    (weights [G, M], mus [G, M, L], covs [G, M, L, L]) or raw latents
+    (weights=None, mus=latents [G, S, L], covs=None + `row_mask`). The
+    carried assignment stores MOMENT-MATCHED single Gaussians, so the
+    pooled cluster Gaussians, nearest-cluster joins and consistency
+    analytics are unchanged in shape and law."""
+    if weights is None:
+        weights, mus, covs = fit_gateway_gmms(mus, row_mask,
+                                              components=components,
+                                              iters=gmm_iters)
+    js = np.asarray(pairwise_gmm_js(jnp.asarray(weights, jnp.float32),
+                                    jnp.asarray(mus, jnp.float32),
+                                    jnp.asarray(covs, jnp.float32)))
+    assignment, _ = fit_medoids(js, k, max_iter=max_iter)
+    mm_means, mm_covs = moment_match_gmms(weights, mus, covs)
+    return ClusterAssignment.from_arrays(k, assignment, mm_means, mm_covs,
+                                         fitted_round=fitted_round)
+
+
+def refit_with_hysteresis(means, covs, prev_assignment: np.ndarray, k: int,
+                          hysteresis: float, fitted_round: int = 0
+                          ) -> ClusterAssignment:
+    """Label-stable cadence refit (ClusterSpec.hysteresis): pooled
+    Gaussians are rebuilt from the PREVIOUS assignment's labels over the
+    FRESH per-gateway stats (no medoid re-fit, so cluster labels cannot
+    permute between refits), and gateway g moves to its best cluster only
+    when the improvement clears the relative margin
+
+        js[g, best] < (1 - hysteresis) * js[g, prev].
+
+    The assignment-poisoning defense of DESIGN.md §21: an adversary
+    forging borderline latent statistics can drag victims back and forth
+    across clusters on every refit (each flip re-tenants the victim's
+    cluster model); under hysteresis a move must be WON by a margin, so
+    borderline forgeries leave the fleet where it is while genuine
+    distribution shift (which clears any sane margin) still moves."""
+    means = np.asarray(means, np.float32)
+    covs = np.asarray(covs, np.float32)
+    prev = np.asarray(prev_assignment, np.int32)
+    cl_means, cl_covs, counts = cluster_gaussians(means, covs, prev, k)
+    js = np.array(js_to_references(
+        jnp.asarray(means), jnp.asarray(covs),
+        jnp.asarray(cl_means, jnp.float32), jnp.asarray(cl_covs,
+                                                        jnp.float32)))
+    js[:, np.asarray(counts) == 0] = np.inf  # empty labels take nobody
+    g = np.arange(len(prev))
+    best = np.argmin(js, axis=1)
+    move = js[g, best] < (1.0 - hysteresis) * js[g, prev]
+    new = np.where(move, best, prev).astype(np.int32)
+    return ClusterAssignment.from_arrays(k, new, means, covs,
+                                         fitted_round=fitted_round)
+
+
+def gateway_latent_stats(model, spec: ClusterSpec, stacked_params,
+                         train_x, train_m, client_mask, n_real: int,
+                         stats_fn=None):
+    """Per-real-gateway latent statistics under `spec.metric`: returns
+    (means [G, L], covs [G, L, L], gmm) where gmm is None for 'js' and
+    the fitted (weights, mus, covs) mixture params for 'gmm' (means/covs
+    are then the moment-matched collapse). `stats_fn` is the cached
+    compiled program of the matching maker (make_latent_stats_fn /
+    make_latent_rows_fn)."""
     probe = incumbent_mean_params(stacked_params, jnp.asarray(client_mask))
+    if spec.metric == "gmm":
+        if stats_fn is None:
+            stats_fn = make_latent_rows_fn(model)
+        latents = np.asarray(stats_fn(probe, jnp.asarray(train_x)))[:n_real]
+        mask = None if train_m is None else \
+            np.asarray(train_m).reshape(np.asarray(train_m).shape[0],
+                                        -1)[:n_real]
+        gmm = fit_gateway_gmms(latents, mask,
+                               components=spec.gmm_components)
+        means, covs = moment_match_gmms(*gmm)
+        return means, covs, gmm
     if stats_fn is None:
         stats_fn = make_latent_stats_fn(model)
     means, covs = stats_fn(probe, jnp.asarray(train_x),
                            None if train_m is None else jnp.asarray(train_m))
-    return fit_assignments(np.asarray(means)[:n_real],
-                           np.asarray(covs)[:n_real], spec.k,
-                           fitted_round=fitted_round,
+    return np.asarray(means)[:n_real], np.asarray(covs)[:n_real], None
+
+
+def fit_from_states(model, spec: ClusterSpec, stacked_params,
+                    train_x, train_m, client_mask, n_real: int,
+                    fitted_round: int = 0, stats_fn=None,
+                    prev_assignment: Optional[np.ndarray] = None
+                    ) -> ClusterAssignment:
+    """The engines' one-call fit: incumbent-mean probe -> latent stats
+    (per `spec.metric`) -> k-medoids; with `prev_assignment` set and
+    `spec.hysteresis` > 0, the label-stable hysteresis refit instead.
+    `stats_fn` (make_latent_stats_fn / make_latent_rows_fn, matching the
+    metric) may be passed in so repeated refits reuse one compiled
+    program."""
+    means, covs, gmm = gateway_latent_stats(
+        model, spec, stacked_params, train_x, train_m, client_mask, n_real,
+        stats_fn=stats_fn)
+    if prev_assignment is not None and spec.hysteresis > 0.0:
+        return refit_with_hysteresis(means, covs, prev_assignment, spec.k,
+                                     spec.hysteresis,
+                                     fitted_round=fitted_round)
+    if gmm is not None:
+        return fit_assignments_gmm(*gmm, spec.k, fitted_round=fitted_round)
+    return fit_assignments(means, covs, spec.k, fitted_round=fitted_round,
                            sample=spec.fit_sample)
 
 

@@ -66,6 +66,55 @@ def pairwise_js(means: jax.Array, covs: jax.Array) -> jax.Array:
     return jax.vmap(one_vs_all)(means, covs)
 
 
+def gmm_kl(p_w: jax.Array, p_means: jax.Array, p_covs: jax.Array,
+           q_w: jax.Array, q_means: jax.Array, q_covs: jax.Array) -> jax.Array:
+    """Variational upper-bound KL between Gaussian mixtures (Hershey &
+    Olsen 2007, eq. 20) — the jax port of
+    utils/similarity.gmm_kl_variational (the f64 host oracle; parity is
+    pinned at f32 tolerance like the Gaussian path). Component KLs are
+    the closed-form `gaussian_kl` above; the match-through is a
+    weight-weighted logsumexp (`b=` carries the weights, so exact-zero
+    padding components drop out instead of poisoning a log)."""
+    from jax.scipy.special import logsumexp
+
+    def cross_kl(mu_a, cov_a, mus, covs):
+        return jax.vmap(lambda m2, c2: gaussian_kl(mu_a, cov_a, m2, c2))(
+            mus, covs)
+
+    kl_ff = jax.vmap(lambda m, c: cross_kl(m, c, p_means, p_covs))(
+        p_means, p_covs)                        # [A, A]
+    kl_fg = jax.vmap(lambda m, c: cross_kl(m, c, q_means, q_covs))(
+        p_means, p_covs)                        # [A, B]
+    num = logsumexp(-kl_ff, b=p_w[None, :], axis=1)
+    den = logsumexp(-kl_fg, b=q_w[None, :], axis=1)
+    return jnp.sum(p_w * (num - den))
+
+
+def gmm_js(p_w: jax.Array, p_means: jax.Array, p_covs: jax.Array,
+           q_w: jax.Array, q_means: jax.Array, q_covs: jax.Array) -> jax.Array:
+    """Mixture JS via the half-mixture trick (the mixture 0.5f + 0.5g is
+    itself a GMM: concatenated components at half weight) — the 'gmm'
+    assignment metric's pairwise kernel (ClusterSpec.metric)."""
+    m_w = jnp.concatenate([0.5 * p_w, 0.5 * q_w])
+    m_means = jnp.concatenate([p_means, q_means])
+    m_covs = jnp.concatenate([p_covs, q_covs])
+    return 0.5 * (gmm_kl(p_w, p_means, p_covs, m_w, m_means, m_covs)
+                  + gmm_kl(q_w, q_means, q_covs, m_w, m_means, m_covs))
+
+
+@jax.jit
+def pairwise_gmm_js(weights: jax.Array, means: jax.Array,
+                    covs: jax.Array) -> jax.Array:
+    """[G, G] variational mixture-JS matrix over G gateways' latent GMMs
+    (weights [G, M], means [G, M, L], covs [G, M, L, L]) — the 'gmm'
+    counterpart of `pairwise_js`, one dispatch, symmetrized downstream
+    by the same fitter."""
+    def one_vs_all(w, m, c):
+        return jax.vmap(lambda w2, m2, c2: gmm_js(w, m, c, w2, m2, c2))(
+            weights, means, covs)
+    return jax.vmap(one_vs_all)(weights, means, covs)
+
+
 @jax.jit
 def js_to_references(means: jax.Array, covs: jax.Array,
                      ref_means: jax.Array, ref_covs: jax.Array) -> jax.Array:

@@ -25,13 +25,32 @@ AUC collapses to 0.17). The spec declares how the federation is split:
   * `metric`        — the assignment similarity. 'js' (Gaussian
                       Jensen-Shannon over per-gateway latent statistics,
                       cluster/similarity.py — the jax port of
-                      utils/similarity.py, parity-pinned) is the one
-                      supported metric; `similarity_score`'s KDE path is
-                      deliberately NOT an assignment metric — PARITY.md
-                      §9 records why (per-sample KDE cost, bandwidth
-                      instability on thin shards, and it measures the
-                      wrong thing: score-distribution overlap of a
-                      fitted KDE, not traffic-distribution similarity).
+                      utils/similarity.py, parity-pinned) is the
+                      default; 'gmm' summarizes each gateway's latents
+                      as a `gmm_components`-component Gaussian mixture
+                      (deterministic fixed-iteration EM) compared by
+                      variational mixture JS — multimodal gateways
+                      (e.g. a NAT'd slot fronting two device types)
+                      stop collapsing to one blurred Gaussian. The
+                      carried ClusterAssignment stays moment-matched
+                      single Gaussians, so every downstream consumer
+                      (nearest-cluster joins, consistency, checkpoints)
+                      is shape-unchanged. `similarity_score`'s KDE path
+                      is deliberately NOT an assignment metric —
+                      PARITY.md §9 records why (per-sample KDE cost,
+                      bandwidth instability on thin shards, and it
+                      measures the wrong thing: score-distribution
+                      overlap of a fitted KDE, not traffic-distribution
+                      similarity).
+  * `hysteresis`    — cadence-refit stickiness in [0, 1): a re-fit moves
+                      gateway g off its previous cluster only when the
+                      best cluster's JS beats the previous cluster's by
+                      the relative margin (js_best < (1-h)·js_prev).
+                      The redteam defense against assignment-poisoning
+                      flip-flap (an adversary forging borderline latent
+                      statistics to drag victims across clusters every
+                      refit — DESIGN.md §21); 0 keeps the exact
+                      refit-from-scratch behavior.
 
 Like ChaosSpec/ElasticSpec, validation is eager (a bad K must fail at
 construction, not as a silent mis-shaped one-hot under jit) and
@@ -55,6 +74,11 @@ class ClusterSpec:
     personalize: bool = False
     refit_every: int = 0
     metric: str = "js"
+    # assignment-move hysteresis on cadence refits (module docstring);
+    # 0.0 = refit from scratch (the exact pre-hysteresis behavior)
+    hysteresis: float = 0.0
+    # mixture size of the 'gmm' metric's per-gateway latent summary
+    gmm_components: int = 2
     shared_modules: Tuple[str, ...] = ("encoder",)
     # medoid-fit scale cap (the CLARA idiom): fleets larger than this fit
     # medoids on a deterministic stride subsample and assign everyone by
@@ -75,12 +99,21 @@ class ClusterSpec:
             raise ValueError(
                 f"refit_every must be >= 0 (0 = fit once), got "
                 f"{self.refit_every}")
-        if self.metric != "js":
+        if self.metric not in ("js", "gmm"):
             raise ValueError(
                 f"unknown assignment metric {self.metric!r}: 'js' (Gaussian "
-                "Jensen-Shannon over per-gateway latent statistics) is the "
-                "supported metric; the reference's KDE similarity_score is "
-                "deliberately not an assignment metric — PARITY.md §9")
+                "Jensen-Shannon over per-gateway latent statistics) and "
+                "'gmm' (variational mixture JS over per-gateway latent "
+                "GMMs) are the supported metrics; the reference's KDE "
+                "similarity_score is deliberately not an assignment metric "
+                "— PARITY.md §9")
+        if not 0.0 <= self.hysteresis < 1.0:
+            raise ValueError(
+                f"hysteresis must be in [0, 1) (0 = refit from scratch, "
+                f"-> 1 = never move), got {self.hysteresis}")
+        if self.gmm_components < 1:
+            raise ValueError(
+                f"gmm_components must be >= 1, got {self.gmm_components}")
         if self.personalize and not self.shared_modules:
             raise ValueError(
                 "personalize=True needs at least one shared module "
@@ -103,4 +136,8 @@ class ClusterSpec:
                f"m{self.metric}s{shared}")
         if self.fit_sample != 4096:  # default stays compatible with
             sig += f"f{self.fit_sample}"  # ... pre-fit_sample checkpoints
+        if self.hysteresis != 0.0:  # same pre-existing-checkpoint rule
+            sig += f"h{self.hysteresis}"
+        if self.gmm_components != 2:  # the metric is already in `m...`
+            sig += f"c{self.gmm_components}"
         return sig

@@ -189,6 +189,15 @@ class ExperimentConfig:
     # (federation/verification.py make_verify_fn docstring).
     hardened_verification: bool = False
 
+    # Cumulative ceiling on the hardened verifier's recovery waiver: total
+    # waived Frobenius movement (beyond verification_threshold) a single
+    # client will ever accept via the waiver across the run. None keeps the
+    # exact pre-budget accept rule; only meaningful with
+    # hardened_verification=True. Closes the shared-tensor waiver
+    # gameability documented in the make_verify_fn CAVEAT — measured in
+    # REDTEAM_r17.json (DESIGN.md §21).
+    recovery_budget: Optional[float] = None
+
     # Runs / seeds (src/main.py:43, 51, 73-78, 115-117)
     num_runs: int = 1
     data_seed: int = 1234

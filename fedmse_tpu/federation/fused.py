@@ -73,7 +73,10 @@ def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
                      sel_mask: jax.Array, agg_count: jax.Array,
                      vote_x: jax.Array, vote_m: jax.Array, rng: jax.Array,
                      max_threshold: int,
-                     cluster_in: Optional[jax.Array] = None
+                     cluster_in: Optional[jax.Array] = None,
+                     vote_ok: Optional[jax.Array] = None,
+                     adv: Optional[jax.Array] = None,
+                     lie_votes: bool = False
                      ) -> Tuple[jax.Array, jax.Array]:
     """First-voter-wins election entirely on device.
 
@@ -87,6 +90,19 @@ def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
     existing no-candidate fallthrough). None = fleet-wide candidacy
     (the single-global program, trace-identical to the pre-cluster
     election).
+
+    `vote_ok` ([N] f32 — fedmse_tpu/redteam/, the min-tenure defense)
+    gates BOTH sides of the election: an ineligible slot is no candidate
+    (cannot be elected) and casts no vote (its turn passes, exactly like
+    a chaos-dropped voter). `adv` + `lie_votes=True` compile the sybil
+    COLLUSION rule: an adversarial voter deviates from honest
+    score-ranking and picks the earliest-selected adversarial candidate
+    in its candidacy scope when one exists (falling back to the honest
+    pick when none does — a detectable abstention would burn the
+    coalition). The gate is applied BEFORE the collusion pick, so a
+    tenure-gated sybil cannot be elected even by an accomplice. All
+    three default to the None/False trace — byte-identical to the
+    pre-redteam election.
     """
     n = sel_mask.shape[0]
     n_sel = sel_indices.shape[0]
@@ -111,17 +127,34 @@ def _elect_on_device(scores_fn: Callable, params: Any, sel_indices: jax.Array,
             # clustered federation: a voter only ranks peers of its OWN
             # cluster — voting scopes to the voter's cluster (DESIGN §19)
             cand = cand & (cluster_in == cluster_in[voter])
+        if vote_ok is not None:
+            # min-tenure gate (redteam defense): an ineligible slot is no
+            # candidate — applied before the collusion pick below so a
+            # gated sybil cannot be elected even by an accomplice
+            cand = cand & (vote_ok > 0)
         # a voter masked out of the (effective) cohort casts no vote: under
         # chaos `sel_mask` is selected ∧ available ∧ ¬straggler, and a
         # dropped-out voter's turn passes to the next selected client
         # (chaos-free, every sel_indices entry is in the mask — no-op)
         found = jnp.any(cand) & (sel_mask[voter] > 0)
+        if vote_ok is not None:
+            # ...and an ineligible voter casts none either: its turn
+            # passes to the next selected client, like a chaos dropout
+            found = found & (vote_ok[voter] > 0)
         # NaN scores (diverged training) rank worst; if EVERY candidate is
         # NaN the earliest selected candidate wins — the pick is always a
         # genuine candidate
         masked = jnp.where(cand & ~jnp.isnan(scores), scores, jnp.inf)
         tie = cand & (masked == jnp.min(masked))  # lexicographic (score, pos)
         pick = jnp.argmin(jnp.where(tie, sel_pos, jnp.int32(n_sel + 1)))
+        if lie_votes and adv is not None:
+            # sybil collusion: an adversarial voter picks the earliest-
+            # selected adversarial candidate in scope when one exists
+            acc = cand & (adv > 0)
+            acc_pick = jnp.argmin(jnp.where(acc, sel_pos,
+                                            jnp.int32(n_sel + 1)))
+            lie = (adv[voter] > 0) & jnp.any(acc)
+            pick = jnp.where(lie, acc_pick, pick)
         agg = jnp.where(found, pick.astype(jnp.int32), jnp.int32(-1))
         kept = jnp.where(found, scores, kept)
         return i + 1, agg, kept
@@ -141,7 +174,8 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                     divergence_fn: Optional[Callable] = None,
                     cluster_k: int = 1,
                     personalize: bool = False,
-                    shared_modules: Sequence[str] = ("encoder",)) -> Callable:
+                    shared_modules: Sequence[str] = ("encoder",),
+                    redteam_fns=None) -> Callable:
     """Build the traceable round body (jit-wrapped by make_fused_round,
     scanned directly by make_fused_rounds_scan):
 
@@ -233,6 +267,22 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
     (parallel/collectives.py::make_shardmap_divergence) when a non-einsum
     aggregation backend is selected on a sharded mesh (DESIGN.md §12).
 
+    `redteam_fns` (redteam/adversary.py RedteamFns) adds a trailing
+    `redteam_in` argument (a single-round RedteamMasks slice —
+    redteam/masks.py) and compiles the coalition semantics into the
+    program (DESIGN.md §21):
+      * adversarial slots in the effective cohort submit POISONED updates
+        (update_fn, applied to their trained params rows before the merge
+        — the insider that must get past verification from inside);
+      * when the elected aggregator is adversarial, the merged tree it
+        coordinates is tampered (merge_fn), surgically scoped to the
+        victim cluster's row under clustering;
+      * `gate_votes` compiles the min-tenure election gate and
+        `lie_votes` the sybil collusion pick (_elect_on_device).
+    `redteam_fns=None` traces NO hook — bit-identical to the pre-redteam
+    program, the same by-construction identity as the chaos/elastic/
+    cluster axes (tests/test_redteam.py pins it).
+
     WIDTH-POLYMORPHISM CONTRACT (DESIGN.md §16): nothing in this body
     depends on N being the full fleet — every shape derives from the
     arguments' leading axis. The tiered layout (federation/tiered.py)
@@ -251,10 +301,11 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
     # bit-identity lowering is cluster_k <= 1 AND personalize=False
     # (ClusterSpec.is_null).
     clustered = cluster_k > 1 or personalize
+    redteam = redteam_fns is not None
 
     def round_body(states: ClientStates, data, ver_x, ver_m, sel_indices,
                    sel_mask, agg_count, rng, round_index, chaos_in=None,
-                   elastic_in=None, cluster_in=None):
+                   elastic_in=None, cluster_in=None, redteam_in=None):
         n_pad = data.num_clients_padded
         client_ids = jnp.arange(n_pad)
         member_b = None
@@ -302,7 +353,8 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                 hist_perf=jnp.where(joined_b, jnp.float32(0),
                                     states.hist_perf),
                 hist_seen=jnp.where(joined_b, False, states.hist_seen),
-                rejected=jnp.where(joined_b, jnp.int32(0), states.rejected))
+                rejected=jnp.where(joined_b, jnp.int32(0), states.rejected),
+                waived=jnp.where(joined_b, jnp.float32(0), states.waived))
         if chaos:
             eff_mask = sel_mask * chaos_in.available * \
                 (1.0 - chaos_in.straggler)
@@ -329,10 +381,20 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                                             states.opt_state)
             min_valid = jnp.where(lost, jnp.nan, min_valid)
             tracking = jnp.where(lost[:, None, None], jnp.nan, tracking)
+        if redteam and redteam_fns.update_fn is not None:
+            # insider poisoning (redteam/adversary.py): adversarial slots
+            # in the effective cohort submit poisoned updates — applied to
+            # their trained rows so the poison arrives merge-weighted like
+            # any honest update (fold constant 0x52454454 "REDT": an index
+            # the voter loop, crash re-election and poison_fn never reach)
+            params = redteam_fns.update_fn(
+                params, redteam_in.adv * eff_mask, round_index,
+                jax.random.fold_in(rng, 0x52454454))
         states = ClientStates(
             params=params, opt_state=opt_state, prev_global=states.prev_global,
             hist_params=states.hist_params, hist_perf=states.hist_perf,
-            hist_seen=states.hist_seen, rejected=states.rejected)
+            hist_seen=states.hist_seen, rejected=states.rejected,
+            waived=states.waived)
 
         # ---- election (src/main.py:282-288): voting data is the FIRST
         # selected client's valid split (src/main.py:285) — under chaos or
@@ -344,10 +406,17 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
             vote_owner = sel_indices[0]
         vote_x = data.valid_x[vote_owner]
         vote_m = data.valid_m[vote_owner]
+        # redteam election inputs (None/False when off — trace-identical):
+        rt_vote_ok = redteam_in.vote_ok \
+            if (redteam and redteam_fns.gate_votes) else None
+        rt_adv = redteam_in.adv \
+            if (redteam and redteam_fns.lie_votes) else None
+        rt_lie = bool(redteam and redteam_fns.lie_votes)
         aggregator, scores = _elect_on_device(
             scores_fn, states.params, sel_indices, eff_mask, agg_count,
             vote_x, vote_m, rng, max_threshold,
-            cluster_in=cluster_in if clustered else None)
+            cluster_in=cluster_in if clustered else None,
+            vote_ok=rt_vote_ok, adv=rt_adv, lie_votes=rt_lie)
 
         # ---- aggregator crash -> on-device re-election (chaos only) ----
         crashed = jnp.int32(-1)
@@ -363,7 +432,8 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                     scores_fn, states.params, sel_indices, mask2, agg_count,
                     vote_x, vote_m, jax.random.fold_in(rng, 0x7FFFFFFE),
                     max_threshold,
-                    cluster_in=cluster_in if clustered else None)
+                    cluster_in=cluster_in if clustered else None,
+                    vote_ok=rt_vote_ok, adv=rt_adv, lie_votes=rt_lie)
 
             crashed = jnp.where(crash_now, aggregator, jnp.int32(-1))
             aggregator, scores = jax.lax.cond(
@@ -390,6 +460,15 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                     cluster_params = poison_fn(
                         cluster_params, round_index,
                         jax.random.fold_in(rng, 0x7FFFFFFF))
+                if redteam and redteam_fns.merge_fn is not None:
+                    # coalition-aggregator tampering: fires only when the
+                    # elected aggregator is adversarial, and touches only
+                    # the victim cluster's row when the spec names one
+                    # (fold 0x52454455 — unreachable elsewhere)
+                    cluster_params = redteam_fns.merge_fn(
+                        cluster_params, redteam_in.adv[aggregator] > 0,
+                        round_index, jax.random.fold_in(rng, 0x52454455),
+                        clustered=True)
                 agg_bcast = gather_cluster_rows(cluster_params, cluster_in)
                 if personalize:
                     agg_bcast = personalized_broadcast(
@@ -404,6 +483,12 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
                     agg_params = poison_fn(agg_params, round_index,
                                            jax.random.fold_in(rng,
                                                               0x7FFFFFFF))
+                if redteam and redteam_fns.merge_fn is not None:
+                    # unclustered coalition aggregator: the whole merge
+                    agg_params = redteam_fns.merge_fn(
+                        agg_params, redteam_in.adv[aggregator] > 0,
+                        round_index, jax.random.fold_in(rng, 0x52454455),
+                        clustered=False)
                 agg_bcast = agg_params
             onehot = (client_ids == aggregator).astype(jnp.float32)
             outcome = verify(states, agg_bcast, ver_x, ver_m, onehot,
@@ -474,28 +559,30 @@ def make_round_body(train_all: Callable, scores_fn: Callable,
 def make_fused_round(*args, chaos: bool = False, elastic: bool = False,
                      divergence_fn: Optional[Callable] = None,
                      cluster_k: int = 1, personalize: bool = False,
-                     shared_modules: Sequence[str] = ("encoder",)
-                     ) -> Callable:
+                     shared_modules: Sequence[str] = ("encoder",),
+                     redteam_fns=None) -> Callable:
     """The single-dispatch round: jitted round body with the incoming states
     buffers donated (they are consumed and replaced every round). With
     `chaos=True` the call takes a trailing single-round ChaosMasks slice;
     with `elastic=True` a single-round MembershipMasks slice; with
-    `cluster_k > 1` a [N] i32 assignment vector (pass all as KEYWORDS —
-    `chaos_in=` / `elastic_in=` / `cluster_in=` — so any axis composes
+    `cluster_k > 1` a [N] i32 assignment vector; with `redteam_fns` a
+    single-round RedteamMasks slice (pass all as KEYWORDS — `chaos_in=` /
+    `elastic_in=` / `cluster_in=` / `redteam_in=` — so any axis composes
     alone without positional ambiguity)."""
     return jax.jit(make_round_body(*args, chaos=chaos, elastic=elastic,
                                    divergence_fn=divergence_fn,
                                    cluster_k=cluster_k,
                                    personalize=personalize,
-                                   shared_modules=shared_modules),
+                                   shared_modules=shared_modules,
+                                   redteam_fns=redteam_fns),
                    donate_argnums=(0,))
 
 
 def make_fused_rounds_scan(*args, chaos: bool = False, elastic: bool = False,
                            divergence_fn: Optional[Callable] = None,
                            cluster_k: int = 1, personalize: bool = False,
-                           shared_modules: Sequence[str] = ("encoder",)
-                           ) -> Callable:
+                           shared_modules: Sequence[str] = ("encoder",),
+                           redteam_fns=None) -> Callable:
     """Build the whole-schedule runner: `lax.scan` of the raw round body over
     a precomputed selection schedule.
 
@@ -525,27 +612,37 @@ def make_fused_rounds_scan(*args, chaos: bool = False, elastic: bool = False,
     cadence is dispatch-chunk granularity (DESIGN §19), so one vector
     rides the whole scan and a refit simply passes a new vector to the
     next chunk's dispatch — same shapes, zero recompiles.
+
+    `redteam_fns` threads the adversary tensors (`redteam_masks=`, a
+    RedteamMasks with [R, N] leaves — redteam/masks.py) through the
+    scan's xs like the chaos/elastic masks: the coalition and the
+    tenure gate are INPUTS to the program, expanded whole-schedule by
+    the engine and sliced per chunk, so dense/chunked/pipelined
+    dispatches see the identical adversary.
     """
     round_body = make_round_body(*args, chaos=chaos, elastic=elastic,
                                  divergence_fn=divergence_fn,
                                  cluster_k=cluster_k,
                                  personalize=personalize,
-                                 shared_modules=shared_modules)
+                                 shared_modules=shared_modules,
+                                 redteam_fns=redteam_fns)
+    redteam = redteam_fns is not None
 
     @partial(jax.jit, donate_argnums=(0,))
     def run_all(states: ClientStates, data, ver_x, ver_m, sel_schedule,
                 sel_masks, agg_count, keys, round_indices, chaos_masks=None,
-                elastic_masks=None, cluster_in=None):
+                elastic_masks=None, cluster_in=None, redteam_masks=None):
         def step(carry, xs):
             states, agg_count = carry
             sel_indices, sel_mask, key, round_index = xs[:4]
             rest = list(xs[4:])
             ch = rest.pop(0) if chaos else None
             el = rest.pop(0) if elastic else None
+            rt = rest.pop(0) if redteam else None
             states, agg_count, out = round_body(states, data, ver_x, ver_m,
                                                 sel_indices, sel_mask,
                                                 agg_count, key, round_index,
-                                                ch, el, cluster_in)
+                                                ch, el, cluster_in, rt)
             return (states, agg_count), out
 
         xs = (sel_schedule, sel_masks, keys, round_indices)
@@ -553,6 +650,8 @@ def make_fused_rounds_scan(*args, chaos: bool = False, elastic: bool = False,
             xs = xs + (chaos_masks,)
         if elastic:
             xs = xs + (elastic_masks,)
+        if redteam:
+            xs = xs + (redteam_masks,)
         (states, agg_count), outs = jax.lax.scan(step, (states, agg_count),
                                                  xs)
         return states, agg_count, outs

@@ -47,6 +47,8 @@ from fedmse_tpu.federation.state import ClientStates, HostState, init_client_sta
 from fedmse_tpu.federation.verification import make_verify_fn
 from fedmse_tpu.federation.voting import elect_aggregator, make_mse_scores_fn
 from fedmse_tpu.parallel.mesh import host_fetch, host_fetch_async
+from fedmse_tpu.redteam.adversary import make_redteam_fns
+from fedmse_tpu.redteam.masks import make_redteam_masks
 from fedmse_tpu.utils.logging import get_logger
 from fedmse_tpu.utils.seeding import ExperimentRngs
 
@@ -118,7 +120,8 @@ def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
            cfg.fedprox_mu, cfg.compat.no_best_restore,
            cfg.compat.restandardize_vote_data, cfg.compat.vote_tie_break,
            cfg.verification_threshold, cfg.performance_threshold,
-           cfg.hardened_verification, cfg.flatten_optimizer,
+           cfg.hardened_verification, cfg.recovery_budget,
+           cfg.flatten_optimizer,
            model_type, cfg.metric, cfg.fused_eval, cfg.score_kind,
            cfg.knn_bank_size, cfg.knn_k, cfg.knn_topk)
     hit = _PROGRAM_CACHE.get(key)
@@ -141,7 +144,8 @@ def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
         "aggregate": make_aggregate_fn(model, update_type),
         "verify": make_verify_fn(model, cfg.verification_threshold,
                                  cfg.performance_threshold,
-                                 hardened=cfg.hardened_verification),
+                                 hardened=cfg.hardened_verification,
+                                 recovery_budget=cfg.recovery_budget),
         "evaluate_all": make_evaluate_all(model, model_type, cfg.metric,
                                           fused=cfg.fused_eval,
                                           score_kind=cfg.score_kind,
@@ -285,7 +289,8 @@ class RoundEngine:
                  update_type: str, profile: bool = False,
                  fused: bool = False, poison_fn=None, chaos=None,
                  elastic=None, mesh=None, cluster=None,
-                 cluster_assignment=None):
+                 cluster_assignment=None, redteam=None,
+                 elastic_masks=None):
         self.model = model
         self.cfg = cfg
         self.data = data
@@ -372,6 +377,23 @@ class RoundEngine:
         # just a dispatch-path optimization
         self._elastic_premade = None
         self._elastic_horizon = 0
+        # `elastic_masks` injects a PREMADE membership timeline (leaves
+        # [T, N]) in place of the spec-drawn one — the redteam sweep uses
+        # it to stage adversarially-TIMED sybil joins (elastic joins are
+        # otherwise random draws; a quorum-capture attack needs them
+        # landing on the victim cluster's slots at the quota cliff). The
+        # spec still gates the fused program's elastic branch; the
+        # timeline just stops being random.
+        self._elastic_override = elastic_masks
+        if elastic_masks is not None:
+            if elastic is None:
+                raise ValueError(
+                    "elastic_masks needs an ElasticSpec: the override "
+                    "replaces the spec's TIMELINE, not the elastic program "
+                    "itself (pass any non-null spec to compile it in)")
+            self._elastic_premade = elastic_masks
+            self._elastic_horizon = int(
+                jax.tree.leaves(elastic_masks)[0].shape[0])
         # clustered + personalized federation (fedmse_tpu/cluster/,
         # DESIGN.md §19): a ClusterSpec compiled into the fused program as
         # a [N] assignment-vector input — same fused-only discipline as
@@ -393,6 +415,35 @@ class RoundEngine:
                                                   np.int32))
         self._cluster_stats_fn = None     # shared compiled stats program
         self._warned_cluster_backend = False
+        # red-team adversaries (fedmse_tpu/redteam/, DESIGN.md §21): a
+        # RedteamSpec compiled into the fused program as per-round [T, N]
+        # adversary / vote-eligibility tensors plus static poison hooks —
+        # same fused-only discipline as chaos/elastic. A NULL spec (no
+        # attack, no defense knob) is treated exactly like None (the
+        # cluster is_null idiom): no hook traces, so attack-off runs share
+        # the pre-redteam program bit-for-bit.
+        if redteam is not None and redteam.is_null:
+            redteam = None
+        self.redteam = redteam
+        if redteam is not None and (not fused or profile):
+            raise ValueError(
+                "redteam adversaries are compiled into the fused round "
+                "program; construct the engine with fused=True (and "
+                "profile=False)")
+        if redteam is not None and redteam.min_tenure > 0 and elastic is None:
+            # the tenure gate acts on RECYCLED tenants — without an
+            # elastic timeline there are none, and a silently-inert
+            # defense would be reported as free
+            raise ValueError("min_tenure > 0 needs an ElasticSpec: the "
+                             "gate defers recycled tenants' votes, and a "
+                             "static fleet has none to defer")
+        self._redteam_key = rngs.redteam_key() if redteam is not None else None
+        self._redteam_fns = (make_redteam_fns(redteam)
+                             if redteam is not None else None)
+        # whole-schedule adversary-mask cache (see _redteam_masks):
+        # expanded once, sliced per chunk, like the chaos masks
+        self._redteam_premade = None
+        self._redteam_horizon = 0
         self._fused_round = None
         self._fused_scan = None
         self._fused_compact = None  # compact value baked into the programs
@@ -445,21 +496,26 @@ class RoundEngine:
         with_elastic = self.elastic is not None  # ... and on this one
         # same sharing rationale as _engine_programs; the builders are keyed
         # by the already-cached phase callables, so identity works — except
-        # with an attack poison_fn (arbitrary callable, not cache-keyable)
+        # with an attack poison_fn or redteam hooks (arbitrary callables
+        # built per spec, not cache-keyable), which bypass the cache like
+        # poison_fn always has
+        cacheable = self.poison_fn is None and self._redteam_fns is None
         key = ("fused",) + args[:-1] + (with_chaos, with_elastic,
                                         divergence_fn,
                                         tuple(sorted(cluster_kw.items())))
-        if self.poison_fn is None and key in _PROGRAM_CACHE:
+        if cacheable and key in _PROGRAM_CACHE:
             self._fused_round, self._fused_scan = _PROGRAM_CACHE[key]
             return
         self._fused_round = make_fused_round(*args, chaos=with_chaos,
                                              elastic=with_elastic,
                                              divergence_fn=divergence_fn,
+                                             redteam_fns=self._redteam_fns,
                                              **cluster_kw)
         self._fused_scan = make_fused_rounds_scan(
             *args, chaos=with_chaos, elastic=with_elastic,
-            divergence_fn=divergence_fn, **cluster_kw)
-        if self.poison_fn is None:
+            divergence_fn=divergence_fn, redteam_fns=self._redteam_fns,
+            **cluster_kw)
+        if cacheable:
             _cache_put(key, (self._fused_round, self._fused_scan))
 
     def _data_mesh(self):
@@ -611,8 +667,17 @@ class RoundEngine:
             self._chaos_horizon = 0
         if self.elastic is not None:
             self._elastic_key = self.rngs.elastic_key()
-            self._elastic_premade = None
-            self._elastic_horizon = 0
+            # a premade timeline override is construction state: it is
+            # restored, not re-drawn (the sweep's staged sybil joins must
+            # replay identically across resets)
+            self._elastic_premade = self._elastic_override
+            self._elastic_horizon = (
+                0 if self._elastic_override is None else int(
+                    jax.tree.leaves(self._elastic_override)[0].shape[0]))
+        if self.redteam is not None:
+            self._redteam_key = self.rngs.redteam_key()
+            self._redteam_premade = None
+            self._redteam_horizon = 0
         if self.cluster is not None and self._cluster_override is None:
             # a fresh federation re-fits from its fresh init states
             self._cluster_assign = None
@@ -649,6 +714,12 @@ class RoundEngine:
         changing its prefix when the horizon regrows)."""
         end = start_round + n_rounds
         if self._elastic_premade is None or end > self._elastic_horizon:
+            if self._elastic_override is not None:
+                # regrowing would splice spec-drawn rounds onto a staged
+                # timeline — the override must cover the whole schedule
+                raise ValueError(
+                    f"elastic_masks override covers {self._elastic_horizon} "
+                    f"rounds but the schedule needs {end}")
             self._elastic_horizon = max(end, self.cfg.num_rounds)
             self._elastic_premade = make_membership_masks(
                 self.elastic, self._elastic_key, self._elastic_horizon,
@@ -685,14 +756,36 @@ class RoundEngine:
                                   self.n_real)
         return member
 
+    def _redteam_masks(self, start_round: int, n_rounds: int):
+        """[n_rounds]-stacked adversary tensors for the chunk — the chaos
+        hoist: whole-schedule expansion on first ask, slices per chunk.
+        The coalition draw keys on ABSOLUTE slot ids (redteam/masks.py),
+        so the slice is identical to a per-chunk build; the tenure gate
+        reads the already-expanded elastic timeline (forcing its horizon
+        first so both caches cover the same rounds)."""
+        end = start_round + n_rounds
+        if self._redteam_premade is None or end > self._redteam_horizon:
+            self._redteam_horizon = max(end, self.cfg.num_rounds)
+            membership = None
+            if self.redteam.min_tenure > 0:
+                self._elastic_masks(0, self._redteam_horizon)
+                membership = self._elastic_premade
+            self._redteam_premade = make_redteam_masks(
+                self.redteam, self._redteam_key, self._redteam_horizon,
+                self.n_pad, membership=membership)
+        return jax.tree.map(lambda t: t[start_round:end],
+                            self._redteam_premade)
+
     def _mask_kwargs(self, start_round: int, n_rounds: int) -> dict:
-        """The fault/membership xs for one dispatch, as KEYWORDS — either
-        axis composes alone without positional ambiguity."""
+        """The fault/membership/adversary xs for one dispatch, as KEYWORDS
+        — any axis composes alone without positional ambiguity."""
         kw = {}
         if self.chaos is not None:
             kw["chaos_masks"] = self._chaos_masks(start_round, n_rounds)
         if self.elastic is not None:
             kw["elastic_masks"] = self._elastic_masks(start_round, n_rounds)
+        if self.redteam is not None:
+            kw["redteam_masks"] = self._redteam_masks(start_round, n_rounds)
         return kw
 
     # ---- clustered federation (fedmse_tpu/cluster/, DESIGN.md §19) ---- #
@@ -749,13 +842,20 @@ class RoundEngine:
                    >= spec.refit_every))
         if not due:
             return
-        from fedmse_tpu.cluster import fit_from_states, make_latent_stats_fn
+        from fedmse_tpu.cluster import (fit_from_states, make_latent_rows_fn,
+                                        make_latent_stats_fn)
         if self._cluster_stats_fn is None:
-            self._cluster_stats_fn = make_latent_stats_fn(self.model)
+            maker = (make_latent_rows_fn if spec.metric == "gmm"
+                     else make_latent_stats_fn)
+            self._cluster_stats_fn = maker(self.model)
         self._cluster_assign = fit_from_states(
             self.model, spec, self.states.params, self.data.train_xb,
             self.data.train_mb, self.data.client_mask, self.n_real,
-            fitted_round=round_index, stats_fn=self._cluster_stats_fn)
+            fitted_round=round_index, stats_fn=self._cluster_stats_fn,
+            # cadence refits under hysteresis are label-stable moves off
+            # the PREVIOUS assignment (cluster/assign.py
+            # refit_with_hysteresis); the first fit has no previous
+            prev_assignment=self._cluster_vec)
         self._cluster_vec = self._cluster_assign.assignment
         self._cluster_fitted_round = round_index
         logger.info("cluster fit at round %d: k=%d sizes=%s", round_index,
@@ -796,6 +896,9 @@ class RoundEngine:
         if self.elastic is not None:
             kw["elastic_in"] = jax.tree.map(
                 lambda t: t[0], self._elastic_masks(round_index, 1))
+        if self.redteam is not None:
+            kw["redteam_in"] = jax.tree.map(
+                lambda t: t[0], self._redteam_masks(round_index, 1))
         kw.update(self._cluster_kwargs(round_index))
         self.states, _, out = self._fused_round(
             self.states, self.data, self._ver_x, self._ver_m,

@@ -47,6 +47,10 @@ class ClientStates:
     hist_perf: jax.Array   # [N] — 1/(1+MSE) of last received state
     hist_seen: jax.Array   # [N] bool — verifier history exists
     rejected: jax.Array    # [N] int32 — consecutive rejected updates
+    waived: jax.Array      # [N] f32 — cumulative Frobenius delta accepted
+    #                        via the hardened verifier's recovery waiver
+    #                        (beyond verification_threshold); gated by
+    #                        config.recovery_budget (DESIGN.md §21)
 
 
 @dataclasses.dataclass
@@ -138,6 +142,7 @@ def init_client_states(model, tx: optax.GradientTransformation,
             hist_perf=jnp.zeros((n_clients,), dtype=jnp.float32),
             hist_seen=jnp.zeros((n_clients,), dtype=bool),
             rejected=jnp.zeros((n_clients,), dtype=jnp.int32),
+            waived=jnp.zeros((n_clients,), dtype=jnp.float32),
         )
 
     if mesh is None:
@@ -170,6 +175,7 @@ def init_batched_client_states(model, tx: optax.GradientTransformation,
         hist_perf=jnp.zeros((runs, n_clients), dtype=jnp.float32),
         hist_seen=jnp.zeros((runs, n_clients), dtype=bool),
         rejected=jnp.zeros((runs, n_clients), dtype=jnp.int32),
+        waived=jnp.zeros((runs, n_clients), dtype=jnp.float32),
     )
 
 
@@ -228,7 +234,8 @@ class TieredClientStore:
                 hist_params=jax.tree.map(jnp.zeros_like, params),
                 hist_perf=jnp.zeros((c,), jnp.float32),
                 hist_seen=jnp.zeros((c,), bool),
-                rejected=jnp.zeros((c,), jnp.int32))
+                rejected=jnp.zeros((c,), jnp.int32),
+                waived=jnp.zeros((c,), jnp.float32))
 
         chunk_init = jax.jit(chunk_init)
         chunk = min(init_chunk, n_clients)
@@ -352,7 +359,8 @@ class TieredShardStore(TieredClientStore):
                 hist_params=jax.tree.map(jnp.zeros_like, params),
                 hist_perf=jnp.zeros((c,), jnp.float32),
                 hist_seen=jnp.zeros((c,), bool),
-                rejected=jnp.zeros((c,), jnp.int32))
+                rejected=jnp.zeros((c,), jnp.int32),
+                waived=jnp.zeros((c,), jnp.float32))
 
         chunk_init = jax.jit(chunk_init)
         rows = stop - start

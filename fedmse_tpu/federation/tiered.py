@@ -142,10 +142,20 @@ class TieredRoundEngine:
                  n_real: int, rngs: ExperimentRngs, model_type: str,
                  update_type: str, poison_fn=None, chaos=None, elastic=None,
                  mesh=None, init_chunk: int = 4096, cluster=None,
-                 host_sharded: bool = False, local_data: bool = False):
+                 host_sharded: bool = False, local_data: bool = False,
+                 redteam=None):
         if cfg.metric == "time":
             raise ValueError("metric='time' is host-side wall-clock and "
                              "cannot run inside the fused cohort program")
+        if redteam is not None and not redteam.is_null:
+            # the adversary tensors are not cohort-gathered here (yet):
+            # a NULL spec is accepted — and changes nothing, the same
+            # program traces (the attack-off cross-layout pin in
+            # tests/test_redteam.py) — but an active coalition must fail
+            # loudly rather than silently run a clean schedule
+            raise ValueError("redteam adversaries run on the dense fused "
+                             "engine (state_layout='dense'); the tiered "
+                             "layout accepts only a null RedteamSpec")
         self.model = model
         self.cfg = cfg
         self.n_real = n_real

@@ -52,7 +52,8 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
                    performance_threshold: float = 0.002,
                    hardened: bool = False,
                    recovery_threshold: float = 0.1,
-                   recovery_delta_cap: Optional[float] = None) -> Callable:
+                   recovery_delta_cap: Optional[float] = None,
+                   recovery_budget: Optional[float] = None) -> Callable:
     """Build fn(states, agg_params, ver_x [N,V,D], ver_m [N,V],
     agg_onehot [N], client_mask [N]) -> VerifyOutcome.
 
@@ -99,6 +100,20 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
         both the test-size and paper-size models) with ~1.5x headroom
         while still bounding what a broadcast that games the perf oracle
         can move in one round.
+
+    ``recovery_budget`` closes the remaining gameability of the waiver
+    (the CAVEAT below): every recovery-waived accept whose delta exceeds
+    ``verification_threshold`` adds that delta to the client's CUMULATIVE
+    ``states.waived``; once a client's total reaches the budget, the
+    recovery waiver stops applying to it — further broadcasts must pass
+    the ordinary delta cap. A repeat attacker who keeps clearing the perf
+    margin on the shared tensor thus extracts at most ``recovery_budget``
+    of waived Frobenius movement per client over the WHOLE run, not
+    ``recovery_delta_cap`` per round forever (REDTEAM_r17.json measures
+    the bound). First-contact waivers do not consume budget (cold start
+    is not the attack surface). ``None`` preserves the exact pre-budget
+    accept rule (the waived counter still accumulates, so a later resume
+    under a budget sees true history).
 
     CAVEAT — recovery waiver × compat.shared_last_client_val (ADVICE r5):
     the recovery waiver's oracle is only as private as the verification
@@ -177,10 +192,20 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
             # it: even a big genuine improvement stays Frobenius-bounded
             recovers = ((perf_change >= recovery_threshold)
                         & (delta <= recovery_delta_cap))
+            if recovery_budget is not None:
+                # cumulative-influence ceiling: a client whose waived
+                # total has reached the budget gets no further waivers
+                recovers = recovers & (states.waived < recovery_budget)
             first = ~states.hist_seen
             checks = perf_ok & (first | recovers |
                                 (delta <= verification_threshold))
             accepted = attempted & checks
+            # charge the budget only for steps the waiver actually bought
+            # (beyond the ordinary cap; first contact is cold start, not
+            # the attack surface — it never consumes budget)
+            waived = states.waived + jnp.where(
+                accepted & recovers & ~first
+                & (delta > verification_threshold), delta, 0.0)
         else:
             delta = jax.vmap(frob_delta)(states.hist_params, agg_stacked)
             first = ~states.hist_seen
@@ -188,6 +213,7 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
             checks = (delta <= verification_threshold) & \
                      (perf_change >= -performance_threshold)
             accepted = attempted & (first | checks)
+            waived = states.waived  # no waiver path to charge
 
         load_mask = accepted | is_agg  # aggregator loads unconditionally
         params = tree_select_clients(load_mask, agg_stacked, states.params)
@@ -206,7 +232,7 @@ def make_verify_fn(model, verification_threshold: float = 3.0,
         out = ClientStates(
             params=params, opt_state=states.opt_state, prev_global=prev_global,
             hist_params=hist_params, hist_perf=hist_perf, hist_seen=hist_seen,
-            rejected=rejected)
+            rejected=rejected, waived=waived)
         return VerifyOutcome(states=out,
                              accepted=accepted | is_agg,
                              perf_change=jnp.where(attempted, perf_change, 0.0),

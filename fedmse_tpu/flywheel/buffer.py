@@ -90,13 +90,29 @@ class FlywheelBuffer:
     """Fixed-capacity per-gateway reservoirs of served-normal rows."""
 
     def __init__(self, num_gateways: int, dim: int, capacity: int = 512,
-                 seed: int = 0, decay: Optional[float] = None):
+                 seed: int = 0, decay: Optional[float] = None,
+                 margin_frac: Optional[float] = None,
+                 thresholds_fn=None,
+                 influence_cap: Optional[float] = None):
         if num_gateways < 1:
             raise ValueError(f"num_gateways must be >= 1, got {num_gateways}")
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         if decay is not None and not 0.0 < decay <= 1.0:
             raise ValueError(f"decay must be in (0, 1], got {decay}")
+        if margin_frac is not None and not 0.0 < margin_frac <= 1.0:
+            raise ValueError(f"margin_frac must be in (0, 1], got "
+                             f"{margin_frac}")
+        if margin_frac is not None and thresholds_fn is None:
+            # a floor with no threshold source would silently admit
+            # everything — the defense must fail loudly, not open
+            raise ValueError("margin_frac needs thresholds_fn (a callable "
+                             "returning the DEPLOYED per-gateway [N] "
+                             "thresholds — e.g. lambda: front.engine."
+                             "calibration.thresholds)")
+        if influence_cap is not None and not 0.0 < influence_cap <= 1.0:
+            raise ValueError(f"influence_cap must be in (0, 1], got "
+                             f"{influence_cap}")
         self.num_gateways = num_gateways
         self.dim = dim
         self.capacity = capacity
@@ -105,6 +121,15 @@ class FlywheelBuffer:
         # exponential recency weight per admitted row (module docstring)
         self.decay = decay
         self._log_decay = None if decay is None else float(np.log(decay))
+        # reservoir admission hardening (fedmse_tpu/redteam/, DESIGN.md
+        # §21): margin_frac admits only rows scoring <= margin_frac x the
+        # DEPLOYED threshold — the slow-drift adversary's probe rows live
+        # just under threshold, exactly the band the floor excludes;
+        # influence_cap bounds one gateway's share of a fine-tune's train
+        # rows. Both default None = byte-identical to the unhardened path.
+        self.margin_frac = margin_frac
+        self.thresholds_fn = thresholds_fn
+        self.influence_cap = influence_cap
         self._rows = np.zeros((num_gateways, capacity, dim), np.float32)
         self._pri = np.full((num_gateways, capacity), np.inf)
         self.count = np.zeros(num_gateways, np.int64)  # valid slots
@@ -128,19 +153,30 @@ class FlywheelBuffer:
 
         `verdicts` (bool [n], True = anomalous) filters to the NORMAL
         rows — the semi-supervised admission rule. None admits everything
-        (callers that pre-filter). `scores` is accepted for tap
-        signature compatibility and unused: admission is verdict-driven,
-        and thresholds — not raw scores — are the deployed notion of
-        normal."""
-        del scores
+        (callers that pre-filter). `scores` is unused UNLESS the
+        verdict-margin floor is armed (`margin_frac` + `thresholds_fn`):
+        then a row must score <= margin_frac x its gateway's DEPLOYED
+        threshold to be admitted — "verdicted normal" stops being enough,
+        the row must be normal with margin. A slow-drift poisoner's rows
+        ride just under threshold by construction, so the floor cuts it
+        off at margin_frac of the walk while genuinely normal traffic
+        (which scores well below threshold) passes untouched."""
         rows = np.asarray(rows, np.float32)
         if rows.ndim == 1:
             rows = rows[None, :]
         gw = np.broadcast_to(np.asarray(gateway_ids, np.int32),
                              (rows.shape[0],))
+        sc = (None if scores is None else
+              np.broadcast_to(np.asarray(scores, np.float64),
+                              (rows.shape[0],)))
         if verdicts is not None:
             keep = ~np.asarray(verdicts, bool)
             rows, gw = rows[keep], gw[keep]
+            sc = None if sc is None else sc[keep]
+        if self.margin_frac is not None and sc is not None:
+            thr = np.asarray(self.thresholds_fn(), np.float64)
+            rows_ok = sc <= self.margin_frac * thr[gw]
+            rows, gw = rows[rows_ok], gw[rows_ok]
         if not len(rows):
             return 0
         for g in np.unique(gw):
@@ -249,6 +285,18 @@ class FlywheelBuffer:
                           max(1, int(round(valid_frac * len(rows)))))
             train_rows.append(rows[:-n_valid])
             valid_rows.append(rows[-n_valid:])
+
+        if self.influence_cap is not None:
+            # per-gateway influence cap (DESIGN.md §21): no single gateway
+            # may contribute more than influence_cap of the fine-tune's
+            # total train rows — a captive gateway streaming at full rate
+            # cannot dominate the update however fast it fills its
+            # reservoir. Trimming keeps the FIRST slots (priority order =
+            # a uniform subsample), so the cap is deterministic and the
+            # kept rows remain an unbiased sample of the reservoir.
+            total = sum(len(r) for r in train_rows)
+            cap = max(1, int(self.influence_cap * total))
+            train_rows = [r[:cap] for r in train_rows]
 
         def ceil_div(a: int, b: int) -> int:
             return -(-a // b)

@@ -1,0 +1,38 @@
+"""fedmse_tpu.redteam — adaptive adversaries + measured defenses for the
+decision-making subsystems (DESIGN.md §21, ROADMAP item 5).
+
+The PR 3 threat model (federation/attack.py) predates cluster assignment,
+the flywheel, and elastic membership; this package attacks each where it
+decides, and carries the defense knobs measured against each attack:
+
+  * spec.py      — RedteamSpec (coalition + poison schedule + defenses)
+  * masks.py     — [T, N] adversary / vote-eligibility schedule inputs
+  * adversary.py — compiled update/merge poison hooks + election flags
+  * mimicry.py   — latent-stats forgery for cluster-assignment poisoning
+  * traffic.py   — the adaptive slow-drift flywheel self-poisoner
+
+Attack-success-rate-vs-defense grids: redteam_sweep.py -> REDTEAM_r17.json
+(`make redteam-sweep`); the reduced regression guard is bench_suite
+scenario 19.
+"""
+
+from fedmse_tpu.redteam.adversary import (MERGE_POISON_FOLD,
+                                          UPDATE_POISON_FOLD, RedteamFns,
+                                          make_redteam_fns)
+from fedmse_tpu.redteam.masks import (RedteamMasks, coalition_mask,
+                                      make_redteam_masks, null_redteam_masks,
+                                      tenure_vote_ok)
+from fedmse_tpu.redteam.mimicry import (assignment_capture_rate,
+                                        mimic_latent_stats)
+from fedmse_tpu.redteam.spec import POISON_KINDS, REDTEAM_KINDS, RedteamSpec
+from fedmse_tpu.redteam.traffic import SlowDriftAdversary, normal_fraction
+
+__all__ = [
+    "RedteamSpec", "REDTEAM_KINDS", "POISON_KINDS",
+    "RedteamMasks", "make_redteam_masks", "null_redteam_masks",
+    "coalition_mask", "tenure_vote_ok",
+    "RedteamFns", "make_redteam_fns",
+    "UPDATE_POISON_FOLD", "MERGE_POISON_FOLD",
+    "mimic_latent_stats", "assignment_capture_rate",
+    "SlowDriftAdversary", "normal_fraction",
+]

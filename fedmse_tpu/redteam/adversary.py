@@ -1,0 +1,140 @@
+"""Compiled adversary hooks for the fused round body.
+
+Where federation/attack.py's poison_fn models a blind malicious aggregator
+(corrupt the whole broadcast, whoever you are), these hooks model a
+COALITION that attacks where the system decides (DESIGN.md §21):
+
+  * `update_fn` poisons the coalition's OWN submitted updates after local
+    training — the insider shape: each adversarial row of the trained
+    params tree is perturbed before the merge, so the poison arrives
+    weighted like any honest update and must get past cluster-scoped
+    verification from inside. Modest strengths are the point: a boiling-
+    frog drift each round stays under per-round delta thresholds while
+    compounding (the recovery-waiver exploit the cumulative budget caps —
+    verification.py).
+  * `merge_fn` fires only when the ELECTED aggregator is adversarial and
+    poisons the merged tree it coordinates — surgically scoped to the
+    victim cluster's row of the [K, ...] cluster trees when the spec names
+    one, so other clusters' broadcasts are byte-identical and nothing
+    cross-cluster notices.
+
+Both hooks are pure, jittable, and scheduled by `lax.cond` on the traced
+round index (the attack.py idiom), drawing noise from round-key folds
+0x52454454 / 0x52454455 — constants the voter loop (folds [0, n_sel)),
+crash re-election (0x7FFFFFFE) and poison_fn (0x7FFFFFFF) never reach.
+`RedteamFns` also carries the static election flags (`lie_votes`,
+`gate_votes`) the round body compiles in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.redteam.spec import RedteamSpec
+
+# round-key fold constants for the two poison stages (see module docstring)
+UPDATE_POISON_FOLD = 0x52454454  # "REDT"
+MERGE_POISON_FOLD = 0x52454455
+
+
+class RedteamFns(NamedTuple):
+    """Static bundle the fused round body compiles in. `update_fn` /
+    `merge_fn` are None when that stage is off; `gate_votes` True compiles
+    the vote_ok tenure gate into the election; `lie_votes` True compiles
+    the colluding-voter pick."""
+
+    update_fn: Optional[Callable]
+    merge_fn: Optional[Callable]
+    lie_votes: bool
+    gate_votes: bool
+    spec: RedteamSpec
+
+
+def _schedule_active(spec: RedteamSpec, round_index: jax.Array) -> jax.Array:
+    round_index = jnp.asarray(round_index)
+    active = (round_index >= spec.start_round) & \
+             (((round_index - spec.start_round) % spec.every_k) == 0)
+    if spec.stop_round is not None:
+        active = active & (round_index < spec.stop_round)
+    return active
+
+
+def _bcast_rows(mask: jax.Array, leaf: jax.Array) -> jax.Array:
+    """Reshape an [N] mask against an [N, ...] leaf for row broadcasting."""
+    return mask.reshape(mask.shape + (1,) * (leaf.ndim - 1))
+
+
+def _poison_rows(spec: RedteamSpec, params: Any, adv: jax.Array,
+                 rng: jax.Array) -> Any:
+    """Perturb the adversarial rows of an [N, ...]-stacked params tree;
+    honest rows pass through bitwise."""
+    if spec.poison == "scale":
+        return jax.tree.map(
+            lambda t: t * jnp.where(_bcast_rows(adv, t) > 0,
+                                    jnp.asarray(spec.strength, t.dtype),
+                                    jnp.asarray(1.0, t.dtype)), params)
+    if spec.poison == "sign_flip":
+        return jax.tree.map(
+            lambda t: jnp.where(_bcast_rows(adv, t) > 0,
+                                (-spec.strength * t).astype(t.dtype), t),
+            params)
+    # noise: per-leaf keys; the draw shape is the full leaf, masked to the
+    # adversarial rows — honest rows see zero added, not a different draw
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(rng, len(leaves))
+    out = [t + (_bcast_rows(adv, t) * spec.strength
+                * jax.random.normal(k, t.shape, jnp.float32)).astype(t.dtype)
+           for t, k in zip(leaves, keys)]
+    return jax.tree.unflatten(treedef, out)
+
+
+def _poison_tree(spec: RedteamSpec, params: Any, rng: jax.Array,
+                 clustered: bool) -> Any:
+    """Perturb a merged tree: the victim cluster's row of [K, ...] cluster
+    trees when clustered and the spec names one, else every element."""
+    if clustered and spec.victim_cluster is not None:
+        k = jax.tree.leaves(params)[0].shape[0]
+        victim = (jnp.arange(k) == spec.victim_cluster).astype(jnp.float32)
+        return _poison_rows(spec, params, victim, rng)
+    if spec.poison == "scale":
+        return jax.tree.map(lambda t: t * spec.strength, params)
+    if spec.poison == "sign_flip":
+        return jax.tree.map(lambda t: -spec.strength * t, params)
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(rng, len(leaves))
+    out = [t + spec.strength * jax.random.normal(k, t.shape, t.dtype)
+           for t, k in zip(leaves, keys)]
+    return jax.tree.unflatten(treedef, out)
+
+
+def make_redteam_fns(spec: Optional[RedteamSpec]) -> Optional[RedteamFns]:
+    """None for a fully-null spec (the program must be bit-identical to one
+    built without redteam — fused.py traces no hook); otherwise the static
+    hook bundle. A defense-only spec (kind='none', min_tenure > 0) yields
+    hooks with both poison stages None and only the vote gate compiled."""
+    if spec is None or spec.is_null:
+        return None
+
+    update_fn = None
+    merge_fn = None
+    if spec.attacks:
+        def update_fn(params, adv_mask, round_index, rng):
+            return jax.lax.cond(
+                _schedule_active(spec, round_index),
+                lambda p: _poison_rows(spec, p, adv_mask, rng),
+                lambda p: p, params)
+
+        def merge_fn(params, aggregator_is_adv, round_index, rng,
+                     clustered=False):
+            active = _schedule_active(spec, round_index) & aggregator_is_adv
+            return jax.lax.cond(
+                active,
+                lambda p: _poison_tree(spec, p, rng, clustered),
+                lambda p: p, params)
+
+    return RedteamFns(update_fn=update_fn, merge_fn=merge_fn,
+                      lie_votes=bool(spec.lie_votes and spec.attacks),
+                      gate_votes=spec.min_tenure > 0, spec=spec)

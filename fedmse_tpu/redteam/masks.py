@@ -1,0 +1,115 @@
+"""Redteam schedule inputs: [T, N] adversary + vote-eligibility tensors.
+
+Like chaos faults and elastic membership, the adversary coalition is an
+INPUT to the fused program, not control flow around it: `make_redteam_masks`
+expands the whole schedule once and the engines slice per chunk, so dense,
+tiered, chunked, and pipelined dispatches all see the identical coalition.
+
+Determinism contract (the chaos/elastic one):
+  * slot i's coalition draw is `bernoulli(fold_in(redteam_key, i))` — a
+    pure function of (key, ABSOLUTE slot id), never a shaped draw over the
+    padded axis, so padding the client axis cannot move the coalition
+    (PARITY §8; tests/test_redteam.py pins prefix equality);
+  * the redteam key is the domain-separated stream from
+    `ExperimentRngs.redteam_key()` (utils/seeding.py REDTEAM_STREAM_TAG):
+    drawing the coalition consumes nothing, so enabling an adversary
+    perturbs no training/eval/selection/chaos/elastic draw;
+  * the coalition is static over rounds (an adversary does not reform),
+    but the masks are materialized [T, N] so they ride the scan's xs
+    exactly like the selection schedule — one layout for every engine.
+
+`vote_ok` is the min-tenure DEFENSE tensor: recycled tenants
+(generation > 0) may neither vote nor be elected until they have held
+their slot for `min_tenure` consecutive rounds. It is computed host-side
+from the already-expanded MembershipMasks (a numpy streak over the [T]
+axis — the membership timeline is itself padding-invariant, so the gate
+inherits that). Founding tenants (generation 0) are never gated: a clean
+elastic run under the defense only defers the votes of just-joined slots,
+which is the bounded clean-cost the sweep measures.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedmse_tpu.redteam.spec import RedteamSpec
+from fedmse_tpu.utils.seeding import fold_in_keys
+
+
+class RedteamMasks(NamedTuple):
+    """Per-round adversary tensors. As built every leaf carries a leading
+    [T] rounds axis; `lax.scan` slices one round off the front, so the
+    round body sees [N] leaves."""
+
+    adv: jax.Array      # f32 1 = slot is adversary-controlled this round
+    vote_ok: jax.Array  # f32 1 = slot may vote / be elected (tenure gate)
+
+
+def null_redteam_masks(n_clients: int) -> RedteamMasks:
+    """The no-adversary, no-gate single-round masks (what a null spec
+    expands to at every round)."""
+    return RedteamMasks(
+        adv=jnp.zeros((n_clients,), jnp.float32),
+        vote_ok=jnp.ones((n_clients,), jnp.float32))
+
+
+def coalition_mask(spec: RedteamSpec, redteam_key: jax.Array,
+                   n_clients: int) -> jax.Array:
+    """[N] f32 adversary-slot mask — explicit ids when the spec names
+    them, else the per-slot bernoulli draw (absolute-id keyed)."""
+    if not spec.attacks:
+        return jnp.zeros((n_clients,), jnp.float32)
+    if spec.adversaries is not None:
+        adv = np.zeros((n_clients,), np.float32)
+        ids = [a for a in spec.adversaries if a < n_clients]
+        adv[np.asarray(ids, np.int64)] = 1.0
+        return jnp.asarray(adv)
+    draws = jax.vmap(
+        lambda k: jax.random.bernoulli(k, spec.adversary_frac))(
+            fold_in_keys(redteam_key, n_clients))
+    return draws.astype(jnp.float32)
+
+
+def tenure_vote_ok(min_tenure: int, membership,
+                   n_rounds: int, n_clients: int) -> np.ndarray:
+    """[T, N] f32 vote-eligibility under the min-tenure gate, from an
+    expanded elastic MembershipMasks (leaves [T', N], T' >= n_rounds).
+    A recycled tenant's streak restarts at 1 on its `joined` round and
+    grows while it stays a member; it may vote once streak >= min_tenure.
+    Founding tenants (generation 0) always may."""
+    member = np.asarray(membership.member[:n_rounds]) > 0
+    joined = np.asarray(membership.joined[:n_rounds]) > 0
+    gen = np.asarray(membership.generation[:n_rounds])
+    vote_ok = np.ones((n_rounds, n_clients), np.float32)
+    streak = np.zeros((n_clients,), np.int64)
+    for t in range(n_rounds):
+        streak = np.where(joined[t], 1, np.where(member[t], streak + 1, 0))
+        gated = (gen[t] > 0) & (streak < min_tenure)
+        vote_ok[t] = np.where(gated, 0.0, 1.0)
+    return vote_ok
+
+
+def make_redteam_masks(spec: RedteamSpec, redteam_key: jax.Array,
+                       n_rounds: int, n_clients: int,
+                       membership=None) -> RedteamMasks:
+    """Redteam tensors for rounds [0, n_rounds), leaves stacked on a
+    leading [T] axis. `membership` (an expanded MembershipMasks over at
+    least the same horizon) is required only when `min_tenure > 0` —
+    without an elastic timeline there are no recycled tenants to gate."""
+    adv_row = coalition_mask(spec, redteam_key, n_clients)
+    adv = jnp.broadcast_to(adv_row, (n_rounds, n_clients))
+    if spec.min_tenure > 0:
+        if membership is None:
+            # a silent all-pass gate would report the defense as free
+            raise ValueError("min_tenure > 0 needs the expanded elastic "
+                             "membership masks (no elastic spec => no "
+                             "recycled tenants to gate)")
+        vote_ok = jnp.asarray(
+            tenure_vote_ok(spec.min_tenure, membership, n_rounds, n_clients))
+    else:
+        vote_ok = jnp.ones((n_rounds, n_clients), jnp.float32)
+    return RedteamMasks(adv=adv, vote_ok=vote_ok)

@@ -1,0 +1,71 @@
+"""Latent-statistics mimicry: the cluster-assignment poisoning front end.
+
+The cluster fit (cluster/assign.py) groups gateways by the Gaussian-JS
+divergence between their latent-moment summaries. An adversary that wants
+INTO a victim cluster therefore does not need the victim's data — it needs
+latent statistics that *look* like the victim's to the JS metric. This
+module crafts them host-side, between the stats extraction and the medoid
+fit, exactly where a gateway that controls its own traffic would steer the
+summary the coordinator sees.
+
+`mimic_latent_stats` moment-blends each adversary's (mean, cov) toward the
+victim's: the blended pair is the EXACT moment summary of a mixture that
+draws from the victim with probability `blend` — so blend=1.0 is perfect
+mimicry (statistically indistinguishable to ANY moments-based metric, the
+provable failure point DESIGN.md §21 documents) and intermediate blends
+model an attacker that can only partially shape its traffic. The defense
+this calibrates is assignment HYSTERESIS (cluster/assign.py
+refit_with_hysteresis): a refit only moves a gateway whose new-cluster JS
+beats its incumbent by a margin, so an imperfect mimic (blend < 1) keeps
+paying its residual divergence every refit and never flips.
+
+`assignment_capture_rate` is the attack-success metric the sweep grids:
+the fraction of the coalition the fit actually placed inside the victim
+cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+def mimic_latent_stats(means: np.ndarray, covs: np.ndarray,
+                       adv_ids: Sequence[int], victim_mu: np.ndarray,
+                       victim_cov: np.ndarray,
+                       blend: float) -> Tuple[np.ndarray, np.ndarray]:
+    """Blend the adversary rows of per-gateway latent stats toward the
+    victim's (new arrays; inputs untouched).
+
+    means [G, D] f32, covs [G, D, D] f32; victim_mu [D], victim_cov
+    [D, D]. The blended row is the moment summary of the mixture
+    blend·victim + (1-blend)·own: mean is the convex combination, cov is
+    the within-component blend PLUS the between-component spread
+    blend·(1-blend)·outer(Δμ) — dropping the spread term would understate
+    the mimic's variance and make the forgery EASIER to cluster-separate
+    than a real traffic blend, overselling the defense."""
+    if not 0.0 <= blend <= 1.0:
+        raise ValueError(f"blend must be in [0, 1], got {blend}")
+    means = np.array(means, np.float32, copy=True)
+    covs = np.array(covs, np.float32, copy=True)
+    victim_mu = np.asarray(victim_mu, np.float32)
+    victim_cov = np.asarray(victim_cov, np.float32)
+    for g in adv_ids:
+        dmu = victim_mu - means[g]
+        means[g] = blend * victim_mu + (1.0 - blend) * means[g]
+        covs[g] = (blend * victim_cov + (1.0 - blend) * covs[g]
+                   + blend * (1.0 - blend) * np.outer(dmu, dmu))
+    return means, covs
+
+
+def assignment_capture_rate(assignment: np.ndarray,
+                            adv_ids: Sequence[int],
+                            victim: int) -> float:
+    """Fraction of the coalition assigned to the victim cluster — the
+    cluster-poisoning attack's first-stage success metric."""
+    if len(adv_ids) == 0:
+        return 0.0
+    assignment = np.asarray(assignment)
+    inside = sum(1 for g in adv_ids if int(assignment[g]) == victim)
+    return inside / len(adv_ids)

@@ -1,0 +1,158 @@
+"""RedteamSpec: declarative adaptive-adversary description + defense knobs.
+
+The attack sweeps of PR 3 (federation/attack.py) model a *blind* poisoner:
+whoever is elected aggregator corrupts the broadcast, every round, for
+everyone. The subsystems that now make the decisions — cluster assignment
+(PR 15), the flywheel (PR 12), elastic membership (PR 10) — are attacked
+where they decide, by adversaries that READ the system state they target:
+
+  * ``cluster_poison`` — a coalition of gateway slots crafts latent
+    statistics the Gaussian-JS fit assigns to a victim cluster
+    (redteam/mimicry.py), then poisons from inside cluster-scoped
+    verification: their own submitted updates every scheduled round
+    (``update``-stage poison) and, whenever one of them wins the
+    election, the victim cluster's merged tree (``merge``-stage poison,
+    surgical — other clusters' rows untouched, so cross-cluster
+    observers see nothing);
+  * ``sybil`` — the same coalition arrives through elastic joins timed
+    to a quota cliff (incumbents' aggregation budgets exhausted, fresh
+    tenants quota-eligible), votes for its own members
+    (``lie_votes``), and captures the victim cluster's aggregation
+    quorum.
+
+The flywheel self-poisoning adversary lives host-side (redteam/traffic.py)
+because its attack surface is the serving stream, not the round program.
+
+Defense knobs ride the same spec so one object describes a measured
+attack-vs-defense cell:
+
+  * ``min_tenure`` — recycled tenants (generation > 0) may neither vote
+    nor be elected until they have been members for ``min_tenure``
+    consecutive rounds. Founding tenants are never gated, so a clean
+    elastic run only defers the votes of just-joined slots.
+
+Validation is eager (the AttackSpec/ChaosSpec idiom): every bad value
+raises at construction, never silently no-ops under jit. ``is_null``
+follows the PR 3 zero-probability contract — a null spec must compile to
+a program bit-identical to one built with no spec at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+REDTEAM_KINDS = ("none", "cluster_poison", "sybil")
+POISON_KINDS = ("scale", "sign_flip", "noise")
+
+
+@dataclasses.dataclass(frozen=True)
+class RedteamSpec:
+    """Adversary coalition + poison schedule + defense knobs.
+
+    The coalition is either the explicit ``adversaries`` tuple of ABSOLUTE
+    slot ids (padding/layout-invariant by construction) or a per-slot
+    bernoulli(``adversary_frac``) draw keyed ``fold_in(redteam_key, slot)``
+    — absolute-id keying, so the same slots are adversarial whatever the
+    pad width (PARITY §8). Poison fires on rounds ``start_round,
+    start_round + every_k, ...`` up to (exclusive) ``stop_round``.
+
+    ``victim_cluster`` scopes the merge-stage poison to one cluster's row
+    of the [K, ...] cluster trees (None = poison the whole merged tree,
+    the unclustered / indiscriminate shape). ``mimic_blend`` is the
+    moment-blend weight the host-side mimicry helper uses to steer the
+    coalition's latent statistics toward the victim's (1.0 = perfect
+    mimicry — the provable failure point of stats-based defenses,
+    DESIGN.md §21)."""
+
+    kind: str = "none"
+    adversaries: Optional[Tuple[int, ...]] = None
+    adversary_frac: float = 0.0
+    victim_cluster: Optional[int] = None
+    poison: str = "scale"
+    strength: float = 10.0
+    every_k: int = 1
+    start_round: int = 0
+    stop_round: Optional[int] = None
+    lie_votes: bool = False
+    mimic_blend: float = 0.0
+    # --- defense knobs ---
+    min_tenure: int = 0
+
+    def __post_init__(self):
+        if self.kind not in REDTEAM_KINDS:
+            raise ValueError(f"unknown redteam kind {self.kind!r}; "
+                             f"one of {REDTEAM_KINDS}")
+        if self.poison not in POISON_KINDS:
+            raise ValueError(f"unknown poison kind {self.poison!r}; "
+                             f"one of {POISON_KINDS}")
+        if not 0.0 <= self.adversary_frac <= 1.0:
+            raise ValueError("adversary_frac must be in [0, 1], got "
+                             f"{self.adversary_frac}")
+        if self.adversaries is not None:
+            if len(self.adversaries) == 0:
+                raise ValueError("adversaries, when given, must be a "
+                                 "non-empty tuple of absolute slot ids")
+            if any(a < 0 for a in self.adversaries):
+                raise ValueError(f"adversary slot ids must be >= 0, got "
+                                 f"{self.adversaries}")
+            if len(set(self.adversaries)) != len(self.adversaries):
+                raise ValueError(f"duplicate adversary slot ids: "
+                                 f"{self.adversaries}")
+        if self.kind != "none" and self.adversaries is None \
+                and self.adversary_frac == 0.0:
+            # an attack with no attackers would silently measure nothing
+            raise ValueError(f"kind={self.kind!r} needs a coalition: set "
+                             "adversaries or adversary_frac > 0")
+        if self.every_k < 1:
+            # traced mod-by-zero under jit is undefined, not an error
+            raise ValueError(f"every_k must be >= 1, got {self.every_k}")
+        if self.start_round < 0:
+            raise ValueError(
+                f"start_round must be >= 0, got {self.start_round}")
+        if self.stop_round is not None and self.stop_round <= self.start_round:
+            raise ValueError(
+                f"stop_round ({self.stop_round}) must be > start_round "
+                f"({self.start_round}); an empty window silently never "
+                f"attacks")
+        if self.victim_cluster is not None and self.victim_cluster < 0:
+            raise ValueError(
+                f"victim_cluster must be >= 0, got {self.victim_cluster}")
+        if not 0.0 <= self.mimic_blend <= 1.0:
+            raise ValueError(
+                f"mimic_blend must be in [0, 1], got {self.mimic_blend}")
+        if self.min_tenure < 0:
+            raise ValueError(
+                f"min_tenure must be >= 0, got {self.min_tenure}")
+
+    @property
+    def is_null(self) -> bool:
+        """True when the spec changes nothing: no adversary AND no defense
+        knob — the compiled program must be bit-identical to one built
+        with no redteam spec at all (tests/test_redteam.py pins this)."""
+        return self.kind == "none" and self.min_tenure == 0
+
+    @property
+    def attacks(self) -> bool:
+        """True when an adversary coalition exists (poison / vote hooks
+        must be compiled in)."""
+        return self.kind != "none"
+
+    def signature(self) -> str:
+        """Canonical string for checkpoint-compat validation (the
+        ElasticSpec idiom: JSON-stable, suffixes only for non-defaults so
+        pre-existing checkpoints keep their signatures)."""
+        adv = ("-" if self.adversaries is None
+               else ".".join(str(a) for a in self.adversaries))
+        sig = (f"k{self.kind}a{adv}f{self.adversary_frac:g}"
+               f"p{self.poison}x{self.strength:g}e{self.every_k}"
+               f"s{self.start_round}t{self.stop_round}")
+        if self.victim_cluster is not None:
+            sig += f"v{self.victim_cluster}"
+        if self.lie_votes:
+            sig += "L"
+        if self.mimic_blend:
+            sig += f"b{self.mimic_blend:g}"
+        if self.min_tenure:
+            sig += f"n{self.min_tenure}"
+        return sig

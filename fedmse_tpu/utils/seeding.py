@@ -42,6 +42,15 @@ CHAOS_STREAM_TAG = 0x4348414F  # "CHAO"
 # (tests/test_elastic.py pins the separation like test_chaos.py does).
 ELASTIC_STREAM_TAG = 0x454C4153  # "ELAS"
 
+# Domain tag for the red-team adversary stream (fedmse_tpu/redteam/):
+# adversary-slot draws and poison noise come from
+# fold_in(jax_root, REDTEAM_STREAM_TAG), separated from training / eval /
+# selection AND from the chaos + elastic streams — enabling an adversary
+# perturbs no honest draw, and composing redteam with chaos/elastic leaves
+# all three fault streams bit-identical to running each alone
+# (tests/test_redteam.py pins the separation like test_chaos.py does).
+REDTEAM_STREAM_TAG = 0x52454454  # "REDT"
+
 
 def fold_in_keys(key: jax.Array, n: int) -> jax.Array:
     """[n] per-index keys `fold_in(key, i)` — the ONE home of the
@@ -105,6 +114,15 @@ class ExperimentRngs:
         and per-run membership streams are independent across the batched
         runs axis (federation/elastic.py make_batched_membership_masks)."""
         return jax.random.fold_in(self.jax_root, ELASTIC_STREAM_TAG)
+
+    def redteam_key(self) -> jax.Array:
+        """Root of this run's domain-separated adversary stream (see
+        REDTEAM_STREAM_TAG). Same contract as `chaos_key` / `elastic_key`:
+        a pure fold of the run root — calling it consumes nothing, so
+        adversary-slot selection and poison noise cannot perturb
+        model-init / tie-break / selection / chaos / elastic draws
+        (fedmse_tpu/redteam/masks.py make_redteam_masks)."""
+        return jax.random.fold_in(self.jax_root, REDTEAM_STREAM_TAG)
 
     def next_jax_batch(self, n: int) -> jax.Array:
         """A [n]-stacked key array identical to n successive `next_jax()`

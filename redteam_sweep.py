@@ -1,0 +1,730 @@
+"""Redteam attack-success-vs-defense grids (ISSUE 17): the measurement
+half of fedmse_tpu/redteam/ (DESIGN.md §21, ROADMAP item 5).
+
+The PR 3 threat model (ATTACK_r05.json) stops at static update poisoning
+of the single-global federation. This sweep attacks the three decision
+surfaces grown since, each with an ADAPTIVE adversary that reads the
+defender's state, and measures the paired defense's bite AND its clean
+cost:
+
+  * **cluster-assignment poisoning** — insiders inside a victim cluster
+    scale-poison their updates (harm cell: honest co-members' AUC), and
+    mimics FORGE their latent statistics toward the victim's pooled
+    Gaussian to be captured into its merge (mimic_latent_stats, blend
+    grid). Defense: assignment hysteresis (refit_with_hysteresis) — a
+    gateway moves only when the alternative is decisively closer, so
+    partial forgeries stall at the margin. The sweep records where the
+    defense provably fails: blend=1.0 IS the victim's Gaussian, and no
+    stats-based assignment can tell forged from genuine.
+  * **flywheel slow-drift self-poisoning** — SlowDriftAdversary walks
+    its traffic toward a target, step-by-step, keeping each batch just
+    inside the verdict envelope; every threshold refit over the
+    poisoned reservoir ratchets the envelope toward the adversary (the
+    self-poisoning feedback loop). Defense: reservoir admission
+    hardening (FlywheelBuffer margin_frac floor + influence_cap). The
+    detector here is the analytic distance-to-calibrated-centroid
+    scorer: the attack and defense live entirely in the ADMISSION
+    POLICY (scores vs thresholds), so detector realism is orthogonal to
+    what the cell measures.
+  * **sybil churn** — a coalition rides elastic joins into the fleet
+    and votes for its own candidates (lie_votes): election capture.
+    Defense: the tenure gate (min_tenure defers recycled tenants'
+    candidacy + votes). A paired probe measures the verification
+    recovery-waiver abuse the PR 1 CAVEAT predicted — repeated
+    large-delta broadcasts each accepted as "recovery" — against the
+    cumulative recovery_budget ceiling (config.recovery_budget).
+
+Clean-cost rows pin that the defenses are free when nobody attacks:
+defenses-off is BITWISE identical to no-redteam (null-spec pin), and
+each defense's clean AUC delta is bounded (<= 2e-3; the tenure gate's
+residual cost is measured in deferred elections).
+
+Writes REDTEAM.json (override with --out); one JSON line per row.
+Run: `make redteam-sweep` (env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+python redteam_sweep.py --out REDTEAM_r17.json). Hermetic CPU like the
+tests.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+DIM = 16
+ROUNDS = 6
+CLEAN_AUC_EPS = 2e-3
+
+
+def base_cfg(**kw):
+    from fedmse_tpu.config import CompatConfig, ExperimentConfig
+    base = dict(
+        dim_features=DIM, hidden_neus=12, latent_dim=5, epochs=6,
+        batch_size=16, num_rounds=ROUNDS, network_size=8,
+        compat=CompatConfig(vote_tie_break=False))
+    base.update(kw)
+    return ExperimentConfig(**base)
+
+
+def build_typed_grid(cfg, n_clients=8, types=2, seed=11):
+    from fedmse_tpu.data import build_dev_dataset, stack_clients
+    from fedmse_tpu.data.synthetic import synthetic_typed_clients
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+    clients = synthetic_typed_clients(
+        n_clients=n_clients, types=types, dim=cfg.dim_features,
+        n_normal=200, n_abnormal=80, seed=seed)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(
+        run=0, data_seed=cfg.data_seed).data_rng)
+    return stack_clients(clients, dev_x, cfg.batch_size), len(clients)
+
+
+def build_plain_grid(cfg, n_clients, seed=0):
+    from fedmse_tpu.data import (build_dev_dataset, stack_clients,
+                                 synthetic_clients)
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+    del seed
+    clients = synthetic_clients(n_clients=n_clients, dim=cfg.dim_features,
+                                n_normal=160, n_abnormal=64)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(
+        run=0, data_seed=cfg.data_seed).data_rng)
+    return stack_clients(clients, dev_x, cfg.batch_size), len(clients)
+
+
+def run_cell(cfg, data, n_real, spec=None, elastic=None, redteam=None,
+             model_type="autoencoder", label="cell"):
+    """One federation; returns (per-gateway final AUC, results, engine)."""
+    import numpy as np
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.models import make_model
+    from fedmse_tpu.parallel import host_fetch
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    model = make_model(model_type, cfg.dim_features, cfg.hidden_neus,
+                       cfg.latent_dim, shrink_lambda=cfg.shrink_lambda)
+    engine = RoundEngine(model, cfg, data, n_real=n_real,
+                         rngs=ExperimentRngs(run=0, data_seed=cfg.data_seed),
+                         model_type=model_type, update_type="mse_avg",
+                         fused=True, cluster=spec, elastic=elastic,
+                         redteam=redteam)
+    results, _, _ = engine.run_schedule_chunk(0, cfg.num_rounds)
+    final = np.asarray(host_fetch(engine.evaluate_all(
+        engine.states.params, data.test_x, data.test_m, data.test_y,
+        data.train_xb, data.train_mb)))[:n_real]
+    return final, results, engine
+
+
+# ------------------------------------------------- defenses-off pin ----
+
+def defenses_off_pin():
+    """RedteamSpec() (null) vs no spec at all: states bitwise after a
+    short schedule — defenses off costs literally nothing."""
+    import numpy as np
+    import jax
+    from fedmse_tpu.redteam import RedteamSpec
+
+    cfg = base_cfg(num_rounds=3)
+    data, n_real = build_plain_grid(cfg, 6)
+    _, _, plain = run_cell(cfg, data, n_real, label="pin-plain")
+    _, _, null = run_cell(cfg, data, n_real, redteam=RedteamSpec(),
+                          label="pin-null")
+    bit = all(np.array_equal(np.asarray(a), np.asarray(b))
+              for a, b in zip(jax.tree.leaves(plain.states),
+                              jax.tree.leaves(null.states)))
+    return {"label": "defenses_off_bitwise_pin",
+            "states_bit_identical": bool(bit)}
+
+
+# ------------------------------------- A. cluster-assignment poisoning ----
+
+def cluster_cells():
+    import numpy as np
+    from fedmse_tpu.cluster import ClusterSpec, refit_with_hysteresis
+    from fedmse_tpu.redteam import (RedteamSpec, assignment_capture_rate,
+                                    mimic_latent_stats)
+
+    rows = []
+    cfg = base_cfg()
+    data, n_real = build_typed_grid(cfg)
+    spec = ClusterSpec(k=2)
+
+    # clean clustered baseline: the fit the mimics will forge against
+    clean, _, eng = run_cell(cfg, data, n_real, spec=spec, label="clean-k2")
+    fit = eng.cluster_fit
+    assignment = fit.assignment
+    victim = int(assignment[0])
+    members = np.flatnonzero(assignment == victim)
+    outsiders = np.flatnonzero(assignment != victim)
+
+    # ---- insider poison harm: 2 victim-cluster insiders scale their
+    # updates; success = honest co-members' AUC drop ----
+    insiders = tuple(int(i) for i in members[:2])
+    honest = np.setdiff1d(members, insiders)
+    atk = RedteamSpec(kind="cluster_poison", adversaries=insiders,
+                      victim_cluster=victim, poison="scale", strength=8.0)
+    poisoned, _, _ = run_cell(cfg, data, n_real, spec=spec, redteam=atk,
+                              label="insider-poison")
+    harm = float(np.nanmean(clean[honest]) - np.nanmean(poisoned[honest]))
+    rows.append({
+        "label": "cluster/insider_poison_harm",
+        "victim_cluster": victim, "insiders": list(insiders),
+        "honest_members": honest.tolist(),
+        "clean_auc_honest": round(float(np.nanmean(clean[honest])), 4),
+        "poisoned_auc_honest": round(float(np.nanmean(poisoned[honest])), 4),
+        "undefended_auc_drop": round(harm, 4),
+        "outsider_auc_delta": round(
+            float(np.nanmean(clean[outsiders])
+                  - np.nanmean(poisoned[outsiders])), 4),
+    })
+
+    # ---- mimicry capture vs hysteresis: outsiders forge their latent
+    # stats toward the victim's pooled Gaussian; the refit either takes
+    # the bait (h=0, plain nearest-reference moves) or holds (h=0.5).
+    # Below blend ~0.8 the forgers' own residue drags their OWN pooled
+    # reference toward the victim (self-contamination keeps them home);
+    # the capture window opens at ~0.8 — exactly where hysteresis holds
+    # and plain refits flip ----
+    adv_ids = tuple(int(i) for i in outsiders[:2])
+    blend_rows = {}
+    for blend in (0.7, 0.8, 1.0):
+        fm, fc = mimic_latent_stats(fit.means, fit.covs, adv_ids,
+                                    fit.cl_means[victim],
+                                    fit.cl_covs[victim], blend)
+        cell = {}
+        for h in (0.0, 0.5):
+            out = refit_with_hysteresis(fm, fc, assignment, spec.k, h)
+            cell[h] = assignment_capture_rate(out.assignment, adv_ids,
+                                              victim)
+        blend_rows[blend] = cell
+    undef = blend_rows[0.8][0.0]
+    defended = blend_rows[0.8][0.5]
+    rows.append({
+        "label": "cluster/mimicry_capture",
+        "adversaries": list(adv_ids), "victim_cluster": victim,
+        "capture_by_blend": {
+            str(b): {"undefended_h0": c[0.0], "hysteresis_h0.5": c[0.5]}
+            for b, c in blend_rows.items()},
+        "undefended_capture_at_0.8": undef,
+        "defended_capture_at_0.8": defended,
+        "provable_failure": "blend=1.0 equals the victim's pooled "
+                            "Gaussian exactly; capture_by_blend['1.0'] "
+                            "shows hysteresis cannot (and should not "
+                            "claim to) separate a perfect forgery",
+    })
+
+    # ---- clean cost: hysteresis on a refitting clean run ----
+    h_cfg = cfg
+    c0, _, _ = run_cell(h_cfg, data, n_real,
+                        spec=ClusterSpec(k=2, refit_every=2),
+                        label="clean-h0")
+    c1, _, _ = run_cell(h_cfg, data, n_real,
+                        spec=ClusterSpec(k=2, refit_every=2,
+                                         hysteresis=0.5),
+                        label="clean-h0.5")
+    clean_delta = float(abs(np.nanmean(c0) - np.nanmean(c1)))
+    rows.append({
+        "label": "cluster/hysteresis_clean_cost",
+        "clean_auc_h0": round(float(np.nanmean(c0)), 4),
+        "clean_auc_h0.5": round(float(np.nanmean(c1)), 4),
+        "clean_auc_delta": round(clean_delta, 6),
+    })
+    return rows, {
+        "undefended_capture": undef, "defended_capture": defended,
+        "insider_auc_drop": harm, "clean_auc_delta": clean_delta,
+    }
+
+
+# --------------------------------------- B. flywheel slow-drift loop ----
+
+def drift_loop(margin_frac, steps=60, refit_every=3, seed=3):
+    """The closed self-poisoning loop: serve -> verdict -> admit ->
+    threshold refit over the reservoir -> serve. The adversary observes
+    only its own verdicts (normal_fraction); the defender's margin floor
+    decides which of the verdicted-normal rows may enter the reservoir
+    that the NEXT threshold is fitted from. Calibration is mean+3*std of
+    the pool's scores (the extrapolating envelope a real refit uses —
+    the statistic that makes self-poisoning POSSIBLE: near-threshold
+    admissions widen the fitted spread, and the envelope walks),
+    floored at the audited seed calibration: the envelope never SHRINKS
+    on unaudited reservoir data (shrinkage is the verdict-inversion
+    vector, and the floor also cancels the margin filter's truncation
+    bias on the fitted std — measured in the clean-cost row)."""
+    import numpy as np
+    from fedmse_tpu.flywheel.buffer import FlywheelBuffer
+    from fedmse_tpu.redteam import SlowDriftAdversary, normal_fraction
+
+    rng = np.random.default_rng(seed)
+    mu0 = np.zeros(DIM, np.float32)
+    target = np.zeros(DIM, np.float32)
+    target[0] = 8.0
+
+    def score(x):
+        return np.mean((x - mu0) ** 2, axis=1)
+
+    def fit(pool_scores):
+        return float(pool_scores.mean() + 3.0 * pool_scores.std())
+
+    seed_rows = rng.normal(mu0, 1.0, (512, DIM)).astype(np.float32)
+    thr = [fit(score(seed_rows))]
+    thr0 = thr[0]
+
+    buf = FlywheelBuffer(
+        1, DIM, capacity=8192,
+        margin_frac=margin_frac,
+        thresholds_fn=(lambda: np.array([thr[0]]))
+        if margin_frac is not None else None)
+    adv = SlowDriftAdversary(mu0, target, seed=seed, step=0.05)
+    admitted_adv = 0
+    for t in range(steps):
+        batch = adv.next_batch(64)
+        sc = score(batch)
+        verdicts = sc > thr[0]                       # True = anomalous
+        adv.observe(normal_fraction(verdicts))
+        admitted_adv += buf.admit(batch, np.zeros(len(batch), np.int64),
+                                  verdicts=verdicts, scores=sc)
+        if (t + 1) % refit_every == 0:
+            # refit over calibration seed + everything the reservoir
+            # admitted — the flywheel's refit_calibration dataflow
+            pool = np.concatenate([seed_rows, buf.rows_for(0)])
+            thr[0] = max(fit(score(pool)), thr0)
+    return {
+        "final_position": round(adv.position, 4),
+        "threshold_inflation": round(thr[0] / thr0, 4),
+        "admitted_adversarial_rows": int(admitted_adv),
+        "reservoir_rows": int(buf.count[0]),
+    }
+
+
+def flywheel_cells():
+    import numpy as np
+    from fedmse_tpu.flywheel.buffer import FlywheelBuffer
+
+    rows = []
+    undef = drift_loop(margin_frac=None)
+    defended = drift_loop(margin_frac=0.7)
+    rows.append({
+        "label": "flywheel/slow_drift_self_poisoning",
+        "undefended": undef, "margin_frac_0.7": defended,
+        "note": "undefended, every near-threshold batch the verdicts "
+                "pass enters the refit pool and ratchets the envelope "
+                "until the adversary reaches its target; the margin "
+                "floor admits only rows well inside the envelope, so "
+                "the refit pool cannot walk and the adversary stalls at "
+                "the FIXED envelope's operating point",
+    })
+
+    # ---- influence cap: a flooding gateway's share of finetune rows ----
+    lens = {}
+    for cap in (None, 0.34):
+        rng = np.random.default_rng(0)
+        buf = FlywheelBuffer(3, DIM, capacity=1024, influence_cap=cap)
+        buf.admit(rng.normal(size=(400, DIM)), np.full(400, 0))
+        buf.admit(rng.normal(size=(60, DIM)), np.full(60, 1))
+        buf.admit(rng.normal(size=(60, DIM)), np.full(60, 2))
+        ft = buf.build_finetune_data(
+            16, dev_x=np.zeros((8, DIM), np.float32), min_rows=8)
+        n = [len(r) for r in ft.train_rows]
+        lens[cap] = {"rows_per_gateway": n,
+                     "flooder_share": round(n[0] / max(1, sum(n)), 4)}
+    rows.append({
+        "label": "flywheel/influence_cap",
+        "uncapped": lens[None], "cap_0.34": lens[0.34],
+    })
+
+    # ---- clean cost: drift-free traffic, margin on vs off; detector
+    # verdict accuracy on held-out normals vs fixed anomalies after the
+    # loop (same mean+3*std calibration as the attack cell) ----
+    rng = np.random.default_rng(9)
+    mu0 = np.zeros(DIM, np.float32)
+
+    def fit(pool_scores):
+        return float(pool_scores.mean() + 3.0 * pool_scores.std())
+
+    def clean_loop(margin):
+        seed_rows = rng.normal(mu0, 1.0, (512, DIM)).astype(np.float32)
+        thr0 = fit(np.mean(seed_rows ** 2, axis=1))
+        thr = [thr0]
+        buf = FlywheelBuffer(
+            1, DIM, capacity=4096, margin_frac=margin,
+            thresholds_fn=(lambda: np.array([thr[0]]))
+            if margin is not None else None)
+        streamed = admitted = 0
+        for t in range(20):
+            batch = rng.normal(mu0, 1.0, (64, DIM)).astype(np.float32)
+            sc = np.mean(batch ** 2, axis=1)
+            verd = sc > thr[0]
+            streamed += int((~verd).sum())
+            admitted += buf.admit(batch, np.zeros(64, np.int64),
+                                  verdicts=verd, scores=sc)
+            if (t + 1) % 5 == 0:
+                pool = np.concatenate([seed_rows, buf.rows_for(0)])
+                thr[0] = max(fit(np.mean(pool ** 2, axis=1)), thr0)
+        return thr[0], admitted / max(1, streamed)
+
+    eval_rng = np.random.default_rng(123)
+    normals = eval_rng.normal(mu0, 1.0, (512, DIM)).astype(np.float32)
+    anoms = (eval_rng.normal(mu0, 1.0, (512, DIM)).astype(np.float32)
+             + 1.2)
+
+    def auc_at(thr):
+        # threshold-free ranking AUC is margin-invariant here (the
+        # scorer is fixed); the defense can only shift the THRESHOLD, so
+        # the clean-cost AUC axis is the verdict accuracy at thr
+        sn = np.mean(normals ** 2, axis=1) > thr
+        sa = np.mean(anoms ** 2, axis=1) > thr
+        return 0.5 * ((~sn).mean() + sa.mean())
+
+    thr_off, ret_off = clean_loop(None)
+    thr_on, ret_on = clean_loop(0.7)
+    clean_delta = float(abs(auc_at(thr_on) - auc_at(thr_off)))
+    rows.append({
+        "label": "flywheel/margin_clean_cost",
+        "threshold_margin_off": round(thr_off, 4),
+        "threshold_margin_on": round(thr_on, 4),
+        "clean_admission_retention": round(ret_on / max(ret_off, 1e-9), 4),
+        "clean_verdict_auc_delta": round(clean_delta, 6),
+    })
+    return rows, {
+        "undefended_position": undef["final_position"],
+        "defended_position": defended["final_position"],
+        "undefended_inflation": undef["threshold_inflation"],
+        "defended_inflation": defended["threshold_inflation"],
+        "flooder_share_uncapped": lens[None]["flooder_share"],
+        "flooder_share_capped": lens[0.34]["flooder_share"],
+        "clean_auc_delta": clean_delta,
+    }
+
+
+# ------------------------------------------------- C. sybil churn ----
+
+def sybil_cells():
+    import numpy as np
+    from fedmse_tpu.federation.elastic import ElasticSpec
+    from fedmse_tpu.redteam import RedteamSpec
+
+    rows = []
+    cfg = base_cfg(network_size=12, num_rounds=16)
+    data, n_real = build_plain_grid(cfg, 12)
+    # the join blitz: half the fleet are founders, the other half's
+    # slots open at round 8 and fill fast — the coalition rides the
+    # wave in and immediately bids for the coordinator role
+    elastic = ElasticSpec(leave_p=0.0, join_p=0.9,
+                          initial_member_frac=0.5,
+                          join_window=(8, None))
+
+    # scout the (redteam-independent) elastic timeline: the coalition
+    # is exactly the slots the wave recycles
+    clean, clean_res, scout = run_cell(cfg, data, n_real, elastic=elastic,
+                                       label="sybil-scout")
+    scout._elastic_masks(0, cfg.num_rounds)
+    gen = np.asarray(scout._elastic_premade.generation)[:, :n_real]
+    recycled = np.flatnonzero(gen.max(axis=0) > 0)
+    adv_ids = tuple(int(i) for i in recycled)
+
+    blitz_start = 8
+
+    def capture(results, start=0):
+        agg_rounds = [r.aggregator for r in results[start:]
+                      if r.aggregator is not None]
+        if not agg_rounds:
+            return 0.0, 0
+        hits = sum(1 for a in agg_rounds if a in adv_ids)
+        return hits / len(agg_rounds), len(agg_rounds)
+
+    cells = {}
+    for name, spec in (
+            ("undefended", RedteamSpec(kind="sybil", adversaries=adv_ids,
+                                       lie_votes=True)),
+            ("min_tenure_6", RedteamSpec(kind="sybil", adversaries=adv_ids,
+                                         lie_votes=True, min_tenure=6))):
+        auc, results, _ = run_cell(cfg, data, n_real, elastic=elastic,
+                                   redteam=spec, label=f"sybil-{name}")
+        rate, n_agg = capture(results)
+        wrate, wn = capture(results, blitz_start)
+        cells[name] = {"capture_rate": round(rate, 4),
+                       "capture_rate_post_blitz": round(wrate, 4),
+                       "aggregated_rounds": n_agg,
+                       "aggregated_rounds_post_blitz": wn,
+                       "auc_mean": round(float(np.nanmean(auc)), 4)}
+    base_rate, _ = capture(clean_res)
+    base_wrate, _ = capture(clean_res, blitz_start)
+    rows.append({
+        "label": "sybil/election_capture",
+        "adversaries": list(adv_ids),
+        "recycled_slots": recycled.tolist(),
+        "blitz_start_round": blitz_start,
+        "honest_baseline_capture": round(base_rate, 4),
+        "honest_baseline_capture_post_blitz": round(base_wrate, 4),
+        **cells,
+        "note": "before the blitz no coalition slot is even a member — "
+                "the post-blitz rates are the attack's operating window",
+    })
+
+    # ---- clean cost: the tenure gate on an HONEST churning fleet ----
+    defonly, defres, _ = run_cell(
+        cfg, data, n_real, elastic=elastic,
+        redteam=RedteamSpec(min_tenure=6), label="sybil-defonly")
+    deferred = sum(
+        1 for a, b in zip(clean_res, defres)
+        if (a.aggregator is None) != (b.aggregator is None)
+        or (a.aggregator is not None and a.aggregator != b.aggregator))
+    clean_delta = float(abs(np.nanmean(clean) - np.nanmean(defonly)))
+    rows.append({
+        "label": "sybil/tenure_gate_clean_cost",
+        "clean_auc": round(float(np.nanmean(clean)), 4),
+        "defense_only_auc": round(float(np.nanmean(defonly)), 4),
+        "clean_auc_delta": round(clean_delta, 6),
+        "elections_changed": deferred,
+        "note": "the gate defers recycled tenants' candidacy+votes even "
+                "when honest; its residual cost is the elections it "
+                "re-routes, bounded by the join rate",
+    })
+    return rows, {
+        "undefended_capture": cells["undefended"]["capture_rate_post_blitz"],
+        "defended_capture": cells["min_tenure_6"]["capture_rate_post_blitz"],
+        "honest_baseline": base_wrate,
+        "clean_auc_delta": clean_delta,
+    }
+
+
+# --------------------------------- verification recovery-waiver abuse ----
+
+def waiver_abuse_cell():
+    """The PR 1 CAVEAT weaponized: an adversary controlling broadcasts
+    ships a SEQUENCE of large-delta models, each individually passing
+    the recovery waiver — undefended, the cumulative accepted Frobenius
+    influence grows linearly; recovery_budget caps it."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from fedmse_tpu.federation.state import init_client_states
+    from fedmse_tpu.federation.verification import make_verify_fn
+    from fedmse_tpu.models import make_model
+
+    n, probes = 4, 6
+    model = make_model("hybrid", DIM)
+    states0 = init_client_states(model, optax.adam(1e-3),
+                                 jax.random.key(0), n)
+    states0 = type(states0)(
+        params=states0.params, opt_state=states0.opt_state,
+        prev_global=states0.prev_global, hist_params=states0.hist_params,
+        hist_perf=states0.hist_perf, hist_seen=jnp.ones((n,), bool),
+        rejected=states0.rejected, waived=states0.waived)
+    common = dict(verification_threshold=1e-6, performance_threshold=10.0,
+                  hardened=True, recovery_threshold=-1.0,
+                  recovery_delta_cap=1e9)
+    ver_x = jnp.zeros((n, 6, DIM))
+    ver_m = jnp.ones((n, 6))
+    aggs = [jax.tree.map(lambda t, r=r: t[0] + 0.5 * (r + 1),
+                         states0.params) for r in range(probes)]
+
+    def run(budget):
+        verify = make_verify_fn(model, recovery_budget=budget, **common)
+        states, accepted = states0, 0
+        for agg in aggs:
+            out = verify(states, agg, ver_x, ver_m, jnp.zeros((n,)),
+                         jnp.ones((n,)))
+            accepted += int(np.asarray(out.accepted).sum())
+            states = out.states
+        return accepted, float(np.asarray(states.waived).max())
+
+    acc_off, waived_off = run(None)
+    budget = waived_off / probes * 1.5          # ~1.5 probes' worth
+    acc_on, waived_on = run(budget)
+    return {
+        "label": "verification/recovery_waiver_abuse",
+        "probes": probes,
+        "undefended": {"accepted": acc_off,
+                       "cumulative_waived_frobenius": round(waived_off, 4)},
+        "recovery_budget": round(budget, 4),
+        "defended": {"accepted": acc_on,
+                     "cumulative_waived_frobenius": round(waived_on, 4)},
+    }, {
+        "undefended_waived": waived_off,
+        "defended_waived": waived_on,
+        "budget": budget,
+    }
+
+
+def quick_cell():
+    """Reduced redteam guard for bench_suite scenario 19: the
+    defenses-off bitwise pin, one mimicry capture point (blend 0.8,
+    plain refit vs hysteresis 0.5) and the reservoir margin-floor
+    admission bound. The committed standalone artifact
+    (make redteam-sweep -> REDTEAM_r17.json) carries the full blend
+    grids, the drift loop, sybil blitz and waiver-abuse cells."""
+    import numpy as np
+    from fedmse_tpu.cluster import ClusterSpec, refit_with_hysteresis
+    from fedmse_tpu.flywheel.buffer import FlywheelBuffer
+    from fedmse_tpu.redteam import assignment_capture_rate, mimic_latent_stats
+
+    pin = defenses_off_pin()["states_bit_identical"]
+
+    cfg = base_cfg()
+    data, n_real = build_typed_grid(cfg)
+    spec = ClusterSpec(k=2)
+    _, _, eng = run_cell(cfg, data, n_real, spec=spec, label="quick-clean")
+    fit = eng.cluster_fit
+    victim = int(fit.assignment[0])
+    adv_ids = tuple(int(i)
+                    for i in np.flatnonzero(fit.assignment != victim)[:2])
+    fm, fc = mimic_latent_stats(fit.means, fit.covs, adv_ids,
+                                fit.cl_means[victim], fit.cl_covs[victim],
+                                0.8)
+    undef = assignment_capture_rate(
+        refit_with_hysteresis(fm, fc, fit.assignment, spec.k,
+                              0.0).assignment, adv_ids, victim)
+    defended = assignment_capture_rate(
+        refit_with_hysteresis(fm, fc, fit.assignment, spec.k,
+                              0.5).assignment, adv_ids, victim)
+
+    # margin floor: of four near-threshold verdicted-normal rows, only
+    # the ones below thr * (1 - margin) may enter the refit reservoir
+    thr = np.array([1.0], np.float32)
+    buf = FlywheelBuffer(1, DIM, capacity=64, margin_frac=0.5,
+                         thresholds_fn=lambda: thr)
+    sc = np.array([0.2, 0.9, 0.4, 0.51], np.float32)
+    admitted = buf.admit(np.zeros((4, DIM), np.float32),
+                         np.zeros(4, np.int64),
+                         verdicts=np.zeros(4, bool), scores=sc)
+
+    ok = bool(pin and undef >= 0.5 and defended <= 0.5 * undef
+              and admitted == 2)
+    return {"defenses_off_bitwise": bool(pin),
+            "mimicry_blend_0.8": {"undefended_capture": undef,
+                                  "hysteresis_0.5_capture": defended},
+            "margin_floor_admitted": {"scores": sc.tolist(),
+                                      "threshold": 1.0, "margin_frac": 0.5,
+                                      "admitted": int(admitted)},
+            "acceptance_met": ok}
+
+
+def main():
+    from fedmse_tpu.utils.platform import (capture_provenance,
+                                           enable_compilation_cache)
+    enable_compilation_cache()
+    capture_provenance()
+    import jax
+
+    t0 = time.time()
+    rows = []
+
+    def emit(row):
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        return row
+
+    pin = emit(defenses_off_pin())
+    cl_rows, cl = cluster_cells()
+    for r in cl_rows:
+        emit(r)
+    fw_rows, fw = flywheel_cells()
+    for r in fw_rows:
+        emit(r)
+    sy_rows, sy = sybil_cells()
+    for r in sy_rows:
+        emit(r)
+    wv_row, wv = waiver_abuse_cell()
+    emit(wv_row)
+
+    def factor(a, b, floor=1e-9):
+        return round(a / max(b, floor), 2)
+
+    acceptance = {
+        "bar": "each adversary's undefended success quantified; the "
+               "paired defense cuts it by the stated factor; clean AUC "
+               "deltas <= 2e-3; defenses-off bitwise-identical to "
+               "no-redteam",
+        "defenses_off_bitwise": pin["states_bit_identical"],
+        "cluster": {
+            "undefended_capture": cl["undefended_capture"],
+            "defended_capture": cl["defended_capture"],
+            "defense_factor": factor(cl["undefended_capture"],
+                                     cl["defended_capture"]),
+            "insider_auc_drop": round(cl["insider_auc_drop"], 4),
+            "clean_auc_delta": round(cl["clean_auc_delta"], 6),
+            "met": bool(cl["undefended_capture"] >= 0.5
+                        and cl["defended_capture"]
+                        <= 0.5 * cl["undefended_capture"]
+                        and cl["clean_auc_delta"] <= CLEAN_AUC_EPS),
+        },
+        "flywheel": {
+            "undefended_position": fw["undefended_position"],
+            "defended_position": fw["defended_position"],
+            "defense_factor": factor(fw["undefended_position"],
+                                     fw["defended_position"]),
+            "threshold_inflation": {
+                "undefended": fw["undefended_inflation"],
+                "defended": fw["defended_inflation"]},
+            "flooder_share": {
+                "uncapped": fw["flooder_share_uncapped"],
+                "capped": fw["flooder_share_capped"]},
+            "clean_auc_delta": round(fw["clean_auc_delta"], 6),
+            # the success axis is the SELF-POISONING itself — how far the
+            # envelope walked (inflation - 1); the defended stall
+            # position is the fixed envelope's intrinsic operating
+            # point, not a defense failure
+            "met": bool(fw["undefended_inflation"] >= 1.5
+                        and abs(fw["defended_inflation"] - 1.0)
+                        <= 0.2 * (fw["undefended_inflation"] - 1.0)
+                        and fw["defended_position"]
+                        < fw["undefended_position"]
+                        and fw["flooder_share_capped"]
+                        < fw["flooder_share_uncapped"]
+                        and fw["clean_auc_delta"] <= CLEAN_AUC_EPS),
+        },
+        "sybil": {
+            "undefended_capture": sy["undefended_capture"],
+            "defended_capture": sy["defended_capture"],
+            "honest_baseline": sy["honest_baseline"],
+            "defense_factor": factor(sy["undefended_capture"],
+                                     sy["defended_capture"]),
+            "clean_auc_delta": round(sy["clean_auc_delta"], 6),
+            "met": bool(sy["undefended_capture"] > sy["honest_baseline"]
+                        and sy["defended_capture"]
+                        <= 0.5 * sy["undefended_capture"]
+                        and sy["clean_auc_delta"] <= CLEAN_AUC_EPS),
+        },
+        "waiver": {
+            "undefended_waived": round(wv["undefended_waived"], 4),
+            "defended_waived": round(wv["defended_waived"], 4),
+            "budget": round(wv["budget"], 4),
+            "met": bool(wv["defended_waived"]
+                        <= 0.5 * wv["undefended_waived"]),
+        },
+    }
+    acceptance["met"] = bool(
+        acceptance["defenses_off_bitwise"]
+        and acceptance["cluster"]["met"] and acceptance["flywheel"]["met"]
+        and acceptance["sybil"]["met"] and acceptance["waiver"]["met"])
+
+    device = jax.devices()[0]
+    out = {
+        "metric": "attack success rate vs measured defense across the "
+                  "cluster / flywheel / elastic decision surfaces "
+                  "(DESIGN.md §21)",
+        "rows": rows,
+        "acceptance": acceptance,
+        "total_seconds": round(time.time() - t0, 1),
+        "device": str(device), "platform": device.platform,
+        **capture_provenance(),
+    }
+    dest = "REDTEAM.json"
+    for i, a in enumerate(sys.argv):
+        if a == "--out" and i + 1 < len(sys.argv):
+            dest = sys.argv[i + 1]
+        elif a.startswith("--out="):
+            dest = a.split("=", 1)[1]
+    with open(dest, "w") as f:
+        f.write(json.dumps(out) + "\n")
+    print(json.dumps({"wrote": dest, "acceptance_met": acceptance["met"]}))
+
+
+if __name__ == "__main__":
+    main()

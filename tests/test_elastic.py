@@ -291,7 +291,8 @@ def test_join_inherits_global_and_zeroes_moments():
         hist_params=jax.tree.map(lambda t: poison(t, 3.0), st.hist_params),
         hist_perf=poison(st.hist_perf, 5.0),
         hist_seen=poison(st.hist_seen, True),
-        rejected=poison(st.rejected, 7))
+        rejected=poison(st.rejected, 7),
+        waived=poison(st.waived, 9.0))
     incumbent_means = [np.asarray(t)[[i for i in range(N) if i != j]].mean(0)
                        for t in jax.tree.leaves(st.params)]
 
@@ -355,7 +356,7 @@ def test_leave_zeroes_moments_only():
     st = type(st)(params=st.params, opt_state=ones_opt,
                   prev_global=st.prev_global, hist_params=st.hist_params,
                   hist_perf=st.hist_perf, hist_seen=st.hist_seen,
-                  rejected=st.rejected)
+                  rejected=st.rejected, waived=st.waived)
     el = MembershipMasks(
         member=jax.numpy.asarray(
             (np.arange(N) != leaver).astype(np.float32)),

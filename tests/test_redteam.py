@@ -1,0 +1,582 @@
+"""Redteam adversaries + measured defenses (fedmse_tpu/redteam/,
+DESIGN.md §21), with the acceptance contracts pinned:
+
+  * a NULL RedteamSpec produces a program bit-identical to no spec at
+    all (states pinned across dense; the tiered layout accepts only a
+    null spec and rejects active ones eagerly);
+  * the coalition draw is absolute-id keyed: padding the client axis
+    never moves which slots are adversarial (PARITY §8);
+  * the election compiles the tenure gate BEFORE the collusion pick, so
+    a gated sybil cannot be elected even by an accomplice;
+  * off-schedule rounds apply no poison (the lax.cond identity branch
+    is bitwise);
+  * the hardened verifier's recovery waiver consumes a CUMULATIVE
+    budget (config.recovery_budget): the PR 1 gameability cap;
+  * the flywheel admission defenses (margin floor, influence cap)
+    exclude exactly the adversarial band and default to byte-identical
+    off;
+  * assignment hysteresis holds borderline moves, and the 'gmm' metric
+    matches its numpy f64 oracle (utils/similarity.py) at f32 tolerance.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.cluster import (ClusterSpec, fit_gateway_gmms,
+                                js_to_references, moment_match_gmms,
+                                pairwise_gmm_js, refit_with_hysteresis)
+from fedmse_tpu.config import CompatConfig, ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+from fedmse_tpu.federation import RoundEngine
+from fedmse_tpu.federation.elastic import ElasticSpec, MembershipMasks
+from fedmse_tpu.flywheel.buffer import FlywheelBuffer
+from fedmse_tpu.models import make_model
+from fedmse_tpu.redteam import (RedteamSpec, SlowDriftAdversary,
+                                assignment_capture_rate, coalition_mask,
+                                make_redteam_fns, make_redteam_masks,
+                                mimic_latent_stats, normal_fraction,
+                                tenure_vote_ok)
+from fedmse_tpu.utils.seeding import ExperimentRngs
+from fedmse_tpu.utils.similarity import gmm_js as gmm_js_oracle
+
+pytestmark = pytest.mark.redteam
+
+DIM = 12
+N = 4
+
+
+def build_cfg(**kw):
+    return ExperimentConfig(
+        dim_features=DIM, network_size=N, epochs=2, batch_size=8,
+        compat=CompatConfig(vote_tie_break=False), **kw)
+
+
+@pytest.fixture(scope="module")
+def data():
+    cfg = build_cfg()
+    clients = synthetic_clients(n_clients=N, dim=DIM, n_normal=120,
+                                n_abnormal=60)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(run=0).data_rng)
+    return stack_clients(clients, dev_x, cfg.batch_size)
+
+
+def build_engine(cfg, data, redteam=None, elastic=None, run=0):
+    m = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+    return RoundEngine(m, cfg, data, n_real=N, rngs=ExperimentRngs(run=run),
+                       model_type="hybrid", update_type="avg", fused=True,
+                       redteam=redteam, elastic=elastic)
+
+
+# ---------------------------------------------------------------- spec ----
+
+def test_spec_validation_rejects_bad_values():
+    with pytest.raises(ValueError, match="kind"):
+        RedteamSpec(kind="zero")
+    with pytest.raises(ValueError, match="poison"):
+        RedteamSpec(poison="typo")
+    with pytest.raises(ValueError, match="adversary_frac"):
+        RedteamSpec(adversary_frac=1.5)
+    with pytest.raises(ValueError, match="non-empty"):
+        RedteamSpec(kind="sybil", adversaries=())
+    with pytest.raises(ValueError, match="duplicate"):
+        RedteamSpec(kind="sybil", adversaries=(1, 1))
+    with pytest.raises(ValueError, match="coalition"):
+        RedteamSpec(kind="cluster_poison")  # attack with no attackers
+    with pytest.raises(ValueError, match="every_k"):
+        RedteamSpec(kind="sybil", adversaries=(0,), every_k=0)
+    with pytest.raises(ValueError, match="stop_round"):
+        RedteamSpec(kind="sybil", adversaries=(0,), start_round=3,
+                    stop_round=3)
+    with pytest.raises(ValueError, match="min_tenure"):
+        RedteamSpec(min_tenure=-1)
+    assert RedteamSpec().is_null
+    assert not RedteamSpec(min_tenure=2).is_null       # defense-only
+    assert not RedteamSpec(kind="sybil", adversaries=(0,)).is_null
+
+
+def test_null_and_defense_only_fns():
+    assert make_redteam_fns(None) is None
+    assert make_redteam_fns(RedteamSpec()) is None
+    fns = make_redteam_fns(RedteamSpec(min_tenure=2))
+    assert fns.update_fn is None and fns.merge_fn is None
+    assert fns.gate_votes and not fns.lie_votes
+    fns = make_redteam_fns(RedteamSpec(kind="sybil", adversaries=(1,),
+                                       lie_votes=True, min_tenure=1))
+    assert fns.update_fn is not None and fns.lie_votes and fns.gate_votes
+
+
+# --------------------------------------------------------------- masks ----
+
+def test_coalition_padding_invariance():
+    """The frac-drawn coalition is keyed by ABSOLUTE slot id: the n=8
+    build is the exact prefix of the n=12 build (PARITY §8)."""
+    spec = RedteamSpec(kind="sybil", adversary_frac=0.5)
+    key = ExperimentRngs(run=0).redteam_key()
+    a = np.asarray(coalition_mask(spec, key, 8))
+    b = np.asarray(coalition_mask(spec, key, 12))
+    np.testing.assert_array_equal(a, b[:8])
+    # ... and the draw reproduces from the key
+    np.testing.assert_array_equal(a, np.asarray(coalition_mask(spec, key, 8)))
+
+
+def test_explicit_ids_and_out_of_range_drop():
+    spec = RedteamSpec(kind="sybil", adversaries=(1, 9))
+    key = ExperimentRngs(run=0).redteam_key()
+    adv = np.asarray(coalition_mask(spec, key, 4))
+    np.testing.assert_array_equal(adv, [0.0, 1.0, 0.0, 0.0])
+    m = make_redteam_masks(spec, key, 3, 4)
+    assert np.asarray(m.adv).shape == (3, 4)
+    np.testing.assert_array_equal(np.asarray(m.vote_ok), 1.0)
+
+
+def test_tenure_gate_spares_founders_and_gates_recycled():
+    # timeline: slot 2 is recycled at round 1 (generation 1); slots 0-1
+    # are founding tenants, slot 3 leaves at round 2
+    member = np.array([[1, 1, 0, 1], [1, 1, 1, 1], [1, 1, 1, 0],
+                       [1, 1, 1, 0]], np.float32)
+    joined = np.zeros((4, 4), np.float32)
+    joined[1, 2] = 1.0
+    left = np.zeros((4, 4), np.float32)
+    left[2, 3] = 1.0
+    gen = np.zeros((4, 4), np.int32)
+    gen[1:, 2] = 1
+    mm = MembershipMasks(member=jnp.asarray(member),
+                         joined=jnp.asarray(joined),
+                         left=jnp.asarray(left),
+                         generation=jnp.asarray(gen))
+    ok = tenure_vote_ok(2, mm, 4, 4)
+    # founders are never gated
+    np.testing.assert_array_equal(ok[:, 0], 1.0)
+    np.testing.assert_array_equal(ok[:, 1], 1.0)
+    # the recycled tenant is gated on its join round (streak 1 < 2) and
+    # eligible from the next (streak 2)
+    np.testing.assert_array_equal(ok[:, 2], [1.0, 0.0, 1.0, 1.0])
+
+
+def test_min_tenure_requires_membership():
+    spec = RedteamSpec(min_tenure=2)
+    key = ExperimentRngs(run=0).redteam_key()
+    with pytest.raises(ValueError, match="membership"):
+        make_redteam_masks(spec, key, 4, 4)
+
+
+# ------------------------------------------------------------ adversary ----
+
+def test_off_schedule_rounds_apply_no_poison():
+    spec = RedteamSpec(kind="cluster_poison", adversaries=(1,),
+                       poison="scale", strength=100.0, start_round=2,
+                       every_k=2, stop_round=7)
+    fns = make_redteam_fns(spec)
+    params = {"w": jnp.ones((4, 3)), "b": jnp.arange(4, dtype=jnp.float32)}
+    adv = jnp.asarray([0.0, 1.0, 0.0, 0.0])
+    rng = jax.random.key(0)
+    for r, active in [(0, False), (1, False), (2, True), (3, False),
+                      (4, True), (7, False), (8, False)]:
+        out = fns.update_fn(params, adv, jnp.asarray(r), rng)
+        changed = bool(np.any(np.asarray(out["w"]) != np.asarray(params["w"])))
+        assert changed == active, f"round {r}"
+        if active:
+            # only the adversarial row moves
+            np.testing.assert_array_equal(np.asarray(out["w"])[0],
+                                          np.asarray(params["w"])[0])
+            np.testing.assert_array_equal(np.asarray(out["w"])[1], 100.0)
+
+
+def test_merge_poison_scopes_to_victim_cluster_row():
+    spec = RedteamSpec(kind="cluster_poison", adversaries=(1,),
+                       victim_cluster=1, poison="sign_flip", strength=2.0)
+    fns = make_redteam_fns(spec)
+    cluster_params = {"w": jnp.ones((3, 5))}  # [K=3, ...]
+    out = fns.merge_fn(cluster_params, jnp.asarray(True), jnp.asarray(0),
+                       jax.random.key(0), clustered=True)
+    w = np.asarray(out["w"])
+    np.testing.assert_array_equal(w[0], 1.0)   # other clusters untouched
+    np.testing.assert_array_equal(w[1], -2.0)  # victim row flipped
+    np.testing.assert_array_equal(w[2], 1.0)
+    # an honest aggregator never fires the merge stage
+    out = fns.merge_fn(cluster_params, jnp.asarray(False), jnp.asarray(0),
+                       jax.random.key(0), clustered=True)
+    np.testing.assert_array_equal(np.asarray(out["w"]), 1.0)
+
+
+# ------------------------------------------------------------- election ----
+
+def _elect(scores, sel, adv=None, vote_ok=None, lie=False):
+    from fedmse_tpu.federation.fused import _elect_on_device
+    n = len(scores)
+    scores = np.asarray(scores, np.float32)
+
+    def scores_fn(params, vote_x, vote_m, rng):
+        return jnp.asarray(scores)
+
+    agg, _ = _elect_on_device(
+        scores_fn, None, jnp.asarray(sel, jnp.int32),
+        jnp.ones((n,), jnp.float32), jnp.zeros((n,), jnp.int32),
+        jnp.zeros((2, 2)), jnp.ones((2, 2)), jax.random.key(0), 100,
+        vote_ok=None if vote_ok is None else jnp.asarray(vote_ok,
+                                                         jnp.float32),
+        adv=None if adv is None else jnp.asarray(adv, jnp.float32),
+        lie_votes=lie)
+    return int(agg)
+
+
+def test_lying_voter_elects_accomplice():
+    # honest rank: slot 2 has the best (lowest) score among candidates
+    scores = [0.9, 0.5, 0.1, 0.7]
+    sel = [0, 1, 2, 3]
+    assert _elect(scores, sel) == 2
+    # voter 0 is adversarial with accomplice 3: collusion overrides rank
+    adv = [1.0, 0.0, 0.0, 1.0]
+    assert _elect(scores, sel, adv=adv, lie=True) == 3
+    # an honest voter with adversaries in the fleet still ranks honestly
+    adv = [0.0, 0.0, 0.0, 1.0]
+    assert _elect(scores, sel, adv=adv, lie=True) == 2
+
+
+def test_tenure_gate_blocks_colluding_election():
+    """The vote_ok gate lands BEFORE the collusion pick: a tenure-gated
+    sybil cannot be elected even by an adversarial accomplice."""
+    scores = [0.9, 0.5, 0.1, 0.7]
+    sel = [0, 1, 2, 3]
+    adv = [1.0, 0.0, 0.0, 1.0]
+    vote_ok = [1.0, 1.0, 1.0, 0.0]  # the accomplice is gated
+    assert _elect(scores, sel, adv=adv, vote_ok=vote_ok, lie=True) == 2
+    # a gated voter casts no vote: its turn passes to the next voter
+    vote_ok = [0.0, 1.0, 1.0, 1.0]
+    assert _elect(scores, sel, adv=adv, vote_ok=vote_ok, lie=True) == 2
+
+
+# -------------------------------------------------- engine bit-identity ----
+
+def test_null_spec_is_bitwise_off(data):
+    """A null RedteamSpec (and spec=None) compiles the identical program:
+    states after 3 dense fused rounds are bitwise equal."""
+    cfg = build_cfg()
+    engines = [build_engine(cfg, data),
+               build_engine(cfg, data, redteam=RedteamSpec())]
+    for e in engines:
+        for r in range(3):
+            e.run_round_fused(r)
+    for a, b in zip(jax.tree.leaves(engines[0].states),
+                    jax.tree.leaves(engines[1].states)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_attack_changes_states_and_chunk_parity(data):
+    """An active coalition perturbs the federation, and per-round vs
+    scanned-chunk dispatch agree bitwise with the hooks compiled in."""
+    cfg = build_cfg(num_rounds=3)
+    spec = RedteamSpec(kind="cluster_poison", adversaries=(1,),
+                       poison="scale", strength=50.0)
+    off = build_engine(cfg, data)
+    ea = build_engine(cfg, data, redteam=spec)
+    eb = build_engine(cfg, data, redteam=spec)
+    for r in range(3):
+        off.run_round_fused(r)
+        ea.run_round_fused(r)
+    eb.run_schedule_chunk(0, 3)
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(off.states.params),
+                        jax.tree.leaves(ea.states.params)))
+    for a, b in zip(jax.tree.leaves(ea.states), jax.tree.leaves(eb.states)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_tiered_layout_accepts_only_null_spec(data):
+    """The tiered layout (which host_sharded degenerates to in one
+    process) takes a null spec bitwise-free and rejects an active one
+    eagerly — redteam hooks live in the dense fused body only."""
+    from fedmse_tpu.federation.tiered import TieredRoundEngine
+    cfg = build_cfg(state_layout="tiered", num_rounds=2)
+    m = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+
+    def tiered(**kw):
+        return TieredRoundEngine(m, cfg, data, n_real=N,
+                                 rngs=ExperimentRngs(run=0),
+                                 model_type="hybrid", update_type="avg",
+                                 **kw)
+
+    # null spec: accepted AND bitwise-identical to no spec at all
+    plain, null = tiered(), tiered(redteam=RedteamSpec())
+    for e in (plain, null):
+        e.run_rounds(0, 2, lambda r, s: False)
+    for a, b in zip(jax.tree.leaves(plain.states_for_checkpoint(N)),
+                    jax.tree.leaves(null.states_for_checkpoint(N))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(ValueError, match="dense"):
+        tiered(redteam=RedteamSpec(kind="sybil", adversaries=(1,)))
+
+
+def test_redteam_requires_fused_engine(data):
+    cfg = build_cfg()
+    m = make_model("hybrid", DIM, shrink_lambda=cfg.shrink_lambda)
+    with pytest.raises(ValueError, match="fused"):
+        RoundEngine(m, cfg, data, n_real=N, rngs=ExperimentRngs(run=0),
+                    model_type="hybrid", update_type="avg", fused=False,
+                    redteam=RedteamSpec(kind="sybil", adversaries=(1,)))
+    with pytest.raises(ValueError, match="ElasticSpec"):
+        build_engine(cfg, data, redteam=RedteamSpec(min_tenure=2))
+
+
+# -------------------------------------------- verification budget (PR 1) ----
+
+def test_recovery_budget_caps_cumulative_waivers():
+    """The hardened verifier's recovery waiver consumes a CUMULATIVE
+    per-client budget: once states.waived crosses it, further broadcasts
+    must pass the ordinary delta cap — the enforced version of the
+    make_verify_fn CAVEAT's shared-tensor gameability."""
+    from fedmse_tpu.federation.state import init_client_states
+    from fedmse_tpu.federation.verification import make_verify_fn
+    import optax
+
+    model = make_model("hybrid", DIM)
+    tx = optax.adam(1e-3)
+    states = init_client_states(model, tx, jax.random.key(0), N)
+    # every client has verifier history (first-contact waivers are not
+    # the surface under test — they never consume budget)
+    states = type(states)(
+        params=states.params, opt_state=states.opt_state,
+        prev_global=states.prev_global, hist_params=states.hist_params,
+        hist_perf=states.hist_perf,
+        hist_seen=jnp.ones((N,), bool), rejected=states.rejected,
+        waived=states.waived)
+    # recovery_threshold=-1 makes every broadcast "recover" (the waiver
+    # qualifies unconditionally) so the test isolates the BUDGET gate;
+    # verification_threshold ~0 forces the waiver to be load-bearing
+    common = dict(verification_threshold=1e-6, performance_threshold=10.0,
+                  hardened=True, recovery_threshold=-1.0,
+                  recovery_delta_cap=1e9)
+    ver_x = jnp.zeros((N, 6, DIM))
+    ver_m = jnp.ones((N, 6))
+    agg_onehot = jnp.zeros((N,))
+    client_mask = jnp.ones((N,))
+    # accepted broadcasts overwrite client params with the aggregator's,
+    # so each probe must move FURTHER to re-trip the waiver
+    agg1 = jax.tree.map(lambda t: t[0] + 0.5, states.params)
+    agg2 = jax.tree.map(lambda t: t[0] + 1.5, states.params)
+
+    # no budget: waived accumulates but every attempt is accepted
+    verify = make_verify_fn(model, **common)
+    out1 = verify(states, agg1, ver_x, ver_m, agg_onehot, client_mask)
+    assert bool(np.all(np.asarray(out1.accepted)))
+    waived1 = np.asarray(out1.states.waived)
+    assert (waived1 > 0).all()
+    np.testing.assert_allclose(waived1, np.asarray(out1.param_delta),
+                               rtol=1e-6)
+    out2 = verify(out1.states, agg2, ver_x, ver_m, agg_onehot, client_mask)
+    assert bool(np.all(np.asarray(out2.accepted)))
+    assert (np.asarray(out2.states.waived) > waived1).all()
+
+    # budget below one waived step: the first waiver lands, the second is
+    # over budget and rejected
+    verify_b = make_verify_fn(model, recovery_budget=float(waived1.min()),
+                              **common)
+    out1b = verify_b(states, agg1, ver_x, ver_m, agg_onehot, client_mask)
+    assert bool(np.all(np.asarray(out1b.accepted)))
+    out2b = verify_b(out1b.states, agg2, ver_x, ver_m, agg_onehot,
+                     client_mask)
+    assert not bool(np.any(np.asarray(out2b.accepted)))
+    # a rejected attempt charges nothing
+    np.testing.assert_allclose(np.asarray(out2b.states.waived),
+                               np.asarray(out1b.states.waived))
+
+
+# ------------------------------------------------- flywheel admission ----
+
+def test_margin_floor_excludes_near_threshold_rows():
+    thr = np.array([1.0, 1.0])
+    buf = FlywheelBuffer(2, DIM, capacity=16, margin_frac=0.5,
+                         thresholds_fn=lambda: thr)
+    rows = np.ones((4, DIM), np.float32)
+    gw = np.array([0, 0, 1, 1])
+    verdicts = np.zeros(4, bool)  # all verdicted normal
+    scores = np.array([0.2, 0.9, 0.4, 0.51])  # floor at 0.5 x 1.0
+    admitted = buf.admit(rows, gw, verdicts=verdicts, scores=scores)
+    assert admitted == 2
+    assert buf.count.tolist() == [1, 1]
+    # margin off: byte-identical admission of everything verdicted normal
+    buf2 = FlywheelBuffer(2, DIM, capacity=16)
+    assert buf2.admit(rows, gw, verdicts=verdicts, scores=scores) == 4
+
+
+def test_margin_floor_validation():
+    with pytest.raises(ValueError, match="thresholds_fn"):
+        FlywheelBuffer(2, DIM, margin_frac=0.5)
+    with pytest.raises(ValueError, match="margin_frac"):
+        FlywheelBuffer(2, DIM, margin_frac=1.5,
+                       thresholds_fn=lambda: np.ones(2))
+    with pytest.raises(ValueError, match="influence_cap"):
+        FlywheelBuffer(2, DIM, influence_cap=0.0)
+
+
+def test_influence_cap_bounds_one_gateways_share():
+    rng = np.random.default_rng(0)
+    buf = FlywheelBuffer(3, DIM, capacity=128, influence_cap=0.34)
+    buf.admit(rng.normal(size=(100, DIM)), np.full(100, 0))  # flooder
+    buf.admit(rng.normal(size=(20, DIM)), np.full(20, 1))
+    buf.admit(rng.normal(size=(20, DIM)), np.full(20, 2))
+    ft = buf.build_finetune_data(8, dev_x=np.zeros((4, DIM), np.float32),
+                                 min_rows=8)
+    lens = [len(r) for r in ft.train_rows]
+    cap = max(1, int(0.34 * sum(
+        len(buf.rows_for(g)) - max(1, int(round(0.25 * len(
+            buf.rows_for(g))))) for g in range(3))))
+    assert lens[0] <= cap
+    # uncapped: the flooder dominates
+    buf2 = FlywheelBuffer(3, DIM, capacity=128)
+    buf2.admit(rng.normal(size=(100, DIM)), np.full(100, 0))
+    buf2.admit(rng.normal(size=(20, DIM)), np.full(20, 1))
+    buf2.admit(rng.normal(size=(20, DIM)), np.full(20, 2))
+    ft2 = buf2.build_finetune_data(8, dev_x=np.zeros((4, DIM), np.float32),
+                                   min_rows=8)
+    lens2 = [len(r) for r in ft2.train_rows]
+    assert lens2[0] > lens[0]
+    assert lens2[0] > lens2[1] + lens2[2]
+
+
+# ------------------------------------------------ slow-drift adversary ----
+
+def test_slow_drift_adapts_to_verdict_feedback():
+    adv = SlowDriftAdversary(np.zeros(DIM), np.full(DIM, 5.0), step=0.1)
+    assert adv.position == 0.0
+    adv.observe(1.0)
+    assert adv.position == pytest.approx(0.1)
+    adv.observe(0.95)
+    assert adv.position == pytest.approx(0.2)
+    adv.observe(0.2)  # detector pushes back: retreat a half-step
+    assert adv.position == pytest.approx(0.15)
+    batch = adv.next_batch(32)
+    assert batch.shape == (32, DIM)
+    np.testing.assert_allclose(batch.mean(axis=0), adv.mu(), atol=0.1)
+    probe = adv.target_rows(16, seed=7)
+    np.testing.assert_array_equal(probe, SlowDriftAdversary(
+        np.zeros(DIM), np.full(DIM, 5.0)).target_rows(16, seed=7))
+    assert normal_fraction(np.array([False, False, True, False])) == 0.75
+    assert normal_fraction(np.zeros(0, bool)) == 0.0
+
+
+# ------------------------------------------------------------- mimicry ----
+
+def test_perfect_mimicry_captures_victim_cluster():
+    """blend=1.0 forges the victim's exact latent Gaussian: the JS
+    assignment cannot distinguish forged from genuine — the provable
+    failure point the DESIGN.md §21 threat table records."""
+    rng = np.random.default_rng(0)
+    means = np.stack([np.zeros(5), np.zeros(5) + 0.1,
+                      np.full(5, 8.0), np.full(5, 8.1)]).astype(np.float32)
+    covs = np.tile(np.eye(5, dtype=np.float32), (4, 1, 1))
+    covs += 0.01 * rng.normal(size=covs.shape).astype(np.float32)
+    covs = 0.5 * (covs + covs.transpose(0, 2, 1))
+    covs += 0.5 * np.eye(5, dtype=np.float32)
+    victim_mu, victim_cov = means[0], covs[0]
+    # adversaries 2, 3 start statistically far from the victim
+    m1, c1 = mimic_latent_stats(means, covs, (2, 3), victim_mu, victim_cov,
+                                blend=1.0)
+    np.testing.assert_allclose(m1[2], victim_mu, atol=1e-6)
+    np.testing.assert_allclose(c1[2], victim_cov, atol=1e-5)
+    # honest gateways' stats are untouched
+    np.testing.assert_array_equal(m1[0], means[0])
+    np.testing.assert_array_equal(c1[1], covs[1])
+    # a JS nearest-reference assignment now groups them with the victim
+    refs_m = np.stack([means[0], means[2]])
+    refs_c = np.stack([covs[0], covs[2]])
+    js = np.asarray(js_to_references(jnp.asarray(m1), jnp.asarray(c1),
+                                     jnp.asarray(refs_m),
+                                     jnp.asarray(refs_c)))
+    assign = np.argmin(js, axis=1)
+    assert assignment_capture_rate(assign, (2, 3), 0) == 1.0
+    # blend=0 is the identity
+    m0, c0 = mimic_latent_stats(means, covs, (2, 3), victim_mu, victim_cov,
+                                blend=0.0)
+    np.testing.assert_allclose(m0, means, atol=1e-7)
+    np.testing.assert_allclose(c0, covs, atol=1e-7)
+
+
+# --------------------------------------------- hysteresis + GMM metric ----
+
+def test_cluster_spec_new_knobs_validate():
+    with pytest.raises(ValueError, match="hysteresis"):
+        ClusterSpec(k=2, hysteresis=1.0)
+    with pytest.raises(ValueError, match="gmm_components"):
+        ClusterSpec(k=2, metric="gmm", gmm_components=0)
+    with pytest.raises(ValueError, match="metric"):
+        ClusterSpec(k=2, metric="kde")
+    s = ClusterSpec(k=2, hysteresis=0.3, metric="gmm", gmm_components=3)
+    assert "h0.3" in s.signature() and "c3" in s.signature()
+    assert ClusterSpec(k=2).signature() == ClusterSpec(k=2).signature()
+    # defaults keep the pre-PR signature (checkpoint compat)
+    assert "h" not in ClusterSpec(k=2).signature().split("mjs")[-1]
+
+
+def test_hysteresis_holds_borderline_and_allows_decisive_moves():
+    rng = np.random.default_rng(1)
+    means = np.stack([np.zeros(4), np.zeros(4) + 0.2,
+                      np.full(4, 6.0), np.full(4, 6.2)]).astype(np.float32)
+    covs = np.tile(np.eye(4, dtype=np.float32), (4, 1, 1))
+    prev = np.array([0, 0, 1, 1], np.int32)
+    held = refit_with_hysteresis(means, covs, prev, 2, 0.5)
+    np.testing.assert_array_equal(held.assignment, prev)
+    # a decisive shift (gateway 1 lands on cluster 1's center) moves
+    moved = means.copy()
+    moved[1] = means[2]
+    out = refit_with_hysteresis(moved, covs, prev, 2, 0.5)
+    assert out.assignment[1] == out.assignment[2]
+    # labels never permute: gateway 0 keeps its cluster id
+    assert out.assignment[0] == prev[0]
+    # h=0 reduces to plain nearest-reference moves
+    out0 = refit_with_hysteresis(moved, covs, prev, 2, 0.0)
+    assert out0.assignment[1] == out0.assignment[2]
+
+
+def test_gmm_js_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+
+    def rows(mu_list, n=60):
+        return np.concatenate(
+            [rng.normal(m, 0.3, (n, 5)) for m in mu_list])
+
+    lat = np.stack([rows([0.0, 4.0]), rows([0.1, 4.1]),
+                    rows([8.0, 8.0]), rows([8.1, 8.1])]).astype(np.float32)
+    w, mu, cv = fit_gateway_gmms(lat, None, components=2, iters=8)
+    # EM is a pure function of the rows (no RNG stream)
+    w2, mu2, cv2 = fit_gateway_gmms(lat, None, components=2, iters=8)
+    np.testing.assert_array_equal(w, w2)
+    np.testing.assert_array_equal(mu, mu2)
+    np.testing.assert_array_equal(cv, cv2)
+    # the bimodal gateways split ~50/50; f32 jax vs f64 numpy oracle
+    assert abs(w[0, 0] - 0.5) < 0.1
+    jm = np.asarray(pairwise_gmm_js(jnp.asarray(w, jnp.float32),
+                                    jnp.asarray(mu, jnp.float32),
+                                    jnp.asarray(cv, jnp.float32)))
+    om = np.array([[gmm_js_oracle(w[a], mu[a], cv[a], w[b], mu[b], cv[b])
+                    for b in range(4)] for a in range(4)])
+    np.testing.assert_allclose(jm, om, rtol=1e-4, atol=1e-4)
+    # moment matching preserves the mixture mean exactly
+    mm_mean, mm_cov = moment_match_gmms(w, mu, cv)
+    np.testing.assert_allclose(
+        mm_mean[0], np.einsum("m,ml->l", w[0], mu[0]), atol=1e-6)
+    assert mm_cov.shape == (4, 5, 5)
+
+
+def test_gmm_metric_separates_multimodal_gateways():
+    """Two bimodal gateways sharing modes vs two unimodal ones: the gmm
+    metric groups by mixture structure."""
+    from fedmse_tpu.cluster import fit_assignments_gmm
+    rng = np.random.default_rng(0)
+
+    def rows(mu_list, n=50):
+        return np.concatenate(
+            [rng.normal(m, 0.3, (n, 4)) for m in mu_list])
+
+    lat = np.stack([rows([0.0, 4.0]), rows([0.1, 4.1]),
+                    rows([2.0, 2.0]), rows([2.1, 2.1])]).astype(np.float32)
+    asn = fit_assignments_gmm(None, lat, None, 2)
+    assert asn.assignment[0] == asn.assignment[1]
+    assert asn.assignment[2] == asn.assignment[3]
+    assert asn.assignment[0] != asn.assignment[2]
+    assert asn.means.shape == (4, 4)  # moment-matched storage shapes
+    assert asn.covs.shape == (4, 4, 4)
