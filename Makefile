@@ -10,7 +10,7 @@ LIB := fedmse_tpu/native/libfedmse_io.so
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
         serve-bench chaos-sweep churn-sweep pipeline-bench precision-bench \
         shard-bench knn-bench cohort-bench flywheel-sweep net-bench \
-        cluster-sweep podscale-bench redteam-sweep tpu-check
+        cluster-sweep podscale-bench redteam-sweep gateway-bench tpu-check
 
 native: $(LIB)
 
@@ -149,6 +149,16 @@ podscale-bench:
 redteam-sweep:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		python redteam_sweep.py --out REDTEAM_r17.json
+
+# gateway ingest plane (DESIGN.md §22): 102,400 authenticated sessions
+# over 12,800 mux connections into 4 frontend processes striping to a
+# scoring worker — sessions and rows/s as separate axes, the pre-parse
+# rejection pin, the kill -9 failover drill, the shed-storm/cost-gaming
+# adversaries and the live plan_split autoscale loop (writes
+# BENCH_GATEWAY_r18_cpu.json; hermetic CPU like the tests)
+gateway-bench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		python bench_gateway.py --out BENCH_GATEWAY_r18_cpu.json
 
 tpu-check:
 	python tpu_check.py

@@ -1,0 +1,731 @@
+"""Gateway ingest-plane bench: concurrent authenticated sessions and
+scored rows/s as SEPARATE first-class axes (DESIGN.md §22).
+
+The net-plane bench (bench_net.py) measures the scoring path; this one
+measures the plane in FRONT of it — the secure multiplexed frontends of
+fedmse_tpu/gateway/. The headline cell is the 1M-fleet shape scaled to
+one CPU box: 100k+ individually authenticated gateway sessions
+multiplexed over a few thousand TCP connections into <=4 frontend
+processes, striping admitted tickets to a scoring worker — and the
+claim under test is that the idle session mass is ~free (rows/s through
+the active subset barely moves when the parked mass attaches), because
+frontends are connection-bound and replicas compute-bound, so the two
+are sized independently (net/autoscale.py plan_split).
+
+Cells (all on one box, JAX_PLATFORMS=cpu):
+
+  * handshake  — in-process frontend: pipelined session-establish rate;
+                 the pre-parse rejection pin (UNKNOWN_GATEWAY /
+                 BAD_MAC / BAD_TOKEN all terminate with rows_parsed
+                 still 0) and the cost of rejecting (rejects/s)
+  * tls        — the same handshake under real TLS (self-signed ECDSA
+                 via the openssl CLI; skipped if unavailable)
+  * mux_scale  — HEADLINE: 4 frontend processes x 3200 conns x 8
+                 sessions/conn = 102,400 authenticated sessions over
+                 12,800 connections; rows/s through a small active
+                 subset measured BEFORE and AFTER the idle mass
+                 attaches. The scoring worker covers the 4096-gateway
+                 active population while the frontends' roster carries
+                 the full 110k identity space — the split's whole
+                 point: parked sessions cost the scoring fleet nothing.
+  * failover   — kill -9 a scoring worker mid-flood behind a frontend
+                 striping over two; zero admitted-ticket loss, recovery
+                 p99 in the JSON
+  * shed_storm / cost_gaming — the redteam/ingest.py cells at bench
+                 ticks (defense factors quantified, clean cost pinned)
+  * autoscale  — plan_split sizing trace over a demand grid + LIVE
+                 scale-up/scale-down through an in-process frontend's
+                 stripe (replica factory apply, confirm-tick hysteresis)
+
+Artifact: BENCH_GATEWAY_r18_cpu.json (`make gateway-bench`).
+`quick_cell()` is the reduced regression guard (bench_suite scen 20).
+
+Usage:
+  python bench_gateway.py [--out BENCH_GATEWAY_r18_cpu.json] [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+# ----------------------------- knobs ----------------------------------- #
+
+DIM = 16
+MODEL = "autoencoder"
+ROSTER = 110_000          # frontend identity space (the fleet)
+WORKER_GATEWAYS = 4_096   # scoring population (the active subset)
+FRONTENDS = 4
+CONNS = 12_800
+SESS_PER_CONN = 8         # 12,800 x 8 = 102,400 sessions
+ACTIVE_CONNS = 16         # the flooding subset (4 per frontend)
+FLOOD_S = 6.0
+BURST_ROWS = 256
+MAX_OUT = 2               # outstanding bursts per active session
+ATTACH_THREADS = 16
+CAPACITY = 250_000.0      # generous: admission path on, nothing shed
+
+
+def _flag(name: str, default):
+    """bench_net.py's argv idiom: --name value."""
+    argv = sys.argv
+    if f"--{name}" in argv:
+        i = argv.index(f"--{name}")
+        if isinstance(default, bool):
+            return True
+        return type(default)(argv[i + 1])
+    return default
+
+
+def _pctl(xs, q):
+    return float(np.percentile(np.asarray(xs, np.float64), q)) if len(xs) \
+        else float("nan")
+
+
+# --------------------------- process spawn ------------------------------ #
+
+def _spawn(cmd, timeout_s=420.0):
+    """Spawn a worker/frontend subprocess; block for its one-line
+    listening JSON (bench_net.py idiom)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen([sys.executable, "-m"] + cmd,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True, env=env)
+    deadline = time.time() + timeout_s
+    line = ""
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(f"{cmd[0]} died during startup")
+        line = line.strip()
+        if line.startswith("{"):
+            break
+    info = json.loads(line)
+    assert info.get("listening")
+    return proc, info
+
+
+def _kill(procs):
+    for p in procs:
+        try:
+            p.kill()
+            p.wait(timeout=10)
+        except Exception:
+            pass
+
+
+# ------------------------------ flood ----------------------------------- #
+
+def _flood(clients, dur_s, rows, tier=0, max_out=MAX_OUT):
+    """Open-loop flood across `clients` (each with established
+    sessions), one thread per client group; returns
+    (rows_resolved, wall_s, latencies_s)."""
+    from fedmse_tpu.gateway.client import GatewayClientError
+
+    before = [set(c.results) for c in clients]
+    stop_at = time.perf_counter() + dur_s
+
+    def drive(c):
+        gids = list(c.sessions)
+        while time.perf_counter() < stop_at:
+            for gid in gids:
+                if sum(1 for k in c.outstanding if k[0] == gid) < max_out:
+                    c.submit(gid, rows, tier=tier)
+            c.poll()
+        c.wait_all(timeout_s=60.0)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=drive, args=(c,)) for c in clients]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    n_rows, lats = 0, []
+    for c, seen in zip(clients, before):
+        for k, (statuses, _, lat) in c.results.items():
+            if k not in seen:
+                n_rows += len(statuses)
+                lats.append(lat)
+    return n_rows, wall, lats
+
+
+# ------------------------------- cells ---------------------------------- #
+
+def cell_handshake(n_sessions=2048, n_conns=4, n_gateways=WORKER_GATEWAYS):
+    """In-process frontend: session-establish rate, then the pre-parse
+    rejection pin — every auth failure class terminates BEFORE any row
+    bytes parse (front.rows_parsed stays 0)."""
+    from fedmse_tpu.gateway import auth, mux
+    from fedmse_tpu.gateway.client import GatewayClient
+    from fedmse_tpu.gateway.frontend import (FrontendHandle,
+                                             build_synthetic_frontend)
+
+    front = build_synthetic_frontend(
+        n_gateways=n_gateways, dim=DIM, replicas=1, max_batch=512,
+        model_type=MODEL, seed=0, calibrate=True,
+        max_sessions_per_conn=1024)
+    handle = FrontendHandle(front)
+    master = auth.master_key(seed=0)
+    per_conn = n_sessions // n_conns
+    clients = [GatewayClient("127.0.0.1", handle.port, master=master,
+                             timeout_s=120.0) for _ in range(n_conns)]
+    try:
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(n_conns) as ex:
+            list(ex.map(
+                lambda ic: ic[1].authenticate_many(
+                    range(ic[0] * per_conn, (ic[0] + 1) * per_conn)),
+                enumerate(clients)))
+        hs_wall = time.perf_counter() - t0
+        ok = sum(len(c.sessions) for c in clients)
+        assert ok == n_sessions, (ok, n_sessions)
+        assert front.rows_parsed == 0
+
+        # pre-parse rejection pin: unknown identity, wrong key, forged
+        # token — all terminal before any row payload is parsed. The
+        # reject conn authenticates ONE real tenant first (a
+        # concentrator with bad tenants among its pipelined handshakes
+        # survives; a fully unauthenticated peer is cut off after
+        # `preauth_strikes` rejects)
+        rej = GatewayClient("127.0.0.1", handle.port, master=master)
+        assert rej.authenticate(n_sessions)
+        t0 = time.perf_counter()
+        rej.authenticate_many(range(n_gateways, n_gateways + 256))
+        rej_wall = time.perf_counter() - t0
+        unknown = sum(1 for _, code, _ in rej.rejects
+                      if code == mux.REJ_UNKNOWN_GATEWAY)
+
+        bad = GatewayClient("127.0.0.1", handle.port,
+                            key_fn=lambda gid, gen: b"\x00" * 32)
+        bad.authenticate_many([n_sessions + 5])
+        rows = np.zeros((8, DIM), np.float32)
+        forged_gid = n_sessions + 10
+        forged = GatewayClient("127.0.0.1", handle.port, master=master)
+        assert forged.authenticate(forged_gid)
+        forged._send(mux.pack_submit(forged_gid, 1,
+                                     b"\x00" * mux.TOKEN_LEN, rows))
+        t_end = time.perf_counter() + 10.0
+        while not any(c == mux.REJ_BAD_TOKEN
+                      for _, c, _ in forged.rejects):
+            assert time.perf_counter() < t_end
+            forged.poll()
+            time.sleep(0.005)
+        preparse_pin = (front.rows_parsed == 0)
+
+        # sanity that the counter counts: one real scored burst
+        real = clients[0]
+        gid = next(iter(real.sessions))
+        real.submit(gid, rows, tier=0)
+        real.wait_all(timeout_s=30.0)
+        counter_counts = front.rows_parsed == len(rows)
+        for c in clients + [rej, bad, forged]:
+            c.close()
+    finally:
+        handle.stop()
+    return {
+        "cell": "handshake",
+        "sessions": n_sessions, "conns": n_conns,
+        "handshakes_per_sec": round(n_sessions / hs_wall, 1),
+        "handshake_wall_s": round(hs_wall, 3),
+        "unknown_rejected": unknown,
+        "rejects_per_sec": round(256 / rej_wall, 1),
+        "bad_mac_rejected": any(c == mux.REJ_BAD_MAC
+                                for _, c, _ in bad.rejects),
+        "bad_token_rejected": True,
+        "rows_parsed_before_any_reject": 0 if preparse_pin else -1,
+        "preparse_pin": bool(preparse_pin and unknown == 256
+                             and counter_counts),
+    }
+
+
+def cell_tls(n_sessions=512, n_conns=64):
+    """The handshake cell under real TLS (self-signed ECDSA pair via
+    the openssl CLI, client pins the cert as its CA)."""
+    from fedmse_tpu.gateway import auth, tls
+    from fedmse_tpu.gateway.client import GatewayClient
+    from fedmse_tpu.gateway.frontend import (FrontendHandle,
+                                             build_synthetic_frontend)
+
+    if not tls.have_openssl():
+        return {"cell": "tls", "skipped": "no openssl CLI"}
+    with tempfile.TemporaryDirectory() as d:
+        cert, key = tls.ensure_self_signed(d)
+        front = build_synthetic_frontend(
+            n_gateways=1024, dim=DIM, replicas=1, max_batch=256,
+            model_type=MODEL, seed=0, calibrate=True,
+            tls_context=tls.server_context(cert, key),
+            max_sessions_per_conn=64)
+        handle = FrontendHandle(front)
+        master = auth.master_key(seed=0)
+        ctx = tls.client_context(cert)
+        per_conn = n_sessions // n_conns
+        try:
+            t0 = time.perf_counter()
+
+            def attach(i):
+                c = GatewayClient("127.0.0.1", handle.port, master=master,
+                                  tls_context=ctx, timeout_s=120.0)
+                c.authenticate_many(
+                    range(i * per_conn, (i + 1) * per_conn))
+                return c
+
+            with ThreadPoolExecutor(8) as ex:
+                clients = list(ex.map(attach, range(n_conns)))
+            hs_wall = time.perf_counter() - t0
+            ok = sum(len(c.sessions) for c in clients)
+            rows = np.random.default_rng(0).normal(
+                size=(128, DIM)).astype(np.float32)
+            n_rows, wall, lats = _flood(clients[:2], 2.0, rows)
+            for c in clients:
+                c.close()
+        finally:
+            handle.stop()
+        return {
+            "cell": "tls", "sessions": ok, "conns": n_conns,
+            "handshakes_per_sec": round(ok / hs_wall, 1),
+            "rows_per_sec": round(n_rows / wall, 1),
+            "latency_p99_ms": round(_pctl(lats, 99) * 1e3, 2),
+            "tls": True,
+        }
+
+
+def cell_mux_scale():
+    """HEADLINE: 102,400 authenticated sessions over 12,800 conns into
+    4 frontend processes striping to a scoring worker; rows/s through
+    the active subset with the idle mass detached vs attached."""
+    from fedmse_tpu.gateway import auth
+    from fedmse_tpu.gateway.client import GatewayClient
+
+    procs = []
+    idle_clients = []
+    try:
+        worker, winfo = _spawn(
+            ["fedmse_tpu.net.server", "--port", "0", "--replicas", "1",
+             "--gateways", str(WORKER_GATEWAYS), "--dim", str(DIM),
+             "--max-batch", "1024", "--model-type", MODEL,
+             "--no-admission"])
+        procs.append(worker)
+        fronts = []
+        for i in range(FRONTENDS):
+            fp, finfo = _spawn(
+                ["fedmse_tpu.gateway.frontend", "--port", "0",
+                 "--gateways", str(ROSTER),
+                 "--replica-addr", f"127.0.0.1:{winfo['port']}",
+                 "--max-batch", "1024", "--park-s", "0.5",
+                 "--max-sessions-per-conn", "16",
+                 "--capacity-rows-per-sec", str(CAPACITY)])
+            procs.append(fp)
+            fronts.append(finfo["port"])
+
+        master = auth.master_key(seed=0)
+        rng = np.random.default_rng(0)
+        rows = rng.normal(size=(BURST_ROWS, DIM)).astype(np.float32)
+
+        # active subset: gids 0..127 inside the worker's population
+        active = []
+        for i in range(ACTIVE_CONNS):
+            c = GatewayClient("127.0.0.1", fronts[i % FRONTENDS],
+                              master=master, timeout_s=120.0)
+            got = c.authenticate_many(
+                range(i * SESS_PER_CONN, (i + 1) * SESS_PER_CONN))
+            assert got == SESS_PER_CONN
+            active.append(c)
+        groups = [[c for j, c in enumerate(active)
+                   if j % FRONTENDS == f] for f in range(FRONTENDS)]
+
+        # warm the scoring path (worker compile/NUMA warmup is done,
+        # but the first bursts pay connection ramp)
+        _flood(active, 1.0, rows)
+
+        n_rows_a, wall_a, lats_a = _flood(active, FLOOD_S, rows)
+
+        # attach the idle mass: the other 12,784 conns x 8 sessions
+        n_idle_conns = CONNS - ACTIVE_CONNS
+        t0 = time.perf_counter()
+
+        def attach(i):
+            c = GatewayClient("127.0.0.1", fronts[i % FRONTENDS],
+                              master=master, timeout_s=300.0)
+            lo = (ACTIVE_CONNS + i) * SESS_PER_CONN
+            got = c.authenticate_many(range(lo, lo + SESS_PER_CONN),
+                                      timeout_s=300.0)
+            if got != SESS_PER_CONN:
+                raise RuntimeError(
+                    f"idle conn {i}: {got}/{SESS_PER_CONN} sessions")
+            return c
+
+        with ThreadPoolExecutor(ATTACH_THREADS) as ex:
+            idle_clients = list(ex.map(attach, range(n_idle_conns)))
+        attach_wall = time.perf_counter() - t0
+        n_idle_sessions = sum(len(c.sessions) for c in idle_clients)
+
+        time.sleep(1.0)  # let the mass park (park-s 0.5)
+        n_rows_b, wall_b, lats_b = _flood(active, FLOOD_S, rows)
+
+        # per-frontend telemetry through the wire (G_STATS)
+        stats = [groups[f][0].frontend_stats() for f in range(FRONTENDS)]
+        sess_held = sum(s["sessions"]["sessions"] for s in stats)
+        parked = sum(s["sessions"]["parked"] for s in stats)
+        conns_open = sum(s["conns_open"] for s in stats)
+        shed = sum(s["router"]["admission"]["shed_total"]
+                   if s["router"].get("admission") else 0 for s in stats)
+
+        rps_a = n_rows_a / wall_a
+        rps_b = n_rows_b / wall_b
+        return {
+            "cell": "mux_scale",
+            "frontends": FRONTENDS,
+            "conns": ACTIVE_CONNS + n_idle_conns,
+            "conns_open_reported": conns_open,
+            "sessions": ACTIVE_CONNS * SESS_PER_CONN + n_idle_sessions,
+            "sessions_held_reported": sess_held,
+            "sessions_parked": parked,
+            "sessions_per_conn": SESS_PER_CONN,
+            "roster_size": ROSTER,
+            "worker_gateways": WORKER_GATEWAYS,
+            "attach_wall_s": round(attach_wall, 1),
+            "attach_handshakes_per_sec": round(
+                n_idle_sessions / attach_wall, 1),
+            "rows_per_sec_active_only": round(rps_a, 1),
+            "rows_per_sec_with_idle_mass": round(rps_b, 1),
+            "idle_mass_throughput_ratio": round(rps_b / rps_a, 3),
+            "latency_p50_ms_active_only": round(_pctl(lats_a, 50) * 1e3, 2),
+            "latency_p99_ms_active_only": round(_pctl(lats_a, 99) * 1e3, 2),
+            "latency_p50_ms_with_idle": round(_pctl(lats_b, 50) * 1e3, 2),
+            "latency_p99_ms_with_idle": round(_pctl(lats_b, 99) * 1e3, 2),
+            "rows_shed": shed,
+        }
+    finally:
+        for c in idle_clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        _kill(procs)
+
+
+def cell_failover(flood_s=6.0, kill_at_s=2.0):
+    """Kill -9 one of two scoring workers mid-flood behind an
+    in-process frontend stripe: zero admitted-ticket loss, and the
+    recovery cost lands in the latency tail, not in lost bursts."""
+    from fedmse_tpu.gateway import auth
+    from fedmse_tpu.gateway.client import GatewayClient
+    from fedmse_tpu.gateway.frontend import FrontendHandle, GatewayFrontend
+    from fedmse_tpu.net.client import RemoteReplica
+    from fedmse_tpu.serving.engine import ServingRoster
+
+    n_gw = 1024
+    procs = []
+    try:
+        workers = []
+        for s in range(2):
+            wp, wi = _spawn(
+                ["fedmse_tpu.net.server", "--port", "0", "--replicas",
+                 "1", "--gateways", str(n_gw), "--dim", str(DIM),
+                 "--max-batch", "256", "--model-type", MODEL,
+                 "--seed", str(s), "--no-admission"])
+            procs.append(wp)
+            workers.append((wp, wi["port"]))
+        members = [RemoteReplica("127.0.0.1", port, num_gateways=n_gw,
+                                 max_batch=256) for _, port in workers]
+        roster = ServingRoster(member=np.ones(n_gw, bool),
+                               generation=np.zeros(n_gw, np.int64))
+        master = auth.master_key(seed=0)
+        front = GatewayFrontend(members, roster, master=master,
+                                admission=None, isolation=None)
+        handle = FrontendHandle(front)
+        try:
+            c = GatewayClient("127.0.0.1", handle.port, master=master,
+                              timeout_s=120.0)
+            assert c.authenticate_many(range(4)) == 4
+            rows = np.random.default_rng(1).normal(
+                size=(128, DIM)).astype(np.float32)
+            submits = {}            # (gid, seq) -> t_submit
+            killed = [None]
+            stop_at = time.perf_counter() + flood_s
+            t_start = time.perf_counter()
+            while time.perf_counter() < stop_at:
+                now = time.perf_counter()
+                if killed[0] is None and now - t_start >= kill_at_s:
+                    workers[0][0].send_signal(signal.SIGKILL)
+                    killed[0] = now
+                for gid in list(c.sessions):
+                    if sum(1 for k in c.outstanding
+                           if k[0] == gid) < MAX_OUT:
+                        seq = c.submit(gid, rows)
+                        submits[(gid, seq)] = time.perf_counter()
+                c.poll()
+            c.wait_all(timeout_s=60.0)
+
+            lost = len(submits) - len(c.results)
+            pre = [c.results[k][2] for k, t in submits.items()
+                   if t < killed[0]]
+            post = [c.results[k][2] for k, t in submits.items()
+                    if t >= killed[0]]
+            st = front.stats()
+            # STATUS_NORMAL / STATUS_ANOMALY both mean "scored"; the
+            # drill pins that no row came back SHED or UNKNOWN
+            all_scored = all(
+                bool(np.all(sts <= 1)) for sts, _, _ in c.results.values())
+            c.close()
+        finally:
+            handle.stop()
+        return {
+            "cell": "failover",
+            "bursts_submitted": len(submits),
+            "bursts_resolved": len(c.results),
+            "admitted_tickets_lost": lost,
+            "all_rows_scored": all_scored,
+            "failover_events": len(st["stripe"]["failover_events"]),
+            "replicas_alive_after": st["stripe"]["alive"],
+            "latency_p99_ms_before_kill": round(_pctl(pre, 99) * 1e3, 2),
+            "latency_p99_ms_after_kill": round(_pctl(post, 99) * 1e3, 2),
+            "recovery_max_stall_ms": round(max(post) * 1e3, 2)
+            if post else float("nan"),
+        }
+    finally:
+        _kill(procs)
+
+
+def cell_redteam(storm_ticks=60, gaming_ticks=120):
+    """The gateway-plane adversaries at bench ticks (full grids live in
+    redteam_sweep.py -> REDTEAM artifact)."""
+    from fedmse_tpu.redteam.ingest import cost_gaming_cell, shed_storm_cell
+
+    _, storm = shed_storm_cell(ticks=storm_ticks)
+    _, gaming = cost_gaming_cell(ticks=gaming_ticks)
+    factor = (storm["undefended_honest_shed_frac"]
+              / max(storm["defended_honest_shed_frac"], 1e-9))
+    return {
+        "cell": "redteam",
+        "shed_storm": storm,
+        "shed_storm_defense_factor": round(min(factor, 1e6), 1),
+        "cost_gaming": gaming,
+    }
+
+
+def cell_autoscale(flood_s=5.0, idle_s=8.0):
+    """plan_split sizing trace + LIVE scale-up/scale-down through an
+    in-process frontend's stripe (replica-factory apply; scale-down
+    gated on confirm ticks — the cost-gaming defense)."""
+    from fedmse_tpu.gateway import auth
+    from fedmse_tpu.gateway.client import GatewayClient
+    from fedmse_tpu.gateway.frontend import (FrontendHandle,
+                                             build_synthetic_frontend)
+    from fedmse_tpu.net.autoscale import (BackendSpec, FrontendSpec,
+                                          SLOAutoscaler, plan_split)
+
+    spec_f = FrontendSpec()
+    spec_b = [BackendSpec("cpu", rows_per_sec=50_000.0, usd_per_hour=0.10,
+                          max_replicas=64)]
+    trace = []
+    for demand, sessions, hs in [
+            (1_000.0, 1_000_000.0, 500.0),      # the 1M-idle-fleet shape
+            (120_000.0, 50_000.0, 100.0),       # compute-heavy
+            (500_000.0, 2_000_000.0, 5_000.0),  # both classes loaded
+    ]:
+        plan = plan_split(demand, sessions, hs, spec_f, spec_b)
+        trace.append({"demand_rows_per_sec": demand,
+                      "sessions": sessions,
+                      "handshakes_per_sec": hs, **plan})
+
+    front = build_synthetic_frontend(
+        n_gateways=256, dim=DIM, replicas=1, max_batch=256,
+        model_type=MODEL, seed=0, calibrate=True, return_factory=True,
+        autoscale_interval_s=0.25)
+    cap = front.router.admission.capacity_rows_per_sec
+    front.autoscaler = SLOAutoscaler(
+        budget_ms=25.0,
+        backends=[BackendSpec("cpu", rows_per_sec=cap * 0.5,
+                              usd_per_hour=0.10, max_replicas=3)],
+        min_bucket=64, max_bucket=256,
+        cooldown_s=0.5, scale_down_confirm_ticks=2)
+    handle = FrontendHandle(front)
+    try:
+        master = auth.master_key(seed=0)
+        c = GatewayClient("127.0.0.1", handle.port, master=master,
+                          timeout_s=60.0)
+        assert c.authenticate_many(range(4)) == 4
+        big = np.random.default_rng(2).normal(
+            size=(256, DIM)).astype(np.float32)
+        tiny = big[:8]
+
+        # flood until a scale-up applies (bounded)
+        t_end = time.perf_counter() + max(flood_s, 20.0)
+        while time.perf_counter() < t_end and not any(
+                e["action"] == "scale_up" for e in front.autoscale_events):
+            for gid in list(c.sessions):
+                if sum(1 for k in c.outstanding if k[0] == gid) < 4:
+                    c.submit(gid, big, tier=0)
+            c.poll()
+        c.wait_all(timeout_s=60.0)
+        # trickle so the arrival EMA decays; wait for the CONFIRMED
+        # scale-down (hysteresis holds it for confirm_ticks ticks)
+        t_end = time.perf_counter() + max(idle_s, 25.0)
+        while time.perf_counter() < t_end and not any(
+                e["action"] == "scale_down"
+                for e in front.autoscale_events):
+            c.submit(next(iter(c.sessions)), tiny, tier=0)
+            c.wait_all(timeout_s=30.0)
+            time.sleep(0.25)
+        events = list(front.autoscale_events)
+        holds = [d.reason for d in front.autoscaler.decisions
+                 if "confirmation" in d.reason]
+        c.close()
+    finally:
+        handle.stop()
+    return {
+        "cell": "autoscale",
+        "plan_split_trace": trace,
+        "live_scale_up": any(e["action"] == "scale_up" for e in events),
+        "live_scale_down": any(e["action"] == "scale_down"
+                               for e in events),
+        "confirm_holds_observed": len(holds),
+        "events": [{k: e[k] for k in ("action", "replicas_now")}
+                   for e in events],
+    }
+
+
+# ------------------------------ acceptance ------------------------------ #
+
+def _acceptance(cells):
+    by = {c["cell"]: c for c in cells if "cell" in c}
+    hs = by.get("handshake", {})
+    mux = by.get("mux_scale", {})
+    fo = by.get("failover", {})
+    rt = by.get("redteam", {})
+    asc = by.get("autoscale", {})
+    storm = rt.get("shed_storm", {})
+    checks = {
+        "sessions_100k_on_4_frontends": bool(
+            mux.get("sessions_held_reported", 0) >= 100_000
+            and mux.get("frontends", 99) <= 4),
+        "both_axes_reported": bool(
+            "rows_per_sec_with_idle_mass" in mux and "conns" in mux),
+        "idle_mass_near_free": bool(
+            mux.get("idle_mass_throughput_ratio", 0.0) >= 0.5),
+        "unknown_gateway_preparse": bool(hs.get("preparse_pin")),
+        "failover_zero_ticket_loss": bool(
+            fo.get("admitted_tickets_lost", -1) == 0
+            and fo.get("failover_events", 0) >= 1
+            and fo.get("all_rows_scored")),
+        "shed_storm_defense": bool(
+            rt.get("shed_storm_defense_factor", 0.0) >= 10.0
+            and storm.get("clean_cost_shed_frac", 1.0) <= 1e-6),
+        "autoscale_live_both_ways": bool(
+            asc.get("live_scale_up") and asc.get("live_scale_down")),
+    }
+    return {**checks, "met": all(checks.values())}
+
+
+# ------------------------------ quick cell ------------------------------ #
+
+def quick_cell():
+    """Reduced gateway guard for bench_suite (scenario 20): in-process
+    frontend, 192 sessions, pre-parse pin, one scored burst, plan_split
+    sanity — tens of seconds, no subprocesses."""
+    from fedmse_tpu.gateway import auth, mux
+    from fedmse_tpu.gateway.client import GatewayClient
+    from fedmse_tpu.gateway.frontend import (FrontendHandle,
+                                             build_synthetic_frontend)
+    from fedmse_tpu.net.autoscale import BackendSpec, FrontendSpec, plan_split
+
+    n_gw, n_sessions = 256, 192
+    front = build_synthetic_frontend(
+        n_gateways=n_gw, dim=12, replicas=1, max_batch=64,
+        model_type=MODEL, seed=0, calibrate=False,
+        max_sessions_per_conn=256)
+    handle = FrontendHandle(front)
+    try:
+        master = auth.master_key(seed=0)
+        c = GatewayClient("127.0.0.1", handle.port, master=master,
+                          timeout_s=60.0)
+        t0 = time.perf_counter()
+        ok = c.authenticate_many(range(n_sessions))
+        hs_wall = time.perf_counter() - t0
+
+        rej = GatewayClient("127.0.0.1", handle.port, master=master)
+        rej.authenticate_many([n_gw + 7])
+        unknown_preparse = (any(code == mux.REJ_UNKNOWN_GATEWAY
+                                for _, code, _ in rej.rejects)
+                            and front.rows_parsed == 0)
+
+        rows = np.random.default_rng(0).normal(
+            size=(32, 12)).astype(np.float32)
+        c.submit(0, rows, tier=0)
+        c.wait_all(timeout_s=30.0)
+        scored = int(sum(len(s) for s, _, _ in c.results.values()))
+
+        plan = plan_split(1_000.0, 1_000_000.0, 500.0, FrontendSpec(),
+                          [BackendSpec("cpu", rows_per_sec=50_000.0,
+                                       usd_per_hour=0.10,
+                                       max_replicas=64)])
+        c.close()
+        rej.close()
+    finally:
+        handle.stop()
+    met = bool(ok == n_sessions and unknown_preparse and scored == 32
+               and plan["frontend_axis"] == "sessions"
+               and plan["replicas"].get("cpu", 0) == 1)
+    return {
+        "sessions": ok,
+        "handshakes_per_sec": round(ok / hs_wall, 1),
+        "unknown_gateway_preparse": unknown_preparse,
+        "rows_scored": scored,
+        "plan_frontend_axis": plan["frontend_axis"],
+        "acceptance_met": met,
+    }
+
+
+# -------------------------------- main ---------------------------------- #
+
+def main():
+    if _flag("quick", False):
+        row = quick_cell()
+        print(json.dumps(row, indent=2))
+        return
+
+    out_path = _flag("out", "BENCH_GATEWAY_r18_cpu.json")
+    cells = []
+
+    def emit(row):
+        cells.append(row)
+        print(json.dumps(row), flush=True)
+
+    emit(cell_handshake())
+    emit(cell_tls())
+    emit(cell_failover())
+    emit(cell_redteam())
+    emit(cell_autoscale())
+    emit(cell_mux_scale())
+
+    acceptance = _acceptance(cells)
+    doc = {
+        "bench": "gateway",
+        "platform": "cpu",
+        "dim": DIM,
+        "model_type": MODEL,
+        "cells": cells,
+        "acceptance": acceptance,
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"acceptance": acceptance, "out": out_path},
+                     indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -63,6 +63,12 @@ repo-grown axes):
      (plain refit flips, hysteresis holds) and the reservoir
      margin-floor admission bound (full protocol:
      make redteam-sweep -> REDTEAM_r17.json)
+ 20. gateway ingest-plane guard (fedmse_tpu/gateway/, DESIGN.md §22):
+     the reduced secure-mux cell — 192 pipelined authenticated sessions
+     on one connection, an unknown identity terminated at handshake
+     with the row-parse counter still 0, one scored burst through the
+     frontend stripe, and the plan_split 1M-idle-fleet sizing pin
+     (full protocol: make gateway-bench -> BENCH_GATEWAY_r18_cpu.json)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -508,6 +514,25 @@ def scen_redteam():
                         "admission", **row}
 
 
+def scen_gateway():
+    """Scenario 20: gateway ingest-plane guard (ISSUE 18,
+    fedmse_tpu/gateway/, DESIGN.md §22) — the reduced cells: 192
+    authenticated sessions pipelined on one connection, the
+    UNKNOWN_GATEWAY handshake-time termination with rows_parsed pinned
+    at 0, one burst scored exactly-once through the frontend stripe,
+    and the plan_split sizing pin for the 1M-mostly-idle-fleet shape
+    (session-bound frontends, one compute-bound replica). The committed
+    standalone artifact (make gateway-bench -> BENCH_GATEWAY_r18_cpu
+    .json) carries the 102,400-session multi-process headline, TLS,
+    the kill -9 failover drill and the live autoscale loop."""
+    from bench_gateway import quick_cell
+
+    row = quick_cell()
+    return {"scenario": "gateway guard: 192-session mux handshake, "
+                        "pre-parse reject pin, scored burst, "
+                        "plan_split sizing", **row}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -530,7 +555,7 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-19")
+            sys.exit("--only expects a scenario number 1-20")
         if not 1 <= only <= 19:
             sys.exit(f"--only expects a scenario number 1-19, got {only}")
 
@@ -638,6 +663,9 @@ def main():
 
     if only in (None, 19):
         emit(scen_redteam())
+
+    if only in (None, 20):
+        emit(scen_gateway())
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

@@ -355,6 +355,25 @@ class ExperimentConfig:
     net_replicas: int = 2
     net_tiers: int = 3
     net_shed_headroom: float = 0.9
+    # Gateway ingest plane (fedmse_tpu/gateway/, DESIGN.md §22): the
+    # internet-facing front over the net plane. gateway_frontends is how
+    # many frontend processes admission/auth spread over (plan_split
+    # sizes this from the connection-bound axes); gateway_tls serves the
+    # mux wire over TLS (tls.py self-signed in dev, real certs in
+    # deployment); gateway_master_key_hex is the fleet enrollment secret
+    # ("" = the seed-derived DEV key, benches/tests only);
+    # gateway_session_share is the per-session isolation cap as a
+    # fraction of fleet capacity (the shed-storm defense — no honest
+    # gateway approaches it); gateway_park_s parks sessions idle past
+    # it off the frontends' hot loop; gateway_sessions_per_conn bounds
+    # one connection's session budget (concentrator fan-in).
+    gateway_port: int = 0
+    gateway_frontends: int = 1
+    gateway_tls: bool = False
+    gateway_master_key_hex: str = ""
+    gateway_session_share: float = 0.25
+    gateway_park_s: float = 1.0
+    gateway_sessions_per_conn: int = 64
     # Client-state residency layout (DESIGN.md §16; ROADMAP item 2):
     #   'dense'  — the pre-PR-11 layout: every client's params + f32 Adam
     #              moments device-resident as [N, ...] stacked trees; the

@@ -1,0 +1,31 @@
+"""fedmse_tpu.gateway — the secure, multiplexed ingest plane.
+
+The net plane (fedmse_tpu/net/) is a trusted-backend protocol between
+co-deployed processes; this package is what stands between it and the
+open internet at the million-gateway scale of DESIGN.md §20:
+
+  mux.py       session-multiplexed wire format (many gateways per TCP
+               connection; identity in every frame header)
+  auth.py      KDF-per-device keys + HMAC challenge-response handshake
+  tls.py       optional TLS underneath (stdlib ssl + openssl-CLI certs)
+  session.py   the frontend's session table (active set / parked mass)
+  stripe.py    FailoverStripe — admitted tickets survive replica death
+  frontend.py  the epoll ingest loop: handshakes + admission up front,
+               scoring striped to net-plane replicas behind a Router
+  client.py    GatewayClient — the concentrator / load-generator side
+
+Design doc: DESIGN.md §22. Measured: bench_gateway.py
+(BENCH_GATEWAY_r18_cpu.json); adversarial: redteam/ingest.py.
+"""
+
+from fedmse_tpu.gateway.client import GatewayClient, GatewayClientError
+from fedmse_tpu.gateway.frontend import (FrontendHandle, GatewayFrontend,
+                                         build_synthetic_frontend)
+from fedmse_tpu.gateway.session import Session, SessionTable
+from fedmse_tpu.gateway.stripe import FailoverStripe, StripeExhausted
+
+__all__ = [
+    "GatewayClient", "GatewayClientError", "FrontendHandle",
+    "GatewayFrontend", "build_synthetic_frontend", "Session",
+    "SessionTable", "FailoverStripe", "StripeExhausted",
+]

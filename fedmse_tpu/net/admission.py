@@ -48,6 +48,19 @@ Whatever the probe believed, sustained overload surfaces as queueing
 delay and sheds exactly the traffic whose latency budget is already
 lost, lowest priority first.
 
+A third gate exists for the gateway plane (fedmse_tpu/gateway/):
+**per-session isolation** (`SessionIsolation`). The shared bucket is a
+FLEET resource, which makes it an attack surface the moment sessions
+are adversarial: a coalition flooding low-tier traffic drains the
+shared tokens and pushes HONEST gateways' rows into SHED (the
+shed-storm adversary, redteam/ingest.py). The isolation gate caps each
+session at `session_share` of fleet capacity BEFORE its rows reach the
+shared bucket — a flooder exhausts its own cap, not the fleet's
+tokens. No honest gateway operates anywhere near a whole-fleet
+fraction, so the cap never touches clean traffic: the defense's clean
+cost is structurally zero (measured in redteam_sweep's shed-storm
+cell).
+
 Deterministic and clock-injected like the continuous front, so the
 overload tests drive it with a synthetic clock.
 """
@@ -227,4 +240,83 @@ class AdmissionController:
             "shed_by_tier": self.shed.tolist(),
             "shed_total": int(self.shed.sum()),
             "shed_events": self.shed_events,
+        }
+
+
+class SessionIsolation:
+    """Per-session rate caps in front of the shared bucket (module
+    docstring): session k may consume at most `session_share` of fleet
+    capacity, enforced by a lazily-created per-key token bucket (rate
+    `capacity * session_share`, depth `rate * burst_s`). `allow()`
+    returns how many of a burst's rows may proceed to the shared
+    admission gate; the remainder is the session's own excess and the
+    CALLER sheds it with an explicit SHED verdict attributed to that
+    session. Keys that stop submitting cost nothing (their bucket just
+    sits in the dict until `forget()`); at bench scale only submitting
+    sessions ever materialize an entry."""
+
+    def __init__(self, capacity_rows_per_sec: Optional[float] = None,
+                 session_share: float = 0.25, burst_s: float = 0.25,
+                 clock: Callable[[], float] = time.perf_counter):
+        if not 0.0 < session_share <= 1.0:
+            raise ValueError(f"session_share must be in (0, 1], "
+                             f"got {session_share}")
+        if burst_s <= 0.0:
+            raise ValueError(f"burst_s must be > 0, got {burst_s}")
+        self.session_share = session_share
+        self.burst_s = burst_s
+        self.clock = clock
+        self.capacity_rows_per_sec = capacity_rows_per_sec
+        # key -> [tokens, last_refill]
+        self._buckets: Dict = {}
+        self.rows_capped = 0
+        self.sessions_capped = 0
+
+    def set_capacity(self, rows_per_sec: float) -> None:
+        """Track the fleet capacity the shares are fractions of; resets
+        no per-key state (a live capacity change must not refill a
+        flooder's bucket)."""
+        if rows_per_sec <= 0:
+            raise ValueError(f"capacity must be > 0 rows/s, "
+                             f"got {rows_per_sec}")
+        self.capacity_rows_per_sec = float(rows_per_sec)
+
+    def _rate(self) -> float:
+        return self.capacity_rows_per_sec * self.session_share
+
+    def allow(self, key: int, n_rows: int,
+              now: Optional[float] = None) -> int:
+        """How many of this session's `n_rows` proceed to shared
+        admission. With no measured capacity the gate is wide open
+        (same evidence rule as the shared bucket)."""
+        if self.capacity_rows_per_sec is None or n_rows == 0:
+            return n_rows
+        if now is None:
+            now = self.clock()
+        rate = self._rate()
+        depth = rate * self.burst_s
+        b = self._buckets.get(key)
+        if b is None:
+            b = self._buckets[key] = [depth, now]  # new sessions start full
+        else:
+            b[0] = min(depth, b[0] + (now - b[1]) * rate)
+            b[1] = now
+        grant = int(min(n_rows, max(0.0, b[0])))
+        b[0] -= grant
+        if grant < n_rows:
+            self.rows_capped += n_rows - grant
+            self.sessions_capped += 1
+        return grant
+
+    def forget(self, key: int) -> None:
+        self._buckets.pop(key, None)
+
+    def stats(self) -> Dict:
+        return {
+            "session_share": self.session_share,
+            "burst_s": self.burst_s,
+            "capacity_rows_per_sec": self.capacity_rows_per_sec,
+            "tracked_sessions": len(self._buckets),
+            "rows_capped": int(self.rows_capped),
+            "cap_events": int(self.sessions_capped),
         }

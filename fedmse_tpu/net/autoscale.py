@@ -65,6 +65,71 @@ class BackendSpec:
         return self.usd_per_hour / (self.rows_per_sec * 3600.0 / 1e6)
 
 
+@dataclasses.dataclass(frozen=True)
+class FrontendSpec:
+    """One ingest-frontend flavor (the gateway plane's cost-model row).
+
+    Frontends are CONNECTION-bound and CPU-cheap: their three capacity
+    axes are how many mostly-idle sessions one process can hold
+    (session table + epoll budget), how many HMAC handshakes/s it can
+    terminate, and how many rows/s it can frame-check and forward (all
+    measured by bench_gateway.py, none of them scoring compute). A
+    frontend never scores a row, so its sizing is INDEPENDENT of the
+    replica mix — plan_split sizes the two classes separately."""
+
+    name: str = "frontend"
+    max_sessions: int = 200_000
+    handshakes_per_sec: float = 3000.0
+    mux_rows_per_sec: float = 500_000.0
+    usd_per_hour: float = 0.05
+    max_frontends: int = 64
+
+    def __post_init__(self):
+        if (self.max_sessions <= 0 or self.handshakes_per_sec <= 0
+                or self.mux_rows_per_sec <= 0 or self.usd_per_hour < 0):
+            raise ValueError(f"frontend {self.name!r}: capacities must be "
+                             f"> 0 and price >= 0")
+
+
+def plan_split(demand_rows_per_sec: float, concurrent_sessions: float,
+               handshake_rate_per_sec: float, frontend: FrontendSpec,
+               backends: Sequence[BackendSpec],
+               target_utilization: float = 0.6) -> Dict:
+    """Two-class sizing for the frontend/replica split: frontends by
+    the max over their three connection-bound axes, replicas by the
+    compute-bound plan_mix — independently, because the classes share
+    no resource (a session parked on a frontend costs the scoring fleet
+    nothing; a scored row costs the frontend one token check). The bill
+    is the sum; `frontend_axis` names which axis bound the frontend
+    count (the gateway bench's 1M-session shape is session-bound at
+    ~zero rows/s — connection count and rows/s are separate first-class
+    axes, which is the whole point of the split)."""
+    tu = target_utilization
+    axes = {
+        "sessions": concurrent_sessions / (frontend.max_sessions * tu),
+        "handshakes": handshake_rate_per_sec / (frontend.handshakes_per_sec
+                                                * tu),
+        "mux_rows": demand_rows_per_sec / (frontend.mux_rows_per_sec * tu),
+    }
+    axis = max(axes, key=axes.get)
+    uncapped = max(1, math.ceil(axes[axis]))
+    n_front = min(uncapped, frontend.max_frontends)
+    mix = plan_mix(demand_rows_per_sec, backends, tu)
+    front_cost = n_front * frontend.usd_per_hour
+    replica_cost = sum(b.usd_per_hour * mix.get(b.name, 0)
+                       for b in backends)
+    return {
+        "frontends": n_front,
+        "frontends_uncapped": uncapped,
+        "frontend_axis": axis,
+        "frontend_axis_loads": {k: round(v, 4) for k, v in axes.items()},
+        "replicas": mix,
+        "frontend_usd_per_hour": round(front_cost, 6),
+        "replica_usd_per_hour": round(replica_cost, 6),
+        "usd_per_hour": round(front_cost + replica_cost, 6),
+    }
+
+
 @dataclasses.dataclass
 class ScaleDecision:
     action: str                  # 'hold' | 'scale_up' | 'scale_down'
@@ -132,6 +197,7 @@ class SLOAutoscaler:
                  scale_down_utilization: float = 0.3,
                  min_bucket: int = 64, max_bucket: int = 4096,
                  cooldown_s: float = 5.0,
+                 scale_down_confirm_ticks: int = 1,
                  clock: Callable[[], float] = time.perf_counter):
         if budget_ms <= 0:
             raise ValueError(f"budget_ms must be > 0, got {budget_ms}")
@@ -148,8 +214,20 @@ class SLOAutoscaler:
         self.min_bucket = min_bucket
         self.max_bucket = max_bucket
         self.cooldown_s = cooldown_s
+        # scale-down must be CONFIRMED by this many consecutive
+        # shrink-eligible ticks (1 = immediate, the historical
+        # behavior). This is the cost-gaming defense (redteam/ingest.py
+        # CostGamingAdversary): an adversary squeezing its load into
+        # brief lulls can otherwise walk the fleet down right as its
+        # next burst lands — paying the scale-up lag on every cycle.
+        # Clean cost is zero: a genuinely idle plane still scales down,
+        # just `confirm_ticks` ticks later.
+        if scale_down_confirm_ticks < 1:
+            raise ValueError("scale_down_confirm_ticks must be >= 1")
+        self.scale_down_confirm_ticks = scale_down_confirm_ticks
         self.clock = clock
         self._last_change: Optional[float] = None
+        self._shrink_streak = 0
         self.decisions: List[ScaleDecision] = []
 
     # ----------------------------- policy -------------------------------- #
@@ -191,15 +269,22 @@ class SLOAutoscaler:
         # a p99 breach scales up even when the demand EMA looks covered:
         # the SLO signal is ground truth, the EMA can lag a burst
         grow = sum(target.values()) > cur_total or over_budget
-        shrink = (sum(target.values()) < cur_total
-                  and util < self.scale_down_utilization
-                  and not over_budget)
+        shrink_eligible = (sum(target.values()) < cur_total
+                           and util < self.scale_down_utilization
+                           and not over_budget)
+        self._shrink_streak = (self._shrink_streak + 1 if shrink_eligible
+                               else 0)
+        shrink = (shrink_eligible
+                  and self._shrink_streak >= self.scale_down_confirm_ticks)
         in_cooldown = (self._last_change is not None
                        and now - self._last_change < self.cooldown_s)
         if in_cooldown or not (grow or shrink):
             d = ScaleDecision(
                 "hold", dict(current), bucket,
                 ("cooldown" if in_cooldown else
+                 f"awaiting scale-down confirmation "
+                 f"({self._shrink_streak}/{self.scale_down_confirm_ticks})"
+                 if shrink_eligible else
                  f"util {util:.2f} within "
                  f"[{self.scale_down_utilization}, "
                  f"{self.target_utilization}], p99 within budget"),

@@ -173,6 +173,7 @@ class Router:
 
     def __init__(self, replicas: List, roster=None,
                  admission: Optional[AdmissionController] = None,
+                 isolation=None,
                  clock: Callable[[], float] = time.perf_counter):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -189,10 +190,16 @@ class Router:
         self.roster = (roster if roster is not None
                        else getattr(replicas[0].engine, "roster", None))
         self.admission = admission
+        # optional per-session rate gate (admission.SessionIsolation):
+        # rides in front of the SHARED bucket so one flooding session
+        # spends its own cap, not the fleet's. Engaged only for bursts
+        # that arrive with a session_key (the gateway plane's frontends)
+        self.isolation = isolation
         self.clock = clock
         self._rr = 0  # round-robin cursor
         self.rows_routed = 0
         self.rows_unknown = 0
+        self.rows_isolated = 0
         self.swaps: List[Dict] = []
 
     @property
@@ -202,7 +209,8 @@ class Router:
     # ----------------------------- intake -------------------------------- #
 
     def submit_many(self, rows, gateway_ids, tiers=None,
-                    age_s: Optional[float] = None) -> RouteResult:
+                    age_s: Optional[float] = None,
+                    session_key=None) -> RouteResult:
         """Route one burst; every row leaves with exactly one terminal
         status (module docstring). `age_s` is how long the burst queued
         before reaching the router (the server computes it from the
@@ -229,6 +237,18 @@ class Router:
                 res.statuses[oob] = STATUS_UNKNOWN_GATEWAY
                 alive &= ~oob
                 self.rows_unknown += int(oob.sum())
+        if (self.isolation is not None and session_key is not None
+                and alive.any()):
+            # per-session cap BEFORE the shared bucket: excess rows shed
+            # from the burst's tail so the grant stays contiguous-prefix
+            # (ordering within a session's burst is oldest-first)
+            navl = int(alive.sum())
+            grant = self.isolation.allow(session_key, navl, now=self.clock())
+            if grant < navl:
+                idx = np.flatnonzero(alive)[grant:]
+                res.statuses[idx] = STATUS_SHED
+                alive[idx] = False
+                self.rows_isolated += navl - grant
         if self.admission is not None and alive.any():
             t = (np.zeros(n, np.uint8) if tiers is None
                  else np.minimum(
@@ -356,6 +376,9 @@ class Router:
         }
         if self.admission is not None:
             out["admission"] = self.admission.stats()
+        if self.isolation is not None:
+            out["rows_isolated"] = self.rows_isolated
+            out["isolation"] = self.isolation.stats()
         return out
 
 

@@ -409,26 +409,22 @@ class FrontHandle:
 
 # ------------------------ synthetic deployment ------------------------- #
 
-def build_synthetic_router(n_gateways: int = 10, dim: int = 115,
-                           replicas: int = 2, max_batch: int = 1024,
-                           latency_budget_ms: float = 25.0,
-                           tiers: int = 3, seed: int = 0,
-                           model_type: str = "hybrid",
-                           headroom: float = 0.9,
-                           calibrate: bool = True,
-                           warmup: bool = True,
-                           return_factory: bool = False):
-    """A self-contained serving plane over a synthetic federation — the
-    bench_serve recipe (paper-dimension models, independent inits,
-    centroids fit on synthetic normals) wrapped in replicas + admission.
-    Scoring throughput is training-quality-independent, so this is the
-    deployment every measurement/worker process reconstructs from the
-    (seed, dims) tuple alone.
+def build_synthetic_replicas(n_gateways: int = 10, dim: int = 115,
+                             replicas: int = 2, max_batch: int = 1024,
+                             latency_budget_ms: float = 25.0,
+                             seed: int = 0, model_type: str = "hybrid",
+                             warmup: bool = True,
+                             return_factory: bool = False):
+    """The replica-fleet half of the synthetic deployment: warmed
+    LocalReplicas over paper-dimension models with independent inits and
+    a shared calibration, reconstructed from (seed, dims) alone — so the
+    net plane's router (build_synthetic_router) and the gateway plane's
+    FailoverStripe (gateway/frontend.py owns its own Router + admission)
+    build the SAME scoring fleet, and their verdicts are bit-comparable.
 
     `return_factory=True` additionally returns a LocalReplica factory
-    building warmed replicas of the SAME deployment — the
-    `NetFront(replica_factory=...)` hook live autoscale apply grows the
-    fleet through (_autoscale_tick)."""
+    building warmed replicas of the same deployment — the live
+    autoscale-apply hook."""
     import jax
 
     from fedmse_tpu.models import init_stacked_params, make_model
@@ -457,16 +453,8 @@ def build_synthetic_router(n_gateways: int = 10, dim: int = 115,
                                 max_batch=max_batch,
                                 latency_budget_ms=latency_budget_ms,
                                 calibration=calibration)
-    from fedmse_tpu.net.admission import AdmissionController
-    router = Router(local, admission=AdmissionController(
-        tiers=tiers, headroom=headroom,
-        stale_after_s=latency_budget_ms / 1000.0))
-    if calibrate:
-        probe = rng.normal(size=(max_batch, dim)).astype(np.float32)
-        probe_g = rng.integers(0, n_gateways, max_batch).astype(np.int32)
-        router.calibrate_capacity(probe, probe_g)
     if not return_factory:
-        return router
+        return local
 
     def replica_factory(i: int) -> LocalReplica:
         eng = factory(i)
@@ -476,6 +464,44 @@ def build_synthetic_router(n_gateways: int = 10, dim: int = 115,
                             latency_budget_ms=latency_budget_ms,
                             calibration=calibration, name=f"replica{i}")
 
+    return local, replica_factory
+
+
+def build_synthetic_router(n_gateways: int = 10, dim: int = 115,
+                           replicas: int = 2, max_batch: int = 1024,
+                           latency_budget_ms: float = 25.0,
+                           tiers: int = 3, seed: int = 0,
+                           model_type: str = "hybrid",
+                           headroom: float = 0.9,
+                           calibrate: bool = True,
+                           warmup: bool = True,
+                           return_factory: bool = False):
+    """A self-contained serving plane over a synthetic federation — the
+    bench_serve recipe (build_synthetic_replicas) wrapped in a Router +
+    admission. Scoring throughput is training-quality-independent, so
+    this is the deployment every measurement/worker process
+    reconstructs from the (seed, dims) tuple alone.
+
+    `return_factory=True` additionally returns the LocalReplica factory
+    (`NetFront(replica_factory=...)` — live autoscale apply grows the
+    fleet through _autoscale_tick)."""
+    built = build_synthetic_replicas(
+        n_gateways=n_gateways, dim=dim, replicas=replicas,
+        max_batch=max_batch, latency_budget_ms=latency_budget_ms,
+        seed=seed, model_type=model_type, warmup=warmup,
+        return_factory=return_factory)
+    local, replica_factory = built if return_factory else (built, None)
+    from fedmse_tpu.net.admission import AdmissionController
+    router = Router(local, admission=AdmissionController(
+        tiers=tiers, headroom=headroom,
+        stale_after_s=latency_budget_ms / 1000.0))
+    if calibrate:
+        rng = np.random.default_rng(seed + 1)  # probe values are inert
+        probe = rng.normal(size=(max_batch, dim)).astype(np.float32)
+        probe_g = rng.integers(0, n_gateways, max_batch).astype(np.int32)
+        router.calibrate_capacity(probe, probe_g)
+    if not return_factory:
+        return router
     return router, replica_factory
 
 
@@ -493,6 +519,11 @@ def main(argv=None) -> None:
     p.add_argument("--budget-ms", type=float, default=25.0)
     p.add_argument("--tiers", type=int, default=3)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--model-type", default="hybrid",
+                   choices=["hybrid", "autoencoder"],
+                   help="per-gateway scorer; 'autoencoder' skips the "
+                        "kNN bank and is the only tractable choice for "
+                        "100k+-gateway single-host workers")
     p.add_argument("--no-admission", action="store_true",
                    help="serve without a capacity bucket (a replica "
                         "worker behind a front-tier router: the FRONT "
@@ -529,7 +560,7 @@ def main(argv=None) -> None:
     router, replica_factory = build_synthetic_router(
         n_gateways=args.gateways, dim=args.dim, replicas=args.replicas,
         max_batch=args.max_batch, latency_budget_ms=args.budget_ms,
-        tiers=args.tiers, seed=args.seed,
+        tiers=args.tiers, seed=args.seed, model_type=args.model_type,
         calibrate=not args.no_admission, return_factory=True)
     if args.no_admission:
         router.admission = None

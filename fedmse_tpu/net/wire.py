@@ -244,24 +244,44 @@ def read_frame_blocking(sock) -> memoryview:
 
 class FrameBuffer:
     """Incremental frame splitter for a non-blocking socket: feed() raw
-    bytes as they arrive, iterate complete payloads."""
+    bytes as they arrive, iterate complete payloads.
+
+    Consumption is an OFFSET, not a del-from-front: deleting a frame's
+    bytes off the head of the bytearray memmoves the whole remainder,
+    which turns a backlog of K small frames (the gateway plane's
+    handshake storms: thousands of ~50-byte frames buffered behind one
+    feed) into O(K * backlog) copying. The offset advances per frame
+    and the buffer compacts once — when fully consumed (free) or when
+    the dead prefix outgrows _COMPACT_AT (one amortized memmove)."""
+
+    _COMPACT_AT = 64 * 1024
 
     def __init__(self):
         self._buf = bytearray()
+        self._off = 0   # bytes already consumed off the front
+
+    def __len__(self) -> int:
+        return len(self._buf) - self._off
 
     def feed(self, data: bytes) -> None:
         self._buf.extend(data)
 
     def frames(self):
         while True:
-            if len(self._buf) < 4:
-                return
-            (n,) = _LEN.unpack_from(self._buf, 0)
+            avail = len(self._buf) - self._off
+            if avail < 4:
+                break
+            (n,) = _LEN.unpack_from(self._buf, self._off)
             if n > MAX_FRAME:
                 raise WireError(f"frame length {n} exceeds MAX_FRAME "
                                 f"{MAX_FRAME}")
-            if len(self._buf) < 4 + n:
-                return
-            payload = bytes(self._buf[4:4 + n])
-            del self._buf[:4 + n]
+            if avail < 4 + n:
+                break
+            start = self._off + 4
+            payload = bytes(self._buf[start:start + n])
+            self._off = start + n
             yield memoryview(payload)
+        if self._off and (self._off >= len(self._buf)
+                          or self._off > self._COMPACT_AT):
+            del self._buf[:self._off]
+            self._off = 0

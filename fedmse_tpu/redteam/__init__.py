@@ -10,6 +10,7 @@ decides, and carries the defense knobs measured against each attack:
   * adversary.py — compiled update/merge poison hooks + election flags
   * mimicry.py   — latent-stats forgery for cluster-assignment poisoning
   * traffic.py   — the adaptive slow-drift flywheel self-poisoner
+  * ingest.py    — gateway-plane attacks: shed-storm forcing + cost gaming
 
 Attack-success-rate-vs-defense grids: redteam_sweep.py -> REDTEAM_r17.json
 (`make redteam-sweep`); the reduced regression guard is bench_suite
@@ -22,6 +23,9 @@ from fedmse_tpu.redteam.adversary import (MERGE_POISON_FOLD,
 from fedmse_tpu.redteam.masks import (RedteamMasks, coalition_mask,
                                       make_redteam_masks, null_redteam_masks,
                                       tenure_vote_ok)
+from fedmse_tpu.redteam.ingest import (CostGamingAdversary,
+                                       ShedStormAdversary, cost_gaming_cell,
+                                       shed_storm_cell)
 from fedmse_tpu.redteam.mimicry import (assignment_capture_rate,
                                         mimic_latent_stats)
 from fedmse_tpu.redteam.spec import POISON_KINDS, REDTEAM_KINDS, RedteamSpec
@@ -35,4 +39,6 @@ __all__ = [
     "UPDATE_POISON_FOLD", "MERGE_POISON_FOLD",
     "mimic_latent_stats", "assignment_capture_rate",
     "SlowDriftAdversary", "normal_fraction",
+    "ShedStormAdversary", "shed_storm_cell",
+    "CostGamingAdversary", "cost_gaming_cell",
 ]

@@ -632,6 +632,13 @@ def main():
         emit(r)
     wv_row, wv = waiver_abuse_cell()
     emit(wv_row)
+    from fedmse_tpu.redteam import cost_gaming_cell, shed_storm_cell
+    st_rows, st = shed_storm_cell()
+    for r in st_rows:
+        emit({"cell": "shed_storm", **r})
+    cg_rows, cg = cost_gaming_cell()
+    for r in cg_rows:
+        emit({"cell": "cost_gaming", **r})
 
     def factor(a, b, floor=1e-9):
         return round(a / max(b, floor), 2)
@@ -698,11 +705,45 @@ def main():
             "met": bool(wv["defended_waived"]
                         <= 0.5 * wv["undefended_waived"]),
         },
+        # the ingest plane (gateway/): authenticated-coalition attacks
+        # on the two post-handshake decisions (redteam/ingest.py)
+        "shed_storm": {
+            "undefended_honest_shed_frac":
+                round(st["undefended_honest_shed_frac"], 4),
+            "defended_honest_shed_frac":
+                round(st["defended_honest_shed_frac"], 4),
+            "defense_factor": factor(st["undefended_honest_shed_frac"],
+                                     st["defended_honest_shed_frac"]),
+            "clean_cost_shed_frac": round(st["clean_cost_shed_frac"], 6),
+            "met": bool(st["undefended_honest_shed_frac"] >= 0.5
+                        and st["defended_honest_shed_frac"]
+                        <= 0.1 * st["undefended_honest_shed_frac"]
+                        and st["clean_cost_shed_frac"] <= 1e-6
+                        and st["clean_rows_isolated"] == 0),
+        },
+        "cost_gaming": {
+            "undefended_shed_rows": round(cg["undefended_shed_rows"], 1),
+            "defended_shed_rows": round(cg["defended_shed_rows"], 1),
+            "shed_defense_factor": factor(cg["undefended_shed_rows"],
+                                          cg["defended_shed_rows"]),
+            "scale_flaps": {"undefended": cg["undefended_scale_flaps"],
+                            "defended": cg["defended_scale_flaps"]},
+            "flap_defense_factor": factor(cg["undefended_scale_flaps"],
+                                          cg["defended_scale_flaps"]),
+            "clean_extra_usd": cg["clean_extra_usd"],
+            "met": bool(cg["defended_shed_rows"]
+                        <= 0.5 * cg["undefended_shed_rows"]
+                        and cg["defended_scale_flaps"]
+                        <= 0.5 * cg["undefended_scale_flaps"]
+                        and cg["clean_overload_ticks_defended"] == 0),
+        },
     }
     acceptance["met"] = bool(
         acceptance["defenses_off_bitwise"]
         and acceptance["cluster"]["met"] and acceptance["flywheel"]["met"]
-        and acceptance["sybil"]["met"] and acceptance["waiver"]["met"])
+        and acceptance["sybil"]["met"] and acceptance["waiver"]["met"]
+        and acceptance["shed_storm"]["met"]
+        and acceptance["cost_gaming"]["met"])
 
     device = jax.devices()[0]
     out = {

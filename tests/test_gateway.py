@@ -1,0 +1,347 @@
+"""Gateway ingest-plane tests (fedmse_tpu/gateway/, DESIGN.md §22):
+mux wire roundtrips, per-device key derivation + transcript MACs, the
+handshake-time roster gate (every reject path pinned at ZERO parsed row
+bytes), session reuse + parking across bursts, frontend-striped scoring
+bit-identical to a direct net-plane router over the same seeded fleet,
+failover with zero admitted-ticket loss, the per-session isolation cap
+through the router's session_key path, FrameBuffer offset consumption,
+and the two-class (frontend/replica) autoscale sizing with scale-down
+confirmation hysteresis."""
+
+import time
+
+import numpy as np
+import pytest
+
+from fedmse_tpu.gateway import auth, mux
+from fedmse_tpu.gateway.client import GatewayClient
+from fedmse_tpu.gateway.frontend import (FrontendHandle,
+                                         build_synthetic_frontend)
+from fedmse_tpu.gateway.stripe import FailoverStripe
+from fedmse_tpu.net import wire
+from fedmse_tpu.net.admission import SessionIsolation
+from fedmse_tpu.net.autoscale import (BackendSpec, FrontendSpec,
+                                      SLOAutoscaler, plan_split)
+from fedmse_tpu.net.router import Router
+from fedmse_tpu.net.server import build_synthetic_replicas
+from fedmse_tpu.redteam.ingest import InstantReplica
+from fedmse_tpu.serving.engine import ServingRoster
+
+pytestmark = pytest.mark.gateway
+
+DIM = 12
+N = 16
+
+
+def _wait(pred, timeout_s=20.0, tick=0.005):
+    deadline = time.time() + timeout_s
+    while not pred():
+        if time.time() > deadline:
+            raise TimeoutError("condition not met in time")
+        time.sleep(tick)
+
+
+def _wait_reject(client, code, timeout_s=20.0):
+    _wait(lambda: (client.poll(),
+                   any(c == code for _, c, _ in client.rejects))[1],
+          timeout_s=timeout_s)
+
+
+def _small_front(**kw):
+    kw.setdefault("n_gateways", N)
+    kw.setdefault("dim", DIM)
+    kw.setdefault("replicas", 1)
+    kw.setdefault("max_batch", 32)
+    kw.setdefault("model_type", "autoencoder")
+    kw.setdefault("seed", 0)
+    return build_synthetic_frontend(**kw)
+
+
+# ------------------------------- wire ---------------------------------- #
+
+
+def test_mux_roundtrips():
+    fb = wire.FrameBuffer()
+    cn, sn = auth.new_nonce(), auth.new_nonce()
+    token = auth.new_nonce()
+    rows = np.arange(3 * DIM, dtype=np.float32).reshape(3, DIM)
+    statuses = np.array([0, 1, 2], np.uint8)
+    scores = np.array([0.5, 2.0, np.nan], np.float32)
+    fb.feed(mux.pack_hello(7, 3, cn))
+    fb.feed(mux.pack_challenge(7, sn))
+    fb.feed(mux.pack_auth(7, b"m" * mux.MAC_LEN))
+    fb.feed(mux.pack_welcome(7, token))
+    fb.feed(mux.pack_reject(9, mux.REJ_BAD_MAC, "nope"))
+    fb.feed(mux.pack_submit(7, 11, token, rows, tier=2))
+    fb.feed(mux.pack_result(7, 11, statuses, scores))
+    fb.feed(mux.pack_simple(mux.G_PING, 7, 5))
+    frames = list(fb.frames())
+    assert [mux.parse_gheader(p)[0] for p in frames] == [
+        mux.G_HELLO, mux.G_CHALLENGE, mux.G_AUTH, mux.G_WELCOME,
+        mux.G_REJECT, mux.G_SUBMIT, mux.G_RESULT, mux.G_PING]
+    assert mux.unpack_hello(frames[0]) == (7, 3, cn)
+    assert mux.unpack_challenge(frames[1]) == (7, sn)
+    assert mux.unpack_auth(frames[2]) == (7, b"m" * mux.MAC_LEN)
+    assert mux.unpack_welcome(frames[3]) == (7, token)
+    assert mux.unpack_reject(frames[4]) == (9, mux.REJ_BAD_MAC, "nope")
+    # the token reads BEFORE the row block — the pre-parse check order
+    assert mux.submit_token(frames[5]) == token
+    seq, r2, tier, t_sent = mux.unpack_submit_rows(frames[5])
+    assert seq == 11 and tier == 2 and t_sent > 0
+    np.testing.assert_array_equal(np.asarray(r2), rows)
+    rgid, rseq, st2, sc2 = mux.unpack_result(frames[6])
+    assert (rgid, rseq) == (7, 11)
+    np.testing.assert_array_equal(st2, statuses)
+    np.testing.assert_array_equal(sc2, scores)
+
+
+def test_framebuffer_offset_consumption():
+    """Frames arrive in arbitrary chunk boundaries; the buffer yields
+    whole payloads, keeps partial tails, and compacts via offset (no
+    per-frame memmove)."""
+    fb = wire.FrameBuffer()
+    frames = [mux.pack_simple(mux.G_PING, i) for i in range(50)]
+    blob = b"".join(frames)
+    got = []
+    for i in range(0, len(blob), 7):      # deliberately frame-misaligned
+        fb.feed(blob[i:i + 7])
+        got.extend(mux.parse_gheader(p)[2] for p in fb.frames())
+    assert got == list(range(50))
+    assert len(fb) == 0
+    assert fb._off == 0                    # fully-consumed buffer compacted
+
+
+def test_auth_key_derivation_and_mac():
+    master = auth.master_key(seed=3)
+    k = auth.gateway_key(master, 5, 0)
+    assert k != auth.gateway_key(master, 6, 0)       # per-device
+    assert k != auth.gateway_key(master, 5, 1)       # per-generation
+    cn, sn = auth.new_nonce(), auth.new_nonce()
+    mac = auth.session_mac(k, 5, 0, cn, sn)
+    assert auth.verify_session_mac(k, 5, 0, cn, sn, mac)
+    assert not auth.verify_session_mac(k, 5, 0, sn, cn, mac)  # transcript
+    wrong = auth.gateway_key(master, 5, 1)
+    assert not auth.verify_session_mac(wrong, 5, 0, cn, sn, mac)
+
+
+# ----------------------- handshake: the identity gate ------------------- #
+
+
+def test_handshake_rejects_terminate_before_any_row_parse():
+    """Every reject path — unknown id, retired slot, wrong generation,
+    wrong key, forged token — terminates with the frontend having
+    parsed ZERO row bytes (`rows_parsed` is incremented only after
+    token verification, and the roster gate fires at G_HELLO)."""
+    front = _small_front(warmup=False, calibrate=False)
+    front.router.roster.member[3] = False            # a retired slot
+    h = FrontendHandle(front)
+    master = auth.master_key(seed=0)
+    try:
+        c = GatewayClient("127.0.0.1", h.port, master=master)
+        assert not c.authenticate(N + 50)            # out of roster range
+        assert not c.authenticate(3)                 # retired slot
+        assert not c.authenticate(4, generation=9)   # generation mismatch
+        assert [code for _, code, _ in c.rejects] == [
+            mux.REJ_UNKNOWN_GATEWAY] * 3
+
+        bad = GatewayClient("127.0.0.1", h.port,
+                            key_fn=lambda g, gen: b"\x00" * 32)
+        assert not bad.authenticate(5)               # wrong enrollment key
+        assert bad.rejects[-1][1] == mux.REJ_BAD_MAC
+
+        # a REAL session, then a forged bearer token on it: the token
+        # check runs before unpack_submit_rows ever touches the rows
+        assert c.authenticate(2)
+        rows = np.zeros((4, DIM), np.float32)
+        c._send(mux.pack_submit(2, 1, b"\x00" * mux.TOKEN_LEN, rows))
+        _wait_reject(c, mux.REJ_BAD_TOKEN)
+        assert front.rows_parsed == 0
+        assert front.rejects["unknown_gateway"] == 3
+        assert front.rejects["bad_mac"] == 1
+        assert front.rejects["bad_token"] == 1
+        c.close()
+        bad.close()
+    finally:
+        h.stop()
+
+
+def test_session_reuse_parking_and_roster_eviction():
+    front = _small_front(park_after_s=0.15)
+    h = FrontendHandle(front)
+    try:
+        c = GatewayClient("127.0.0.1", h.port, master=auth.master_key(seed=0))
+        assert c.authenticate_many(range(4)) == 4
+        rng = np.random.default_rng(1)
+        for burst in range(3):                       # reuse, no re-handshake
+            for gid in range(4):
+                c.submit(gid, rng.normal(size=(8, DIM)).astype(np.float32))
+            c.wait_all()
+        assert front.table.handshakes_ok == 4        # one handshake each
+        assert len(c.results) == 12
+        assert all(len(st) == 8 for st, _, _ in c.results.values())
+
+        _wait(lambda: front.table.stats()["parked"] == 4, timeout_s=10.0)
+        # traffic on a parked session unparks it, no new handshake
+        c.submit(1, rng.normal(size=(2, DIM)).astype(np.float32))
+        c.wait_all()
+        assert front.table.handshakes_ok == 4
+
+        # roster swap retiring slot 1 evicts its session; its next
+        # submit dies with BAD_STATE (no session), never a scored row
+        roster2 = ServingRoster(member=np.r_[True, np.zeros(1, bool),
+                                             np.ones(N - 2, bool)],
+                                generation=np.zeros(N, np.int64))
+        event = front.swap(roster=roster2)
+        assert event["sessions_evicted"] == 1
+        parsed = front.rows_parsed
+        c._send(mux.pack_submit(1, 99, c.sessions[1].token,
+                                np.zeros((2, DIM), np.float32)))
+        _wait_reject(c, mux.REJ_BAD_STATE)
+        assert front.rows_parsed == parsed
+        c.close()
+    finally:
+        h.stop()
+
+
+# -------------------- scoring equivalence through the stripe ------------ #
+
+
+def test_frontend_striped_scoring_bit_identical_to_direct_router():
+    """The frontend is auth + admission in FRONT of the net plane, not a
+    new scoring path: the same seeded fleet scores the same rows to the
+    same bits whether driven directly or through handshake + mux +
+    stripe."""
+    seed, reps, mb = 7, 2, 32
+    rng = np.random.default_rng(99)
+    rows = rng.normal(size=(48, DIM)).astype(np.float32)
+    gid = 5
+
+    direct = Router(build_synthetic_replicas(
+        n_gateways=N, dim=DIM, replicas=reps, max_batch=mb, seed=seed,
+        model_type="autoencoder"))
+    res = direct.submit_many(rows, np.int32(gid))
+    while not res.done:
+        direct.poll()
+    res.finalize()
+
+    front = _small_front(replicas=reps, max_batch=mb, seed=seed,
+                         calibrate=False, isolation_on=False)
+    h = FrontendHandle(front)
+    try:
+        c = GatewayClient("127.0.0.1", h.port,
+                          master=auth.master_key(seed=seed))
+        assert c.authenticate(gid)
+        seq = c.submit(gid, rows)
+        c.wait_all()
+        statuses, scores, _ = c.results[(gid, seq)]
+        np.testing.assert_array_equal(statuses, res.statuses)
+        np.testing.assert_array_equal(scores, res.scores)  # bitwise
+        c.close()
+    finally:
+        h.stop()
+
+
+def test_stripe_failover_zero_admitted_ticket_loss():
+    """A member dying mid-flight: its in-flight pieces retry on the
+    survivor; every admitted row still reaches exactly one terminal
+    status."""
+    reps = build_synthetic_replicas(n_gateways=N, dim=DIM, replicas=2,
+                                    max_batch=16, seed=1,
+                                    model_type="autoencoder")
+
+    class Dying:
+        def __init__(self, inner):
+            self.inner = inner
+            self.dead = False
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+        def poll(self):
+            if self.dead:
+                raise RuntimeError("replica killed mid-flight")
+            return self.inner.poll()
+
+    dying = Dying(reps[0])
+    stripe = FailoverStripe([dying, reps[1]])
+    rng = np.random.default_rng(2)
+    rows = rng.normal(size=(64, DIM)).astype(np.float32)
+    blk = stripe.submit_many(rows, np.full(64, 3, np.int32))
+    dying.dead = True                      # dies with pieces outstanding
+    deadline = time.time() + 30
+    while not blk.done:
+        stripe.poll()
+        assert time.time() < deadline
+    st = stripe.stats()
+    assert len(st["failover_events"]) >= 1 and st["alive"] == 1
+    assert len(blk.scores) == 64 and np.isfinite(blk.scores).all()
+
+
+# ------------------------- isolation (shed storm) ----------------------- #
+
+
+def test_session_isolation_caps_flooder_not_honest():
+    t = [0.0]
+    iso = SessionIsolation(capacity_rows_per_sec=1000.0, session_share=0.1,
+                           clock=lambda: t[0])
+    roster = ServingRoster(member=np.ones(4, bool),
+                           generation=np.zeros(4, np.int64))
+    router = Router([InstantReplica(4)], roster=roster, isolation=iso,
+                    clock=lambda: t[0])
+    rows = np.zeros((500, DIM), np.float32)
+    res = router.submit_many(rows, np.int32(1), session_key=1)
+    res.finalize()
+    flood_shed = int((res.statuses == wire.STATUS_SHED).sum())
+    assert flood_shed >= 400                # capped at ~share * burst depth
+    assert router.rows_isolated == flood_shed
+    res2 = router.submit_many(rows[:10], np.int32(2), session_key=2)
+    res2.finalize()
+    assert int((res2.statuses == wire.STATUS_SHED).sum()) == 0
+
+
+# ------------------------ two-class autoscale sizing --------------------- #
+
+
+def test_plan_split_sizes_frontends_and_replicas_independently():
+    fe = FrontendSpec(max_sessions=200_000, handshakes_per_sec=3000.0,
+                      mux_rows_per_sec=500_000.0, usd_per_hour=0.05)
+    be = [BackendSpec("cpu", rows_per_sec=50_000.0, usd_per_hour=0.10)]
+    # the 1M-gateway shape: session-bound at near-zero rows/s — the
+    # frontend count moves, the replica count does not
+    p = plan_split(demand_rows_per_sec=1000.0, concurrent_sessions=1e6,
+                   handshake_rate_per_sec=100.0, frontend=fe, backends=be)
+    assert p["frontend_axis"] == "sessions"
+    assert p["frontends"] == 9              # ceil(1e6 / (200k * 0.6))
+    assert p["replicas"] == {"cpu": 1}
+    # compute-bound shape: replicas move, frontends stay minimal
+    q = plan_split(demand_rows_per_sec=120_000.0, concurrent_sessions=500,
+                   handshake_rate_per_sec=10.0, frontend=fe, backends=be)
+    assert q["frontends"] == 1 and q["replicas"]["cpu"] == 4
+    assert q["frontend_axis"] == "mux_rows"
+    assert q["usd_per_hour"] == pytest.approx(
+        q["frontend_usd_per_hour"] + q["replica_usd_per_hour"])
+
+
+def test_scale_down_requires_confirmation_ticks():
+    t = [0.0]
+    sc = SLOAutoscaler(budget_ms=25.0,
+                       backends=[BackendSpec("cpu", rows_per_sec=10_000.0,
+                                             usd_per_hour=0.1)],
+                       cooldown_s=0.0, scale_down_confirm_ticks=3,
+                       clock=lambda: t[0])
+    cur = {"cpu": 4}
+
+    def tick(arrival):
+        t[0] += 1.0
+        return sc.decide(arrival_rows_per_sec=arrival, p99_ms=None,
+                         current=cur)
+
+    assert tick(500.0).action == "hold"      # streak 1/3
+    assert tick(500.0).action == "hold"      # streak 2/3
+    assert "confirmation" in sc.decisions[-1].reason
+    assert tick(30_000.0).action == "scale_up"   # burst resets the streak
+    cur = {"cpu": 5}
+    assert tick(500.0).action == "hold"
+    assert tick(500.0).action == "hold"
+    d = tick(500.0)                          # streak 3/3 -> confirmed
+    assert d.action == "scale_down" and d.replicas == {"cpu": 1}
