@@ -10,7 +10,8 @@ LIB := fedmse_tpu/native/libfedmse_io.so
 .PHONY: native clean test bench bench-paper bench-scaling bench-suite \
         serve-bench chaos-sweep churn-sweep pipeline-bench precision-bench \
         shard-bench knn-bench cohort-bench flywheel-sweep net-bench \
-        cluster-sweep podscale-bench redteam-sweep gateway-bench tpu-check
+        cluster-sweep podscale-bench redteam-sweep gateway-bench \
+        clustermerge-bench tpu-check
 
 native: $(LIB)
 
@@ -159,6 +160,15 @@ redteam-sweep:
 gateway-bench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		python bench_gateway.py --out BENCH_GATEWAY_r18_cpu.json
+
+# clustered quantized collectives (DESIGN.md §23): the K=8 cluster merge at
+# 10k clients on the virtual 8-device mesh — measured inter-host merge bytes
+# f32 vs lane-sliced int8 (>= 4x at 2 host groups), the plan_merge candidate
+# table, fused clustered rounds with the effective backend recorded, ZeRO
+# client-state residency, and the K=2 quality pin (writes
+# BENCH_CLUSTERMERGE_r19_cpu.json; hermetic CPU like the tests)
+clustermerge-bench:
+	python bench.py --clustermerge-bench --out BENCH_CLUSTERMERGE_r19_cpu.json
 
 tpu-check:
 	python tpu_check.py

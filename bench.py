@@ -686,6 +686,266 @@ def measure_shard(cfg, n_clients: int = 10000, stack_hosts: int = 8,
     return out
 
 
+def measure_clustermerge(cfg, n_clients: int = 10000, k: int = 8):
+    """Clustered quantized collectives at `n_clients` clients / K=`k` on the
+    virtual 8-device mesh (ISSUE 19 tentpole metric; DESIGN.md §23). Row
+    families:
+
+      * the K-cluster merge at 10k — clustered einsum vs clustered
+        shard_map (bitwise pin) vs the hierarchical int8 merge at 2 and 4
+        host groups: sec, the seam's measured wire profile (int8 DCN bytes
+        vs the f32 flat psum on the SAME topology — acceptance pins >= 4x
+        at 2 host groups), and the clustered error bound asserted from the
+        ACTUAL host-group partial [K, ...] sheets;
+      * the `plan_merge` measured candidate table the auto backend picks
+        from (flat f32 vs lane-sliced int8 across group/block candidates);
+      * full fused clustered rounds at 10k (shard_map + quantized
+        backends, pinned assignment) with the EFFECTIVE backend recorded
+        per row — a silent einsum fallback fails the bench;
+      * cross-replica (ZeRO-style) client-state residency: bytes device 0
+        actually holds vs the fleet total;
+      * the quantized K-merge quality pin on the quick-run scale
+        (final-AUC delta vs clustered einsum at K=2, bar 2e-3 — quantized
+        cluster rows are quality-pinned, not bitwise: PARITY.md).
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from fedmse_tpu.cluster import ClusterSpec
+    from fedmse_tpu.cluster.merge import make_clustered_aggregate_fn
+    from fedmse_tpu.config import CompatConfig
+    from fedmse_tpu.data import synthetic_clients
+    from fedmse_tpu.data.stacking import stack_clients, stack_dims
+    from fedmse_tpu.federation import RoundEngine
+    from fedmse_tpu.models import make_model, init_stacked_params
+    from fedmse_tpu.parallel import (client_mesh,
+                                     make_clustered_hierarchical_aggregate,
+                                     make_clustered_shardmap_aggregate,
+                                     pad_to_multiple, shard_clients,
+                                     shard_federation)
+    from fedmse_tpu.parallel.costmodel import plan_merge, seam
+    from fedmse_tpu.parallel.quantize import clustered_quantization_error_bound
+    from fedmse_tpu.utils.seeding import ExperimentRngs
+
+    mesh = client_mesh()
+    assert mesh.devices.size >= 8, (
+        "clustermerge bench needs the 8-virtual-device mesh "
+        "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    dim = cfg.dim_features
+    out = {"n_clients": n_clients, "k": k,
+           "mesh_devices": int(mesh.devices.size),
+           "quant_block_size": cfg.quant_block_size}
+
+    t0 = time.time()
+    clients, dev_x = _light_clients(n_clients, dim)
+    out["clients_build_sec"] = round(time.time() - t0, 2)
+    n_pad = pad_to_multiple(n_clients, mesh.devices.size)
+    dims = stack_dims(clients, cfg.batch_size, pad_clients_to=n_pad)
+
+    # --- the K-cluster merge at n_pad rows ---
+    model = make_model("hybrid", dim, shrink_lambda=cfg.shrink_lambda)
+    params_host = init_stacked_params(model, jax.random.key(0), n_pad)
+    params = shard_clients(params_host, mesh)
+    sel_host = np.zeros(n_pad, np.float32)
+    sel_host[np.random.default_rng(0).choice(n_clients, n_clients // 2,
+                                             replace=False)] = 1.0
+    sel = shard_clients(jnp.asarray(sel_host), mesh)
+    dev = jnp.asarray(dev_x)
+    # co-prime stride spreads every cluster across every host group, so the
+    # inter-group exchange carries ALL K rows (the worst/honest case)
+    cluster_host = ((np.arange(n_pad) * 31) % k).astype(np.int32)
+    cluster = shard_clients(jnp.asarray(cluster_host), mesh)
+    # quantized variants: (row name, host groups, block size). The model's
+    # many small leaves pad each flattened [K, e] row up to whole blocks,
+    # so the byte-optimal block at this scale is 128 (plan_merge measures
+    # exactly this trade: smaller blocks = less pad, more scale words) —
+    # 128 is the plan's byte-minimal 2-group candidate and carries the
+    # >= 4x acceptance pin; the cfg default block rides as a second row
+    quant_variants = [("quantized_g2", 2, 128),
+                     (f"quantized_g2_b{cfg.quant_block_size}", 2,
+                      cfg.quant_block_size),
+                     ("quantized_g4", 4, 128)]
+    merges = {
+        "einsum": make_clustered_aggregate_fn(model, "avg", k),
+        "shard_map": make_clustered_shardmap_aggregate(model, "avg", mesh,
+                                                       k),
+    }
+    for name, n_groups, block in quant_variants:
+        merges[name] = make_clustered_hierarchical_aggregate(
+            model, "avg", mesh, k, num_groups=n_groups, block_size=block)
+    merge_rows, results, profiles = {}, {}, {}
+    for name, fn in merges.items():
+        seam.reset()
+        results[name] = jax.block_until_ready(
+            fn(params, sel, dev, cluster))  # warm (+ trace-time seam note)
+        if name.startswith("quantized"):
+            profiles[name] = seam.snapshot()["merge_profiles"]["quantized"]
+
+        def timed_once(fn=fn):
+            t0 = time.time()
+            r = jax.block_until_ready(fn(params, sel, dev, cluster))
+            return time.time() - t0, r
+
+        sec, _ = _min_over_reps(timed_once)
+        merge_rows[name] = {"sec": round(sec, 5)}
+    cp_e, w_e, has_e = (jax.device_get(x) for x in results["einsum"])
+    cp_s, w_s, has_s = (jax.device_get(x) for x in results["shard_map"])
+    bitwise = (np.array_equal(np.asarray(w_e), np.asarray(w_s))
+               and np.array_equal(np.asarray(has_e), np.asarray(has_s))
+               and all(np.array_equal(a, b) for a, b in
+                       zip(jax.tree.leaves(cp_e), jax.tree.leaves(cp_s))))
+    merge_rows["shard_map"]["bitwise_vs_einsum"] = bool(bitwise)
+    # per-cluster-row bound from the ACTUAL host-group partial sheets (the
+    # sheet-weighted einsum over each group's rows: Σ_g bound(P^(g))[k] —
+    # quantize.clustered_quantization_error_bound; the merged sheet's
+    # maxima would understate it when group partials cancel), exactly what
+    # tests/test_clustermerge.py asserts
+    w_host = np.asarray(w_e)
+    sheetw = np.zeros((k, n_pad), np.float32)
+    sheetw[cluster_host, np.arange(n_pad)] = w_host
+    for name, n_groups, block in quant_variants:
+        cp_q = jax.device_get(results[name][0])
+        rows_per_group = n_pad // n_groups
+        within, max_err, max_bound = True, 0.0, 0.0
+        for leaf_e, leaf_q, leaf_p in zip(jax.tree.leaves(cp_e),
+                                          jax.tree.leaves(cp_q),
+                                          jax.tree.leaves(params_host)):
+            leaf_bound = np.zeros(k, np.float64)
+            for g in range(n_groups):
+                rows = slice(g * rows_per_group, (g + 1) * rows_per_group)
+                part = np.einsum("kn,n...->k...", sheetw[:, rows],
+                                 np.asarray(leaf_p)[rows])
+                leaf_bound += clustered_quantization_error_bound(
+                    part.astype(np.float32), block)
+            err = np.abs(np.asarray(leaf_e, np.float64)
+                         - np.asarray(leaf_q, np.float64)
+                         ).reshape(k, -1).max(axis=1)
+            within = within and bool(np.all(err <= leaf_bound + 1e-6))
+            max_err = max(max_err, float(err.max()))
+            max_bound = max(max_bound, float(leaf_bound.max()))
+        prof = profiles[name]
+        merge_rows[name].update(
+            n_groups=n_groups, block_size=block,
+            max_abs_error_vs_einsum=float(max_err),
+            max_per_cluster_error_bound=float(max_bound),
+            within_bound=bool(within),
+            dcn_payload_bytes=int(prof["dcn_payload_bytes"]),
+            dcn_bytes_int8=int(prof["dcn_bytes"]),
+            dcn_bytes_f32_same_topology=int(
+                prof["dcn_bytes_f32_same_topology"]),
+            dcn_reduction_vs_f32=round(
+                float(prof["dcn_reduction_vs_f32"]), 2))
+    out["merge_10k"] = merge_rows
+    out["merged_model_bytes_per_allgather"] = int(
+        k * sum(int(np.prod(l.shape[1:], dtype=np.int64)) * 4
+                for l in jax.tree.leaves(params_host)))
+
+    # --- the measured plan the auto backend searches over ---
+    elems = [int(np.prod(l.shape[1:], dtype=np.int64))
+             for l in jax.tree.leaves(params_host)]
+    out["merge_plan"] = plan_merge(mesh, elems, k=k, group_counts=(2, 4),
+                                   block_sizes=(128, 256, 512), repeats=2)
+    del results
+
+    # --- full fused clustered round at n_clients on the mesh ---
+    full = stack_clients(clients, dev_x, cfg.batch_size, pad_clients_to=n_pad,
+                         dims=dims)
+    round_cfg = cfg.replace(network_size=n_clients, epochs=1, num_rounds=1,
+                            compat=CompatConfig(vote_tie_break=False))
+    round_rows = {}
+    for backend in ("shard_map", "quantized"):
+        bcfg = round_cfg.replace(aggregation_backend=backend, quant_hosts=2)
+        engine = RoundEngine(model, bcfg, full, n_real=n_clients,
+                             rngs=ExperimentRngs(run=0), model_type="hybrid",
+                             update_type="mse_avg", fused=True, mesh=mesh,
+                             cluster=ClusterSpec(k=k),
+                             cluster_assignment=cluster_host[:n_clients])
+        engine.data, engine.states = shard_federation(full, engine.states,
+                                                      mesh)
+        engine._ver_x, engine._ver_m = engine._verification_tensors()
+        t0 = time.time()
+        res = engine.run_round(0)  # cold: includes the 10k-program compile
+        compile_sec = time.time() - t0
+        engine.reset_federation()
+        t0 = time.time()
+        res = engine.run_round(0)
+        sec = time.time() - t0
+        effective = res.backend
+        assert effective == backend, (
+            f"silent backend fallback: asked {backend!r}, "
+            f"round ran {effective!r}")
+        if backend == "shard_map":
+            # ZeRO residency: client states born sharded — device 0 holds
+            # 1/D of the fleet's params + Adam moments, never the total
+            st = [l for l in jax.tree.leaves(engine.states)
+                  if hasattr(l, "addressable_shards")]
+            total = sum(int(l.nbytes) for l in st)
+            dev0 = mesh.devices.ravel()[0]
+            local = sum(int(s.data.nbytes) for l in st
+                        for s in l.addressable_shards if s.device == dev0)
+            out["sharded_client_state"] = {
+                "fleet_bytes": total, "device0_bytes": local,
+                "fleet_over_device0": round(total / max(local, 1), 2)}
+        round_rows[backend] = {
+            "sec_per_round_warm": round(sec, 3),
+            "first_round_incl_compile_sec": round(compile_sec, 2),
+            "effective_backend": effective,
+            "mean_metric": round(float(np.nanmean(res.client_metrics)), 5),
+            "finite_metrics": bool(np.all(np.isfinite(res.client_metrics))),
+            "aggregator": res.aggregator,
+        }
+        del engine
+    out["round_10k"] = round_rows
+    del full, params
+
+    # --- quantized K-merge quality pin at the quick-run scale ---
+    small_clients = synthetic_clients(n_clients=10, dim=dim, n_normal=240,
+                                      n_abnormal=120)
+    small = stack_clients(small_clients, dev_x[:64], cfg.batch_size,
+                          pad_clients_to=pad_to_multiple(
+                              10, mesh.devices.size))
+    aucs = {}
+    for backend in ("einsum", "quantized"):
+        bcfg = cfg.replace(network_size=10, num_rounds=3,
+                           aggregation_backend=backend, quant_hosts=4)
+        engine = RoundEngine(make_model("hybrid", dim,
+                                        shrink_lambda=cfg.shrink_lambda),
+                             bcfg, small, n_real=10,
+                             rngs=ExperimentRngs(run=0), model_type="hybrid",
+                             update_type="mse_avg", fused=True, mesh=mesh,
+                             cluster=ClusterSpec(k=2),
+                             cluster_assignment=np.arange(10) % 2)
+        engine.data, engine.states = shard_federation(small, engine.states,
+                                                      mesh)
+        engine._ver_x, engine._ver_m = engine._verification_tensors()
+        results = [engine.run_round(r) for r in range(3)]
+        aucs[backend] = float(np.nanmean(results[-1].client_metrics))
+    delta = abs(aucs["einsum"] - aucs["quantized"])
+    out["quality_pin"] = {
+        "final_auc_einsum": round(aucs["einsum"], 5),
+        "final_auc_quantized": round(aucs["quantized"], 5),
+        "auc_delta": round(delta, 5),
+        "bar": 2e-3, "met": bool(delta <= 2e-3),
+        "protocol": "10-client quick run, 3 rounds, hybrid + mse_avg, "
+                    "K=2 pinned clusters, sharded over the same mesh",
+    }
+    out["acceptance"] = {
+        "shard_map_bitwise_einsum": bool(bitwise),
+        "int8_dcn_reduction_at_2_groups":
+            merge_rows["quantized_g2"]["dcn_reduction_vs_f32"],
+        "int8_dcn_reduction_ge_4x": bool(
+            merge_rows["quantized_g2"]["dcn_reduction_vs_f32"] >= 4.0),
+        "clustered_bound_held": all(
+            merge_rows[name]["within_bound"]
+            for name, _, _ in quant_variants),
+        "no_silent_einsum_fallback": all(
+            r["effective_backend"] != "einsum"
+            for r in round_rows.values()),
+        "quality_pin_met": out["quality_pin"]["met"],
+    }
+    return out
+
+
 def measure_knn(cfg, quality_clients: int = 500,
                 bank_sizes=(128, 256, 512, 1024, 2048, 4096),
                 serve_bucket: int = 1024, quality_rounds: int = 2,
@@ -1107,8 +1367,14 @@ def _podscale_worker() -> None:
     init_sec = time.time() - t0
     assert eng.sharded and not eng._fleet_local, "cell must span hosts"
     assert eng.cohort == c, (eng.cohort, c)
+    # measured per-round collective bytes: reset the seam AFTER tier init
+    # so the snapshot below covers exactly `rounds` rounds of the lane-plan
+    # allgathers (parallel/multihost.py counts payload + wire per call)
+    from fedmse_tpu.parallel.costmodel import seam
+    seam.reset()
     secs = []
     eng.run_rounds(0, rounds, lambda r, s: secs.append(s) and False)
+    collectives = seam.snapshot()["host_collectives"]
     row = {
         "pid": pid, "nprocs": nprocs, "shard_rows": hi - lo,
         "tier_init_sec": round(init_sec, 2),
@@ -1118,6 +1384,10 @@ def _podscale_worker() -> None:
         "prefetch": eng.stats.summary(),
         "ru_maxrss_mb": round(
             resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
+        "collective_bytes": collectives,
+        "collective_wire_bytes_per_round": round(
+            sum(c["wire_bytes"] for c in collectives.values())
+            / max(rounds, 1)),
         **eng.cohort_bytes(),
     }
     with open(os.path.join(cell["outdir"],
@@ -1276,7 +1546,8 @@ def main():
     shard_bench = "--shard-bench" in sys.argv
     cohort_bench = "--cohort-bench" in sys.argv
     podscale_bench = "--podscale-bench" in sys.argv
-    if shard_bench or cohort_bench or podscale_bench:
+    clustermerge_bench = "--clustermerge-bench" in sys.argv
+    if shard_bench or cohort_bench or podscale_bench or clustermerge_bench:
         # hermetic CPU + 8 virtual devices, pinned BEFORE any jax import
         # (like the tests and serve-bench): the shard and cohort benches
         # are memory-layout/scale measurements, never TPU-tunnel ones
@@ -1394,6 +1665,39 @@ def main():
         line = json.dumps(out)
         print(line)
         dest = _flag("--out", f"BENCH_SHARD_r08_{device.platform}.json")
+        with open(dest, "w") as f:
+            f.write(line + "\n")
+        return
+
+    if clustermerge_bench:
+        # clustered quantized collectives (ISSUE 19): the K=8 cluster merge
+        # at 10k on the virtual 8-device mesh — measured inter-host bytes
+        # f32 vs lane-sliced int8, the plan_merge candidate table, fused
+        # clustered rounds with the effective backend recorded, the ZeRO
+        # client-state residency, and the K=2 quality pin. One JSON line,
+        # written to BENCH_CLUSTERMERGE_r19_<platform>.json (or --out).
+        n_cm = _int_flag("--clustermerge-clients", 10000)
+        k_cm = _int_flag("--cluster-k", 8)
+        device = jax.devices()[0]
+        out = {
+            "metric": f"{k_cm}-cluster quantized merge at {n_cm} clients "
+                      f"(virtual 8-device mesh, lane-sliced int8 cluster "
+                      f"rows, measured merge plan)",
+            "value": None,  # filled from the 2-group DCN reduction below
+            "unit": "x (inter-host merge bytes, f32 flat psum / "
+                    "lane-sliced int8, 2 host groups)",
+            "device": str(device),
+            "platform": device.platform,
+            "mode": "clustered quantized collectives (DESIGN.md §23)",
+        }
+        out.update(measure_clustermerge(cfg, n_clients=n_cm, k=k_cm))
+        out["value"] = out["merge_10k"]["quantized_g2"][
+            "dcn_reduction_vs_f32"]
+        out.update(capture_provenance())
+        line = json.dumps(out)
+        print(line)
+        dest = _flag("--out",
+                     f"BENCH_CLUSTERMERGE_r19_{device.platform}.json")
         with open(dest, "w") as f:
             f.write(line + "\n")
         return

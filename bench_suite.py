@@ -69,6 +69,13 @@ repo-grown axes):
      with the row-parse counter still 0, one scored burst through the
      frontend stripe, and the plan_split 1M-idle-fleet sizing pin
      (full protocol: make gateway-bench -> BENCH_GATEWAY_r18_cpu.json)
+ 21. clustered quantized collectives (parallel/collectives.py, DESIGN.md
+     §23): the reduced K=8 cluster-merge cell on the virtual 8-device
+     mesh — clustered shard_map bitwise vs einsum, lane-sliced int8
+     DCN bytes vs the f32 flat psum, the clustered bound from actual
+     host partials and the effective-backend fallback guard (full
+     protocol: make clustermerge-bench ->
+     BENCH_CLUSTERMERGE_r19_cpu.json)
 
 Each scenario prints one JSON line (sec/round or sec/epoch + AUC); the
 collected artifact is committed as BENCH_SUITE_r{N}.json.
@@ -533,6 +540,43 @@ def scen_gateway():
                         "plan_split sizing", **row}
 
 
+def scen_clustermerge():
+    """Scenario 21: clustered quantized collectives (ISSUE 19,
+    parallel/collectives.py, DESIGN.md §23). Shelled out to `bench.py
+    --clustermerge-bench` for the same reason as scen_shard: the
+    8-virtual-device CPU platform must be pinned before jax initializes.
+    A reduced 2k-client cell keeps the suite's cost bounded; the
+    committed standalone artifact (make clustermerge-bench ->
+    BENCH_CLUSTERMERGE_r19_cpu.json) carries the full 10k protocol."""
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        tmp = f.name
+    try:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+                 "--clustermerge-bench", "--clustermerge-clients", "2000",
+                 "--out", tmp],
+                capture_output=True, text=True, timeout=1800)
+        except subprocess.TimeoutExpired:
+            return {"scenario": "clustered quantized collectives",
+                    "error": "bench.py --clustermerge-bench exceeded "
+                             "1800 s"}
+        if proc.returncode != 0:
+            return {"scenario": "clustered quantized collectives",
+                    "error": proc.stdout[-500:] + proc.stderr[-500:]}
+        with open(tmp) as f:
+            row = json.load(f)
+    finally:
+        os.unlink(tmp)
+    row.pop("metric", None)
+    return {"scenario": "clustered quantized collectives: K=8 merge on "
+                        "the virtual 8-device mesh, lane-sliced int8 "
+                        "cluster rows, measured merge plan", **row}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -555,9 +599,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-20")
-        if not 1 <= only <= 19:
-            sys.exit(f"--only expects a scenario number 1-19, got {only}")
+            sys.exit("--only expects a scenario number 1-21")
+        if not 1 <= only <= 21:
+            sys.exit(f"--only expects a scenario number 1-21, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -666,6 +710,9 @@ def main():
 
     if only in (None, 20):
         emit(scen_gateway())
+
+    if only in (None, 21):
+        emit(scen_clustermerge())
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

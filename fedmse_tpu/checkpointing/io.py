@@ -100,17 +100,24 @@ class ResultsWriter:
             f.write("\n")
         return path
 
-    def write_summary(self, best_metrics: Dict, num_runs: int) -> str:
+    def write_summary(self, best_metrics: Dict, num_runs: int,
+                      results: Optional[Dict] = None) -> str:
         os.makedirs(self.results_dir, exist_ok=True)
         path = os.path.join(self.results_dir, "training_summary.json")
+        doc = {
+            "best_metrics": best_metrics,
+            "metric_type": self.metric,
+            "num_runs": num_runs,
+            "network_size": self.network_size,
+            "experiment_name": self.exp,
+        }
+        if results is not None:
+            # Per-run rows (incl. aggregation_backend_effective) — an
+            # artifact claiming a quantized capture must prove the backend
+            # that actually ran, not just the one that was requested.
+            doc["results"] = results
         with open(path, "w") as f:
-            json.dump({
-                "best_metrics": best_metrics,
-                "metric_type": self.metric,
-                "num_runs": num_runs,
-                "network_size": self.network_size,
-                "experiment_name": self.exp,
-            }, f, indent=4)
+            json.dump(doc, f, indent=4)
         return path
 
     def client_model_dir(self, run: int, model_type: str, update_type: str,

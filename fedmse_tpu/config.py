@@ -241,9 +241,25 @@ class ExperimentConfig:
     #                 with per-block f32 scales, dequantize-then-accumulate
     #                 in f32 (EQuARX-style; quality pin: quick-run AUC
     #                 delta <= 2e-3, same bar as the bf16 policy).
+    #   'auto'      — measured cost model (parallel/costmodel.plan_merge):
+    #                 time the candidate collectives on the engine's actual
+    #                 leaf shapes once, score wall + modeled DCN bytes at
+    #                 merge_dcn_gbps, adopt the winner's backend + block
+    #                 size + group topology (replaces the pow2 defaults).
+    # All backends are K-cluster-aware (DESIGN.md §23): under a ClusterSpec
+    # the explicit collectives fold the [K, N] one-hot sheet into the
+    # per-device partial einsum instead of degrading to the auto-partitioned
+    # einsum merge.
     # Off-mesh (client axis unsharded) every backend degenerates to
-    # 'einsum' — the explicit collectives need a mesh to be written against.
+    # 'einsum' — the explicit collectives need a mesh to be written
+    # against; the degradation logs at WARNING and the effective backend
+    # is recorded in round artifacts (RoundResult.backend).
     aggregation_backend: str = "einsum"
+    # assumed cross-host (DCN) bandwidth for the 'auto' cost model's wire
+    # term, GB/s per direction — only the SCORE uses it (measured wall +
+    # dcn_bytes / merge_dcn_gbps); byte counts themselves come from actual
+    # leaf shapes on the collective seam (parallel/costmodel.py)
+    merge_dcn_gbps: float = 25.0
     # blockwise int8 granularity of the 'quantized' backend: elements per
     # f32 scale on the flattened leaf (error/element <= blockmax/254 per
     # quantized hop — parallel/quantize.py)

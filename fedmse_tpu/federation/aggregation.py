@@ -97,12 +97,39 @@ def make_aggregate_fn(model, update_type: str) -> Callable:
 
 def make_aggregate_for(model, update_type: str, backend: str, mesh=None,
                        axis_name: str = "clients", quant_hosts: int = 0,
-                       quant_block_size: int = 256) -> Callable:
+                       quant_block_size: int = 256,
+                       cluster_k: int = 0) -> Callable:
     """Config-selected aggregation backend (cfg.aggregation_backend;
-    DESIGN.md §12). `backend` must already be EFFECTIVE — the engine
-    degrades explicit backends to 'einsum' off-mesh
-    (RoundEngine.agg_backend) before calling here, so a mesh is required
-    for the explicit collectives."""
+    DESIGN.md §12, §23). `backend` must already be EFFECTIVE — the engine
+    degrades explicit backends to 'einsum' off-mesh and resolves 'auto'
+    via the measured cost model (RoundEngine.agg_backend) before calling
+    here, so a mesh is required for the explicit collectives.
+
+    `cluster_k` > 1 selects the K-cluster merge — signature
+    fn(stacked_params, sel_mask, dev_x, cluster_in, sel_idx=None) ->
+    (cluster_params [K, ...], weights [N], has_update [K]) — served by the
+    clustered einsum (cluster/merge.py) or its explicit shard_map /
+    hierarchical-int8 twins (parallel/collectives.py), so clustered fleets
+    no longer degrade to full-f32 auto-partitioned merges."""
+    if cluster_k > 1:
+        if backend == "einsum":
+            from fedmse_tpu.cluster.merge import make_clustered_aggregate_fn
+            return make_clustered_aggregate_fn(model, update_type, cluster_k)
+        if mesh is None:
+            raise ValueError(f"aggregation_backend={backend!r} needs a mesh "
+                             "(the client axis must be sharded)")
+        from fedmse_tpu.parallel.collectives import (
+            make_clustered_hierarchical_aggregate,
+            make_clustered_shardmap_aggregate)
+        if backend == "shard_map":
+            return make_clustered_shardmap_aggregate(
+                model, update_type, mesh, cluster_k, axis_name)
+        if backend == "quantized":
+            return make_clustered_hierarchical_aggregate(
+                model, update_type, mesh, cluster_k, axis_name,
+                num_groups=quant_hosts, block_size=quant_block_size)
+        raise ValueError(f"unknown aggregation_backend {backend!r} "
+                         "(einsum | shard_map | quantized)")
     if backend == "einsum":
         return make_aggregate_fn(model, update_type)
     if mesh is None:

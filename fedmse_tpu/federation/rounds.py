@@ -82,6 +82,10 @@ class RoundResult:
     # increments — the slot-pool roster the serving front mirrors)
     members: Optional[List[int]] = None
     generations: Optional[np.ndarray] = None
+    # the EFFECTIVE aggregation backend that merged this round ('einsum' |
+    # 'shard_map' | 'quantized') — recorded so a silent f32 fallback can
+    # never masquerade as a quantized capture (DESIGN.md §23)
+    backend: Optional[str] = None
 
 
 def split_metric_columns(metrics: np.ndarray):
@@ -196,7 +200,8 @@ def verification_tensors(cfg: ExperimentConfig, data: FederatedData,
 def absorb_fused_out(out, round_index: int, selected: List[int], n_real: int,
                      host: HostState, max_rejected_updates: int,
                      chaos: bool = False, elastic: bool = False,
-                     row_ids: Optional[Sequence[int]] = None) -> RoundResult:
+                     row_ids: Optional[Sequence[int]] = None,
+                     backend: Optional[str] = None) -> RoundResult:
     """Host bookkeeping + RoundResult from ONE host-fetched FusedRoundOut
     bundle: quota/vote counters, reference verification rows, attack
     flagging. Shared by the per-run fused path (RoundEngine._fused_result)
@@ -266,6 +271,7 @@ def absorb_fused_out(out, round_index: int, selected: List[int], n_real: int,
             if elastic else None),
         generations=(np.asarray(out.generation)[:n_real].astype(np.int64)
                      if elastic else None),
+        backend=backend,
     )
 
 
@@ -414,7 +420,7 @@ class RoundEngine:
                                   else np.asarray(cluster_assignment,
                                                   np.int32))
         self._cluster_stats_fn = None     # shared compiled stats program
-        self._warned_cluster_backend = False
+        self._merge_plan = None           # measured plan (backend='auto')
         # red-team adversaries (fedmse_tpu/redteam/, DESIGN.md §21): a
         # RedteamSpec compiled into the fused program as per-round [T, N]
         # adversary / vote-eligibility tensors plus static poison hooks —
@@ -469,23 +475,19 @@ class RoundEngine:
         cluster_on = spec is not None and not spec.is_null
         cluster_kw = {}
         if cluster_on:
-            # the clustered merge is the [K, N]-sheet einsum (cluster/
-            # merge.py); on a sharded mesh jit auto-partitions it to
-            # partial sums + all-reduce — the same lowering story as the
-            # default backend. The EXPLICIT shard_map/int8 collectives
-            # are single-model programs, so they degrade here (their
-            # per-hop error bounds transfer per-cluster unchanged — the
-            # merge is K independent weighted reductions; DESIGN §19).
-            if self._fused_backend != "einsum" \
-                    and not self._warned_cluster_backend:
-                self._warned_cluster_backend = True
-                logger.debug(
-                    "aggregation_backend=%s degrades to the clustered "
-                    "einsum merge under cluster_k=%d (jit auto-partitions "
-                    "the [K, N] sheet on the mesh)", self._fused_backend,
-                    spec.k)
-            aggregate = clustered_aggregate_for(self.model,
-                                                self.update_type, spec)
+            # K-cluster merge: every backend is K-aware (DESIGN.md §23) —
+            # the [K, N]-sheet einsum (cluster/merge.py, jit
+            # auto-partitioned on a mesh), or its explicit shard_map /
+            # hierarchical-int8 twins (parallel/collectives.py) with the
+            # one-hot sheet folded into the per-device partial einsum. The
+            # only degradation left is the off-mesh one `agg_backend`
+            # already WARNs about.
+            if self._fused_backend == "einsum":
+                aggregate = clustered_aggregate_for(self.model,
+                                                    self.update_type, spec)
+            else:
+                aggregate = self._aggregate_for(self._fused_backend,
+                                                cluster_k=spec.k)
             cluster_kw = {"cluster_k": spec.k,
                           "personalize": spec.personalize,
                           "shared_modules": spec.shared_modules}
@@ -535,39 +537,84 @@ class RoundEngine:
         """Effective aggregation backend, evaluated at USE time (the same
         pattern — and for the same post-construction-resharding reason —
         as `compact` below): the explicit collectives are written against a
-        mesh, so off-mesh every backend degenerates to 'einsum'."""
+        mesh, so off-mesh every backend degenerates to 'einsum'. The
+        degradation logs at WARNING — a silent f32 fallback must never
+        masquerade as a quantized capture (the effective backend is also
+        recorded in every RoundResult). 'auto' resolves through the
+        measured cost model (parallel/costmodel.plan_merge) once per
+        engine; the plan's block size / group topology then override the
+        pow2 config defaults in `_aggregate_for`."""
         backend = self.cfg.aggregation_backend
         if backend == "einsum":
             return "einsum"
-        if backend not in ("shard_map", "quantized"):
+        if backend not in ("auto", "shard_map", "quantized"):
             raise ValueError(f"unknown aggregation_backend {backend!r} "
-                             "(einsum | shard_map | quantized)")
+                             "(auto | einsum | shard_map | quantized)")
         if not _client_axis_is_sharded(self.data.train_xb):
             if not self._warned_backend_off:
                 self._warned_backend_off = True
-                logger.debug("aggregation_backend=%s inert: client axis is "
-                             "not sharded across devices; using the dense "
-                             "einsum reduction", backend)
+                logger.warning(
+                    "aggregation_backend=%s inert: client axis is not "
+                    "sharded across devices; using the dense einsum "
+                    "reduction", backend)
             return "einsum"
+        if backend == "auto":
+            return self._plan_backend()
         return backend
 
-    def _aggregate_for(self, backend: str):
+    def _plan_backend(self) -> str:
+        """Resolve aggregation_backend='auto' via the measured cost model:
+        time the candidate collectives on this engine's actual leaf shapes
+        (once; the plan is cached on the engine) and adopt the winner's
+        backend/block/topology."""
+        if self._merge_plan is None:
+            from fedmse_tpu.parallel.costmodel import plan_merge
+            spec = self.cluster
+            k = (spec.k if spec is not None
+                 and not getattr(spec, "is_null", False) else 1)
+            elems = [int(np.prod(l.shape[1:]))
+                     for l in jax.tree.leaves(self.states.params)]
+            groups = ((self.cfg.quant_hosts,)
+                      if self.cfg.quant_hosts > 0 else None)
+            self._merge_plan = plan_merge(
+                self._data_mesh(), elems, k=k,
+                axis_name=self.cfg.client_axis_name,
+                n_hosts=(self.cfg.quant_hosts or None),
+                group_counts=groups,
+                dcn_gbps=self.cfg.merge_dcn_gbps)
+            logger.info("merge plan (auto): %s", self._merge_plan["chosen"])
+        return self._merge_plan["chosen"]["backend"]
+
+    def _quant_knobs(self, backend: str):
+        """(num_groups, block_size) for the quantized backend: the measured
+        plan's choice when 'auto' picked it, else the config knobs."""
+        plan = self._merge_plan
+        if plan is not None and plan["chosen"]["backend"] == backend:
+            return (plan["chosen"]["num_groups"],
+                    plan["chosen"]["block_size"]
+                    or self.cfg.quant_block_size)
+        return self.cfg.quant_hosts, self.cfg.quant_block_size
+
+    def _aggregate_for(self, backend: str, cluster_k: int = 0):
         """The aggregation callable for an effective backend (explicit
         collectives built lazily per mesh and cached — the mesh can only
-        appear after a post-construction data swap)."""
-        if backend == "einsum":
+        appear after a post-construction data swap). `cluster_k` > 1
+        builds the K-cluster-aware variant (DESIGN.md §23)."""
+        if backend == "einsum" and cluster_k <= 1:
             return self.aggregate
         from fedmse_tpu.federation.aggregation import make_aggregate_for
         mesh = self._data_mesh()
         axis = self.cfg.client_axis_name
+        quant_hosts, quant_block = self._quant_knobs(backend)
         key = (backend, self.model, self.update_type, mesh, axis,
-               self.cfg.quant_hosts, self.cfg.quant_block_size)
+               quant_hosts, quant_block, cluster_k)
         fn = _PROGRAM_CACHE.get(key)
         if fn is None:
             fn = make_aggregate_for(
                 self.model, self.update_type, backend, mesh, axis,
-                quant_hosts=self.cfg.quant_hosts,
-                quant_block_size=self.cfg.quant_block_size)
+                quant_hosts=quant_hosts,
+                quant_block_size=quant_block,
+                cluster_k=cluster_k)
             _cache_put(key, fn)
         return fn
 
@@ -635,7 +682,8 @@ class RoundEngine:
         return absorb_fused_out(out, round_index, selected, self.n_real,
                                 self.host, self.cfg.max_rejected_updates,
                                 chaos=self.chaos is not None,
-                                elastic=self.elastic is not None)
+                                elastic=self.elastic is not None,
+                                backend=self._fused_backend)
 
     def _selection_arrays(self, selected: List[int]):
         sel_mask = np.zeros(self.n_pad, dtype=np.float32)
@@ -1080,4 +1128,5 @@ class RoundEngine:
             agg_weights=agg_weights,
             tracking=np.asarray(host_fetch(tracking))[: self.n_real],
             min_valid=np.asarray(host_fetch(min_valid))[: self.n_real],
+            backend=self.agg_backend,
         )

@@ -151,6 +151,39 @@ def init_client_states(model, tx: optax.GradientTransformation,
     return jax.jit(build, out_shardings=shardings)()
 
 
+def make_sharded_client_update(tx: optax.GradientTransformation, mesh=None,
+                               axis_name: str = "clients"):
+    """ZeRO-style sharded application of one optimizer step across the
+    client axis (DESIGN.md §23): build
+    `fn(grads, opt_state, params) -> (new_params, new_opt_state)` where
+    every leaf is `[N, ...]` and — when a mesh is given — jit is PINNED to
+    the canonical `P('clients')` layout on inputs AND outputs. Each
+    replica then materializes only its own partition of the per-client
+    Adam moments while applying the step: the moments never exist
+    replicated (they are the memory wall at 10k+ clients, ROADMAP item 2),
+    and the only fleet-replicated tensors on the merge path stay the
+    [K, ...] merged models the collectives all-gather (bytes ∝ K · model,
+    never ∝ N · model).
+
+    Adam is elementwise over the stacked axis, so the sharded program is
+    bitwise the replicated one per client row (pinned by
+    tests/test_clustermerge.py) — this seam only fixes WHERE the moments
+    live, not what they compute."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def step(grads, opt_state, params):
+        updates, new_opt = jax.vmap(tx.update)(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt
+
+    if mesh is None:
+        return jax.jit(step)
+    # one prefix sharding broadcasts to every leaf of every argument and
+    # output — the same no-trailing-None canonical spec as
+    # client_states_sharding, stated once so the jit is built once
+    sh = NamedSharding(mesh, P(axis_name))
+    return jax.jit(step, in_shardings=sh, out_shardings=sh)
+
+
 def init_batched_client_states(model, tx: optax.GradientTransformation,
                                run_keys: jax.Array,
                                n_clients: int) -> ClientStates:

@@ -166,13 +166,8 @@ class TieredRoundEngine:
         self.chaos = chaos
         self.elastic = elastic
         self.mesh = mesh
-        if cfg.aggregation_backend != "einsum":
-            # the explicit collectives are written against the full dense
-            # client axis; the cohort merge is a [C]-wide einsum that jit
-            # auto-partitions over the slab sharding when a mesh is set
-            logger.debug("state_layout=tiered uses the einsum merge; "
-                         "aggregation_backend=%s is inert here",
-                         cfg.aggregation_backend)
+        self._warned_backend_off = False  # log the einsum fallback once
+        self._merge_plan = None           # measured plan (backend='auto')
 
         programs = _engine_programs(model, cfg, model_type, update_type)
         self.tx = programs["tx"]
@@ -385,6 +380,87 @@ class TieredRoundEngine:
     def cluster_assignment(self):
         return self._cluster_vec
 
+    @property
+    def agg_backend(self) -> str:
+        """Effective merge backend of the cohort program (DESIGN.md §23).
+        The explicit collectives operate on the [C]-wide cohort slab — its
+        client axis is sharded over the mesh by `place_cohort` with the
+        same canonical P('clients') spec as the dense layout, so shard_map
+        and the hierarchical int8 merge compose unchanged at cohort width.
+        Off-mesh every backend degrades to the dense einsum, at WARNING:
+        a silent f32 fallback must never masquerade as a quantized capture
+        (the effective backend is recorded in every RoundResult and in the
+        run artifact's aggregation_backend_effective)."""
+        backend = self.cfg.aggregation_backend
+        if backend == "einsum":
+            return "einsum"
+        if backend not in ("auto", "shard_map", "quantized"):
+            raise ValueError(f"unknown aggregation_backend {backend!r} "
+                             "(auto | einsum | shard_map | quantized)")
+        if self.mesh is None:
+            if not self._warned_backend_off:
+                self._warned_backend_off = True
+                logger.warning(
+                    "aggregation_backend=%s inert: client axis is not "
+                    "sharded across devices; using the dense einsum "
+                    "reduction", backend)
+            return "einsum"
+        if backend == "auto":
+            return self._plan_backend()
+        return backend
+
+    def _plan_backend(self) -> str:
+        """Resolve aggregation_backend='auto' for the cohort merge via the
+        measured cost model — same search as RoundEngine._plan_backend, on
+        this engine's per-client leaf shapes (width-invariant: the plan
+        sizes blocks/topology per model element count, not per cohort)."""
+        if self._merge_plan is None:
+            from fedmse_tpu.parallel.costmodel import plan_merge
+            spec = self.cluster
+            k = (spec.k if spec is not None
+                 and not getattr(spec, "is_null", False) else 1)
+            elems = [int(np.prod(l.shape[1:]))
+                     for l in jax.tree.leaves(self.store.host.params)]
+            groups = ((self.cfg.quant_hosts,)
+                      if self.cfg.quant_hosts > 0 else None)
+            self._merge_plan = plan_merge(
+                self.mesh, elems, k=k,
+                axis_name=self.cfg.client_axis_name,
+                n_hosts=(self.cfg.quant_hosts or None),
+                group_counts=groups,
+                dcn_gbps=self.cfg.merge_dcn_gbps)
+            logger.info("merge plan (auto, tiered): %s",
+                        self._merge_plan["chosen"])
+        return self._merge_plan["chosen"]["backend"]
+
+    def _quant_knobs(self, backend: str):
+        plan = self._merge_plan
+        if plan is not None and plan["chosen"]["backend"] == backend:
+            return (plan["chosen"]["num_groups"],
+                    plan["chosen"]["block_size"]
+                    or self.cfg.quant_block_size)
+        return self.cfg.quant_hosts, self.cfg.quant_block_size
+
+    def _aggregate_for(self, backend: str, cluster_k: int = 0):
+        """Explicit-backend aggregation at cohort width (cached in the
+        shared program cache — the builders are keyed by mesh/knobs, so
+        engines on the same mesh share executables)."""
+        if backend == "einsum" and cluster_k <= 1:
+            return self._programs["aggregate"]
+        from fedmse_tpu.federation.aggregation import make_aggregate_for
+        axis = self.cfg.client_axis_name
+        quant_hosts, quant_block = self._quant_knobs(backend)
+        key = (backend, self.model, self.update_type, self.mesh, axis,
+               quant_hosts, quant_block, cluster_k)
+        fn = _PROGRAM_CACHE.get(key)
+        if fn is None:
+            fn = make_aggregate_for(
+                self.model, self.update_type, backend, self.mesh, axis,
+                quant_hosts=quant_hosts, quant_block_size=quant_block,
+                cluster_k=cluster_k)
+            _cache_put(key, fn)
+        return fn
+
     def _build_fused(self):
         """The cohort round program — the SAME `make_round_body` the dense
         engine scans, jitted WITHOUT buffer donation.
@@ -405,20 +481,28 @@ class TieredRoundEngine:
         spec = self.cluster
         cluster_on = spec is not None and not spec.is_null
         cluster_kw = {}
-        aggregate = self._programs["aggregate"]
+        backend = self.agg_backend
         if cluster_on:
-            aggregate = clustered_aggregate_for(self.model,
-                                                self.update_type, spec)
+            if backend == "einsum":
+                aggregate = clustered_aggregate_for(self.model,
+                                                    self.update_type, spec)
+            else:
+                # the K-cluster-aware explicit collective (DESIGN.md §23):
+                # per-device [K, ...] partial sheets, one psum (or the
+                # hierarchical int8 exchange) over the stacked cluster rows
+                aggregate = self._aggregate_for(backend, cluster_k=spec.k)
             cluster_kw = {"cluster_k": spec.k,
                           "personalize": spec.personalize,
                           "shared_modules": spec.shared_modules}
+        else:
+            aggregate = self._aggregate_for(backend)
         args = (self._programs["train_all"], self._programs["scores_fn"],
                 aggregate, self._programs["verify"],
                 self._programs["evaluate_all"],
                 self.cfg.max_aggregation_threshold, False, self.poison_fn)
         with_chaos = self.chaos is not None
         with_elastic = self.elastic is not None
-        key = ("tiered_fused",) + args[:-1] + (
+        key = ("tiered_fused", backend) + args[:-1] + (
             with_chaos, with_elastic, tuple(sorted(cluster_kw.items())))
         if self.poison_fn is None and key in _PROGRAM_CACHE:
             self._fused_round = _PROGRAM_CACHE[key]
@@ -662,7 +746,7 @@ class TieredRoundEngine:
                                 self.host, self.cfg.max_rejected_updates,
                                 chaos=self.chaos is not None,
                                 elastic=self.elastic is not None,
-                                row_ids=rows)
+                                row_ids=rows, backend=self.agg_backend)
 
     def _dispatch(self, pf: PrefetchedCohort, slab: ClientStates):
         plan = pf.plan
@@ -1165,6 +1249,13 @@ def run_tiered_combination(cfg: ExperimentConfig, data, n_real: int,
                 _save_hybrid_latents_streamed(cfg, model, engine, run,
                                               update_type)
 
+    tiered_stats = stats.summary()
+    # measured collective bytes (parallel/costmodel.seam): the host-side
+    # allgather seams report true per-call payload/wire bytes and the
+    # device merge reports its traced wire profile — the podscale bench
+    # persists these instead of modeled estimates
+    from fedmse_tpu.parallel.costmodel import seam
+    tiered_stats["collective_bytes"] = seam.snapshot()
     out = {
         "final_metrics": final_metrics,
         "best_final": float(np.nanmax(final_metrics)),
@@ -1172,7 +1263,10 @@ def run_tiered_combination(cfg: ExperimentConfig, data, n_real: int,
         "rounds_run": len(round_times),
         "aggregation_count": engine.host.aggregation_count.tolist(),
         "votes_received": engine.host.votes_received.tolist(),
-        "tiered_stats": stats.summary(),
+        # effective merge backend (post off-mesh degrade / 'auto' plan) —
+        # a silent einsum fallback can't masquerade as a quantized run
+        "aggregation_backend_effective": engine.agg_backend,
+        "tiered_stats": tiered_stats,
     }
     if final_metrics_full is not None:
         out["final_metrics_full"] = final_metrics_full

@@ -386,6 +386,11 @@ def run_combination(cfg: ExperimentConfig, data, n_real: int,
         "rounds_run": len(round_times),
         "aggregation_count": engine.host.aggregation_count.tolist(),
         "votes_received": engine.host.votes_received.tolist(),
+        # effective merge backend (post off-mesh degrade / 'auto' planning),
+        # so a silent einsum fallback can't masquerade as a quantized run
+        "aggregation_backend_effective": (
+            last_result.backend if last_result is not None
+            and last_result.backend is not None else engine.agg_backend),
     }
     if final_metrics_full is not None:
         out["final_metrics_full"] = final_metrics_full
@@ -547,6 +552,8 @@ def run_batched_combination(cfg: ExperimentConfig, data, n_real: int,
             "rounds_run": len(round_times[r]),
             "aggregation_count": engine.host[r].aggregation_count.tolist(),
             "votes_received": engine.host[r].votes_received.tolist(),
+            # the batched scan body only supports the dense einsum merge
+            "aggregation_backend_effective": "einsum",
         }
         if final_metrics_full is not None:
             out["final_metrics_full"] = final_metrics_full
@@ -662,6 +669,8 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                     all_results[f"{model_type}/{update_type}/run{run}"] = {
                         "final_metrics": out["final_metrics"].tolist(),
                         "round_times": out["round_times"],
+                        "aggregation_backend_effective":
+                            out["aggregation_backend_effective"],
                     }
                 continue
             for run in range(cfg.num_runs):
@@ -678,9 +687,12 @@ def run_experiment(cfg: ExperimentConfig, dataset: DatasetConfig,
                 all_results[f"{model_type}/{update_type}/run{run}"] = {
                     "final_metrics": out["final_metrics"].tolist(),
                     "round_times": out["round_times"],
+                    "aggregation_backend_effective":
+                        out["aggregation_backend_effective"],
                 }
 
-    summary_path = writer.write_summary(best_metrics, cfg.num_runs)
+    summary_path = writer.write_summary(best_metrics, cfg.num_runs,
+                                        results=all_results)
     logger.info("Saved training summary to %s", summary_path)
     out = {"best_metrics": best_metrics, "results": all_results,
            "summary_path": summary_path}

@@ -15,10 +15,13 @@ from fedmse_tpu.parallel.mesh import (
 )
 from fedmse_tpu.parallel.collectives import (
     host_groups,
+    make_clustered_hierarchical_aggregate,
+    make_clustered_shardmap_aggregate,
     make_hierarchical_aggregate,
     make_shardmap_aggregate,
     make_shardmap_divergence,
 )
+from fedmse_tpu.parallel.costmodel import merge_profile, plan_merge, seam
 from fedmse_tpu.parallel.multihost import (allgather_blocks,
                                             allgather_tree_sum)
 from fedmse_tpu.parallel.multihost import initialize as initialize_multihost
@@ -33,9 +36,14 @@ __all__ = [
     "host_groups",
     "initialize_multihost",
     "uniform_decision",
+    "make_clustered_hierarchical_aggregate",
+    "make_clustered_shardmap_aggregate",
     "make_hierarchical_aggregate",
     "make_shardmap_aggregate",
     "make_shardmap_divergence",
+    "merge_profile",
+    "plan_merge",
+    "seam",
     "local_shard_rows",
     "mesh_process_indices",
     "my_tier_block",

@@ -17,19 +17,38 @@ code, in two flavors:
     quantized hierarchy below.
 
   * `make_hierarchical_aggregate` — the EQuARX-style two-level merge
-    (PAPERS.md, arxiv 2506.17615; DESIGN.md §12): the per-device partial
-    sums first all-reduce in exact f32 WITHIN each host group (the ICI
-    stage), then the per-host partials cross the host boundary (the DCN
-    stage) as blockwise-int8 payloads with per-block f32 scales
-    (parallel/quantize.py), dequantized and accumulated in f32 on every
-    device. Wire bytes of the cross-host stage drop ~4x; the error is
-    bounded by Σ_hosts max|partial|_block/254 per element and the intra-host
-    math is untouched. With one host group the DCN stage vanishes and the
-    function degenerates to `make_shardmap_aggregate` exactly.
+    (PAPERS.md, arxiv 2506.17615; DESIGN.md §12, §23): the per-device
+    partial sums first all-reduce in exact f32 WITHIN each host group (the
+    ICI stage), then the per-host partials cross the host boundary (the
+    DCN stage) as blockwise-int8 payloads with per-block f32 scales
+    (parallel/quantize.py), dequantized and accumulated in f32. The DCN
+    exchange is LANE-SLICED (DESIGN.md §23): each device of a host group
+    owns a disjoint block-aligned slice of the host partial, quantizes and
+    exchanges only its slice with its lane peers across groups, and the
+    reassembly all_gather stays intra-group (ICI) — so each cross-host
+    byte crosses ONCE, not once per local device. The error is bounded by
+    Σ_hosts max|partial|_block/254 per element and the intra-host math is
+    untouched. With one host group the DCN stage vanishes and the function
+    degenerates to `make_shardmap_aggregate` exactly.
+
+  * `make_clustered_shardmap_aggregate` / `make_clustered_hierarchical_-
+    aggregate` — the K-cluster twins (DESIGN.md §23): the one-hot [K, N]
+    sheet of cluster/merge.py is folded into the per-device partial
+    einsum, so each device contributes a [K, ...] sheet of partials and
+    ONE psum over the K-stacked tree replaces K separate merges. The
+    quantized variant ships per-cluster-row int8 payloads with a
+    [K, n_blocks] scale sheet (quantize_blockwise_k); cluster-row weights
+    (the [K] row sums) stay exact f32. Replicated output is just the
+    merged [K, ...] models — bytes ∝ K·model, never fleet.
 
 `make_shardmap_divergence` is the same treatment for the chaos axis's
 per-client divergence reduction (federation/state.py::tree_client_divergence)
 — the mean-model reduction runs as explicit partial sums + psum.
+
+Every builder reports its per-merge wire profile (payload + modeled DCN
+bytes from the actual leaf shapes) to `parallel.costmodel.seam`, so bench
+rows and round artifacts carry measured-shape byte accounting instead of
+hand-waved ratios.
 
 Useful both as documentation of the communication pattern and as a fallback
 when auto-partitioning chooses a worse layout.
@@ -46,30 +65,67 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 from fedmse_tpu.ops.losses import mse_loss
-from fedmse_tpu.parallel.quantize import dequantize_sum, quantize_blockwise
+from fedmse_tpu.parallel.quantize import (dequantize_sum_blocks,
+                                          quantize_blocks)
 
 
-def _raw_weights(model, update_type: str, axis_name: str):
-    """Per-device unnormalized weight computation shared by both explicit
-    backends (semantics of federation.aggregation.make_aggregate_fn:
-    fed_avg / fedprox = masked mean, fed_mse_avg = 1/MSE(dev) — reference
+def _raw_scores(model, update_type: str):
+    """Per-device UNNORMALIZED weight scores (semantics of
+    federation.aggregation.make_aggregate_fn: fed_avg / fedprox = the
+    selection mask, fed_mse_avg = sel/MSE(dev) — reference
     client_trainer.py:107-134). Each device scores its OWN client shard
-    (already embarrassingly parallel); the normalizer is one scalar psum."""
+    (already embarrassingly parallel); normalization is the caller's —
+    one scalar psum for the global merge, a [K] row-sum psum for the
+    clustered one."""
 
     def dev_mse(params, dev_x):
         _, recon = model.apply({"params": params}, dev_x)
         return mse_loss(dev_x, recon)
 
-    def weights(params_shard, sel_shard, dev_x):
+    def raw_fn(params_shard, sel_shard, dev_x):
         if update_type == "mse_avg":
             mses = jax.vmap(dev_mse, in_axes=(0, None))(params_shard, dev_x)
-            raw = sel_shard / mses
-        else:
-            raw = sel_shard
+            return sel_shard / mses
+        return sel_shard
+
+    return raw_fn
+
+
+def _raw_weights(model, update_type: str, axis_name: str):
+    """Normalized per-device weights: `_raw_scores` + one scalar psum."""
+    raw_fn = _raw_scores(model, update_type)
+
+    def weights(params_shard, sel_shard, dev_x):
+        raw = raw_fn(params_shard, sel_shard, dev_x)
         total = jax.lax.psum(jnp.sum(raw), axis_name)
         return raw / total
 
     return weights
+
+
+def _clustered_sheet(raw, cluster_shard, k: int, axis_name: str):
+    """Per-device slice of cluster/merge.normalize_sheet with the row-sum
+    reduction made explicit: local one-hot [K, n_local] sheet scaled by the
+    raw scores, row sums psum'd GLOBALLY (exact f32 — cluster weights are
+    never quantized), rows normalized to sum 1. Returns (sheet [K, n_local],
+    weights [n_local] = local column sums, has_update [K] replicated)."""
+    one_hot = (cluster_shard[None, :] == jnp.arange(k)[:, None]
+               ).astype(jnp.float32)
+    local_sheet = one_hot * raw[None, :]
+    row_sums = jax.lax.psum(jnp.sum(local_sheet, axis=1), axis_name)
+    has_update = row_sums > 0
+    sheet = local_sheet / jnp.maximum(row_sums, 1e-30)[:, None]
+    weights = jnp.sum(sheet, axis=0)
+    return sheet, weights, has_update
+
+
+def _clustered_partial(sheet, params_shard):
+    """f32 [K, ...] partial sheet of the local client shard — the clustered
+    twin of `_partial_merge` (same accumulation contract)."""
+    return jax.tree.map(
+        lambda t: jnp.einsum("kn,n...->k...", sheet, t,
+                             preferred_element_type=jnp.float32),
+        params_shard)
 
 
 def _partial_merge(params_shard, w):
@@ -80,6 +136,26 @@ def _partial_merge(params_shard, w):
         lambda t: jnp.einsum("n,n...->...", w, t,
                              preferred_element_type=jnp.float32),
         params_shard)
+
+
+def _note_merge(backend: str, params_tree, *, n_devices: int, k: int = 1,
+                n_groups: int = 0, per_group: int = 0,
+                block_size: int = 0) -> None:
+    """Report this merge's wire profile (from the ACTUAL leaf shapes seen
+    at trace time) to the collective seam counters. Runs in the traced
+    python wrapper, so tracers' static shapes are all it touches."""
+    from fedmse_tpu.parallel import costmodel
+    elems = [int(np_prod(l.shape[1:])) for l in jax.tree.leaves(params_tree)]
+    costmodel.seam.note_merge(backend, costmodel.merge_profile(
+        backend=backend, elem_counts=elems, k=k, n_devices=n_devices,
+        n_groups=n_groups, per_group=per_group, block_size=block_size))
+
+
+def np_prod(shape) -> int:
+    out = 1
+    for d in shape:
+        out *= int(d)
+    return out
 
 
 def make_shardmap_aggregate(model, update_type: str, mesh: Mesh,
@@ -114,6 +190,8 @@ def make_shardmap_aggregate(model, update_type: str, mesh: Mesh,
     def aggregate(stacked_params, sel_mask, dev_x,
                   sel_idx=None) -> Tuple[Any, jax.Array]:
         del sel_idx  # see docstring: per-shard scoring is already local
+        _note_merge("shard_map", stacked_params,
+                    n_devices=int(mesh.devices.size))
         fn = shard_map(
             per_device, mesh=mesh,
             in_specs=(in_specs_for(stacked_params), spec_clients, P()),
@@ -154,44 +232,95 @@ def host_groups(mesh: Mesh, num_groups: int = 0) -> List[List[int]]:
     return groups
 
 
+def _make_quantized_exchange(axis_name: str, intra: List[List[int]],
+                             block_size: int) -> Callable:
+    """Build the lane-sliced int8 DCN exchange (DESIGN.md §23).
+
+    Returns fn(rows [R, E] f32 host-group partial) -> [R, E] f32 global
+    sum (R = cluster rows; R=1 for the single-global merge, so both paths
+    run the SAME ops and K=1 bitwise-degenerates by construction). Steps,
+    per device:
+
+      1. pad each row to nb_pad = ceil(nb/per)·per blocks of `block_size`
+         (pad blocks are zero → quantize to q=0/scale=1: inert);
+      2. slice the m = nb_pad/per blocks this device's LANE owns (lane =
+         position within its host group; block-aligned, so per-block
+         scales equal whole-row quantization restricted to those blocks);
+      3. quantize the slice (per-row per-block scale sheet) and all_gather
+         q + scales over the lane's INTER-group ring — the only stage that
+         crosses hosts, and each host-partial byte crosses once, not once
+         per local device;
+      4. dequantize-then-accumulate the G gathered payloads in f32 (the
+         PR 5 contract);
+      5. all_gather the f32 slice sums INTRA-group (ICI) and reassemble.
+
+    Identical per-element math to quantizing each whole host partial and
+    summing (same addends, same group order) — only the placement of the
+    work and the wire bytes change."""
+    n_groups = len(intra)
+    per = len(intra[0])
+    inter = [[g[lane] for g in intra] for lane in range(per)]
+    # device index along the mesh axis -> its lane within its host group
+    lane_of = [0] * (n_groups * per)
+    for g in intra:
+        for j, d in enumerate(g):
+            lane_of[d] = j
+    lane_table = jnp.asarray(lane_of, dtype=jnp.int32)
+
+    def exchange(rows: jax.Array) -> jax.Array:
+        k, e = rows.shape
+        rows = rows.astype(jnp.float32)
+        nb = -(-e // block_size)
+        m = -(-nb // per)
+        nb_pad = m * per
+        rows = jnp.pad(rows, ((0, 0), (0, nb_pad * block_size - e)))
+        blocks = rows.reshape(k, nb_pad, block_size)
+        lane = lane_table[jax.lax.axis_index(axis_name)]
+        sl = jax.lax.dynamic_slice_in_dim(blocks, lane * m, m, axis=1)
+        q, scales = quantize_blocks(sl)  # [k, m, B] int8, [k, m] f32
+        q_stack = jax.lax.all_gather(q, axis_name, axis_index_groups=inter)
+        s_stack = jax.lax.all_gather(scales, axis_name,
+                                     axis_index_groups=inter)
+        sl_sum = dequantize_sum_blocks(q_stack, s_stack)  # [k, m, B] f32
+        full = jax.lax.all_gather(sl_sum, axis_name,
+                                  axis_index_groups=intra)  # [per, k, m, B]
+        full = jnp.moveaxis(full, 0, 1).reshape(k, nb_pad * block_size)
+        return full[:, :e]
+
+    return exchange
+
+
 def make_hierarchical_aggregate(model, update_type: str, mesh: Mesh,
                                 axis_name: str = "clients",
                                 num_groups: int = 0,
                                 block_size: int = 256) -> Callable:
     """The two-level quantized merge: intra-group exact-f32 psum (ICI),
-    inter-group blockwise-int8 exchange (DCN), dequantize-then-accumulate
-    in f32. Same signature/semantics as `make_shardmap_aggregate`; weights
-    are computed identically (exact f32 scalar psum — only the BULK param
-    payload is quantized, and only on the cross-host wire).
+    inter-group lane-sliced blockwise-int8 exchange (DCN),
+    dequantize-then-accumulate in f32. Same signature/semantics as
+    `make_shardmap_aggregate`; weights are computed identically (exact f32
+    scalar psum — only the BULK param payload is quantized, and only on
+    the cross-host wire).
 
     With one group (single-process real topology) there is no cross-host
     wire and the program is exactly `make_shardmap_aggregate`'s — the
-    quantizer never runs. See DESIGN.md §12 for when the hierarchy engages
-    and the error-bound derivation."""
+    quantizer never runs. See DESIGN.md §12 for the error-bound derivation
+    and §23 for the lane-sliced exchange and when the hierarchy engages."""
     intra = host_groups(mesh, num_groups)
     n_groups = len(intra)
     per = len(intra[0])
-    # lane l of every group exchanges with lane l of every other group:
-    # the gather that carries the int8 payloads across the host boundary
-    inter = [[g[lane] for g in intra] for lane in range(per)]
+    exchange = _make_quantized_exchange(axis_name, intra, block_size)
     weights_fn = _raw_weights(model, update_type, axis_name)
-
-    def quantized_allreduce(leaf):
-        """f32 per-host partial -> f32 global sum via int8 DCN exchange."""
-        q, scales = quantize_blockwise(leaf, block_size)
-        q_stack = jax.lax.all_gather(q, axis_name, axis_index_groups=inter)
-        s_stack = jax.lax.all_gather(scales, axis_name,
-                                     axis_index_groups=inter)
-        return dequantize_sum(q_stack, s_stack, leaf.shape)
 
     def per_device(params_shard, sel_shard, dev_x):
         w = weights_fn(params_shard, sel_shard, dev_x)
         part = _partial_merge(params_shard, w)
         # level 1 — ICI: exact f32 all-reduce within each host group
         host_sum = jax.lax.psum(part, axis_name, axis_index_groups=intra)
-        # level 2 — DCN: int8 payloads cross the host boundary
+        # level 2 — DCN: lane-sliced int8 payloads cross the host boundary
         if n_groups > 1:
-            agg = jax.tree.map(quantized_allreduce, host_sum)
+            agg = jax.tree.map(
+                lambda hs: exchange(hs.reshape(1, -1)).reshape(hs.shape),
+                host_sum)
         else:
             agg = host_sum
         agg = jax.tree.map(lambda t, a: a.astype(t.dtype), params_shard, agg)
@@ -206,6 +335,9 @@ def make_hierarchical_aggregate(model, update_type: str, mesh: Mesh,
     def aggregate(stacked_params, sel_mask, dev_x,
                   sel_idx=None) -> Tuple[Any, jax.Array]:
         del sel_idx  # per-shard scoring is already local (see above)
+        _note_merge("quantized", stacked_params,
+                    n_devices=int(mesh.devices.size), n_groups=n_groups,
+                    per_group=per, block_size=block_size)
         fn = shard_map(
             per_device, mesh=mesh,
             in_specs=(in_specs_for(stacked_params), spec_clients, P()),
@@ -217,6 +349,149 @@ def make_hierarchical_aggregate(model, update_type: str, mesh: Mesh,
             check_rep=False,
         )
         return fn(stacked_params, sel_mask, dev_x)
+
+    return aggregate
+
+
+def _degenerate_clustered(base: Callable) -> Callable:
+    """Wrap a single-global aggregate as the K=1 clustered one: cluster
+    labels are dead, the merged model gains a leading [1] row (a metadata
+    broadcast — no float op touches the merge), has_update[0] is 'anyone
+    selected'. Keeps the K=1 clustered call bitwise-identical to the
+    single-global program by construction."""
+
+    @jax.jit
+    def aggregate(stacked_params, sel_mask, dev_x, cluster_in,
+                  sel_idx=None) -> Tuple[Any, jax.Array, jax.Array]:
+        del cluster_in, sel_idx
+        agg, w = base(stacked_params, sel_mask, dev_x)
+        has = (jnp.sum(sel_mask) > 0)[None]
+        return jax.tree.map(lambda a: a[None], agg), w, has
+
+    return aggregate
+
+
+def make_clustered_shardmap_aggregate(model, update_type: str, mesh: Mesh,
+                                      k: int, axis_name: str = "clients"
+                                      ) -> Callable:
+    """Explicit-collective K-cluster merge: build fn(stacked_params,
+    sel_mask, dev_x, cluster_in, sel_idx=None) -> (cluster_params [K, ...],
+    weights [N], has_update [K]) — semantics of
+    cluster.merge.make_clustered_aggregate_fn, execution as per-device
+    [K, ...] partial sheets + ONE psum over the K-stacked tree. The psum's
+    replicated output is just the merged [K, ...] models (bytes ∝ K·model,
+    never fleet); pinned bitwise to the einsum lowering on the same mesh
+    (tests/test_clustermerge.py).
+
+    At k=1 the one-hot sheet is the all-ones row and the program is wrapped
+    DIRECTLY around `make_shardmap_aggregate` (cluster labels are dead):
+    same executable as the single-global merge by construction, the same
+    degeneracy discipline as cluster.merge's null spec. (Compiling the
+    sheet ops with k=1 would be value-identical but not bitwise — a
+    traced-input one-hot multiply perturbs XLA fusion by ~1 ulp.)"""
+    if k == 1:
+        return _degenerate_clustered(
+            make_shardmap_aggregate(model, update_type, mesh, axis_name))
+    raw_fn = _raw_scores(model, update_type)
+
+    def per_device(params_shard, sel_shard, dev_x, cluster_shard):
+        raw = raw_fn(params_shard, sel_shard, dev_x)
+        sheet, w, has = _clustered_sheet(raw, cluster_shard, k, axis_name)
+        part = _clustered_partial(sheet, params_shard)
+        cp = jax.lax.psum(part, axis_name)
+        cp = jax.tree.map(lambda t, a: a.astype(t.dtype), params_shard, cp)
+        return cp, w, has
+
+    spec_clients = P(axis_name)
+
+    def in_specs_for(tree):
+        return jax.tree.map(lambda _: P(axis_name), tree)
+
+    @jax.jit
+    def aggregate(stacked_params, sel_mask, dev_x, cluster_in,
+                  sel_idx=None) -> Tuple[Any, jax.Array, jax.Array]:
+        del sel_idx  # per-shard scoring is already local (see above)
+        _note_merge("shard_map", stacked_params, k=k,
+                    n_devices=int(mesh.devices.size))
+        fn = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(in_specs_for(stacked_params), spec_clients, P(),
+                      spec_clients),
+            out_specs=(jax.tree.map(lambda _: P(), stacked_params),
+                       spec_clients, P()),
+        )
+        return fn(stacked_params, sel_mask, dev_x, cluster_in)
+
+    return aggregate
+
+
+def make_clustered_hierarchical_aggregate(model, update_type: str,
+                                          mesh: Mesh, k: int,
+                                          axis_name: str = "clients",
+                                          num_groups: int = 0,
+                                          block_size: int = 256
+                                          ) -> Callable:
+    """The K-cluster quantized merge: per-device [K, ...] partial sheets,
+    intra-host-group exact-f32 psum, then the lane-sliced int8 exchange
+    per CLUSTER ROW — payloads carry a [K, n_blocks] per-cluster per-block
+    scale sheet (quantize.quantize_blockwise_k's layout), so a hot
+    cluster's magnitude never inflates a quiet cluster's quantization
+    step. Cluster-row weights (the [K] row sums) are an exact f32 psum —
+    only the bulk [K, ...] payload is quantized, only on the cross-host
+    wire. Same signature as `make_clustered_shardmap_aggregate`.
+
+    At K=1 this IS `make_hierarchical_aggregate`'s program
+    (`_degenerate_clustered` — the bitwise degeneracy pin, by
+    construction); with one host group the DCN stage vanishes and the
+    program is the clustered shard_map merge exactly."""
+    if k == 1:
+        return _degenerate_clustered(make_hierarchical_aggregate(
+            model, update_type, mesh, axis_name, num_groups, block_size))
+    intra = host_groups(mesh, num_groups)
+    n_groups = len(intra)
+    per = len(intra[0])
+    exchange = _make_quantized_exchange(axis_name, intra, block_size)
+    raw_fn = _raw_scores(model, update_type)
+
+    def per_device(params_shard, sel_shard, dev_x, cluster_shard):
+        raw = raw_fn(params_shard, sel_shard, dev_x)
+        # row sums psum GLOBALLY in exact f32 (never quantized)
+        sheet, w, has = _clustered_sheet(raw, cluster_shard, k, axis_name)
+        part = _clustered_partial(sheet, params_shard)
+        # level 1 — ICI: exact f32 all-reduce within each host group
+        host_sum = jax.lax.psum(part, axis_name, axis_index_groups=intra)
+        # level 2 — DCN: per-cluster-row lane-sliced int8 exchange
+        if n_groups > 1:
+            agg = jax.tree.map(
+                lambda hs: exchange(hs.reshape(k, -1)).reshape(hs.shape),
+                host_sum)
+        else:
+            agg = host_sum
+        agg = jax.tree.map(lambda t, a: a.astype(t.dtype), params_shard, agg)
+        return agg, w, has
+
+    spec_clients = P(axis_name)
+
+    def in_specs_for(tree):
+        return jax.tree.map(lambda _: P(axis_name), tree)
+
+    @jax.jit
+    def aggregate(stacked_params, sel_mask, dev_x, cluster_in,
+                  sel_idx=None) -> Tuple[Any, jax.Array, jax.Array]:
+        del sel_idx  # per-shard scoring is already local (see above)
+        _note_merge("quantized", stacked_params, k=k,
+                    n_devices=int(mesh.devices.size), n_groups=n_groups,
+                    per_group=per, block_size=block_size)
+        fn = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(in_specs_for(stacked_params), spec_clients, P(),
+                      spec_clients),
+            out_specs=(jax.tree.map(lambda _: P(), stacked_params),
+                       spec_clients, P()),
+            # grouped collectives: see make_hierarchical_aggregate
+            check_rep=False,
+        )
+        return fn(stacked_params, sel_mask, dev_x, cluster_in)
 
     return aggregate
 

@@ -1,0 +1,287 @@
+"""Measured collective cost model + byte counters on the merge seam.
+
+Every prior sizing decision on the merge path was a pow2 heuristic and
+every "Nx fewer wire bytes" claim was prose. This module replaces both
+(ROADMAP item 3; DESIGN.md §23):
+
+  * `seam` (a `SeamCounters`) — the byte-accounting registry. The
+    collective builders in parallel/collectives.py report a per-merge
+    wire PROFILE computed from the actual leaf shapes they trace
+    (`note_merge`), and the host-side numpy collectives in
+    parallel/multihost.py report true per-call payload/wire bytes
+    (`add_host_collective`) — the gloo-free sizing input the PR 16 lane
+    plan lacked. Engines and benches snapshot this instead of guessing.
+
+  * shape -> bytes formulas for the two wire patterns in play, under the
+    standard ring lowerings (bytes crossing the host boundary, totalled
+    over all links; D devices, H host groups, S merged payload bytes):
+
+      - flat f32 all-reduce (einsum / shard_map backends): total link
+        bytes 2(D-1)/D · S_f32 per participant; a contiguous-block ring
+        crosses the host boundary on H of its D links, so
+        DCN = 2 · H · (D-1)/D · S_f32.
+      - lane-sliced hierarchical int8 (quantized backend): the only
+        cross-host stage is the per-lane inter-group all_gather of
+        quantized slices. Each of the `per` lane rings moves
+        G(G-1) · P_lane link bytes and per · P_lane = S_q (the whole
+        quantized host partial: int8 blocks + one f32 scale per block,
+        incl. lane padding), so DCN = G(G-1) · S_q. Lane slicing is what
+        keeps `per` out of that product — the pre-§23 exchange gathered
+        the full payload on every local device and paid per · G(G-1) · S_q.
+
+    At the PR 16 pod topology (H=G=2, D=8, block 256) the ratio is
+    (2·2·7/8·4S) / (2·1·~1.03S) ≈ 6.8x in int8's favor; the same formulas
+    also say where the hierarchy LOSES — the all-gather's G² growth means
+    at G=4, D=8 the win shrinks to ~2.3x, which is exactly the kind of
+    fact a measured plan should act on instead of a pow2 default. (The
+    codec alone can never reach 4x on symmetric accounting: int8 + f32
+    scales is 4/(1 + 4/B) ≈ 3.94x at block 256.)
+
+  * `plan_merge` — the measured search: times each candidate
+    (backend, block_size, num_groups) collective on representative
+    payload shapes (jitted, best-of-repeats, synthetic ones) and scores
+    wall + dcn_bytes / dcn_gbps — measured compute plus modeled wire at
+    the configured cross-host bandwidth (on a single CPU box the DCN term
+    is a model by necessity; the wall term is real). The chosen plan
+    feeds cfg.aggregation_backend="auto" (federation/rounds.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+F32_BYTES = 4
+SCALE_BYTES = 4
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def quantized_payload_bytes(elem_counts: Sequence[int], k: int,
+                            per_group: int, block_size: int) -> int:
+    """S_q: total quantized host-partial bytes across one host group's
+    lanes — int8 block payloads + one f32 scale per block, per cluster
+    row, including the pad to lane-aligned whole blocks."""
+    per = max(per_group, 1)
+    total = 0
+    for e in elem_counts:
+        nb_pad = _ceil_div(_ceil_div(e, block_size), per) * per
+        total += k * nb_pad * (block_size + SCALE_BYTES)
+    return total
+
+
+def flat_psum_dcn_bytes(merged_elems: int, n_devices: int,
+                        n_hosts: int) -> float:
+    """Cross-host bytes of the f32 flat all-reduce merge (ring lowering,
+    totalled over links): 2 · H · (D-1)/D · 4 · elems. Zero when all
+    devices share one host."""
+    if n_hosts <= 1 or n_devices <= 1:
+        return 0.0
+    return 2.0 * n_hosts * (n_devices - 1) / n_devices \
+        * merged_elems * F32_BYTES
+
+
+def lane_sliced_dcn_bytes(payload_bytes: int, n_groups: int) -> float:
+    """Cross-host bytes of the lane-sliced hierarchical exchange:
+    G(G-1) · S_q (each host partial's quantized bytes cross each pairwise
+    boundary once; the reassembly all_gather is intra-host)."""
+    if n_groups <= 1:
+        return 0.0
+    return float(n_groups * (n_groups - 1) * payload_bytes)
+
+
+def merge_profile(*, backend: str, elem_counts: Sequence[int], k: int,
+                  n_devices: int, n_groups: int = 0, per_group: int = 0,
+                  block_size: int = 0) -> Dict[str, Any]:
+    """Wire profile of ONE merge with these leaf shapes. For the explicit
+    f32 backends `n_groups` may be 0 (host topology unknown at build —
+    resolve the DCN bytes at query time via `flat_psum_dcn_bytes`)."""
+    merged = k * int(sum(elem_counts))
+    prof: Dict[str, Any] = {
+        "backend": backend,
+        "k": k,
+        "n_devices": n_devices,
+        "n_groups": n_groups,
+        "per_group": per_group,
+        "block_size": block_size,
+        "merged_elems": merged,
+        "merged_f32_bytes": merged * F32_BYTES,
+    }
+    if backend == "quantized":
+        payload = quantized_payload_bytes(elem_counts, k,
+                                          per_group, block_size)
+        prof["dcn_payload_bytes"] = payload
+        prof["dcn_bytes"] = lane_sliced_dcn_bytes(payload, n_groups)
+        flat = flat_psum_dcn_bytes(merged, n_devices, max(n_groups, 1))
+        prof["dcn_bytes_f32_same_topology"] = flat
+        prof["dcn_reduction_vs_f32"] = (
+            flat / prof["dcn_bytes"] if prof["dcn_bytes"] else None)
+    else:
+        prof["dcn_bytes"] = (
+            flat_psum_dcn_bytes(merged, n_devices, n_groups)
+            if n_groups else None)
+    return prof
+
+
+class SeamCounters:
+    """Process-global byte accounting for the collective seams.
+
+    Two kinds of entries: `note_merge` keeps the LATEST per-merge wire
+    profile per backend name (reported at jit trace time — multiply by
+    round counts host-side); `add_host_collective` accumulates true
+    per-call bytes of the host-side numpy collectives (these run outside
+    jit, so every call is counted as it happens)."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.merge_profiles: Dict[str, Dict[str, Any]] = {}
+        self.host_collectives: Dict[str, Dict[str, float]] = {}
+
+    def note_merge(self, name: str, profile: Dict[str, Any]) -> None:
+        self.merge_profiles[name] = profile
+
+    def add_host_collective(self, name: str, payload_bytes: int,
+                            wire_bytes: int) -> None:
+        c = self.host_collectives.setdefault(
+            name, {"calls": 0, "payload_bytes": 0, "wire_bytes": 0})
+        c["calls"] += 1
+        c["payload_bytes"] += int(payload_bytes)
+        c["wire_bytes"] += int(wire_bytes)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "merge_profiles": {k: dict(v)
+                               for k, v in self.merge_profiles.items()},
+            "host_collectives": {k: dict(v)
+                                 for k, v in self.host_collectives.items()},
+        }
+
+
+seam = SeamCounters()
+
+
+def _group_count_candidates(n_devices: int, n_hosts: int) -> List[int]:
+    """num_groups candidates for the quantized backend: the real host
+    count first, then the other divisors of the mesh ≥ 2 (virtual-host
+    emulation widths)."""
+    divs = [g for g in range(2, n_devices + 1) if n_devices % g == 0]
+    if n_hosts in divs:
+        divs.remove(n_hosts)
+        divs.insert(0, n_hosts)
+    return divs
+
+
+def _best_wall(fn, args, repeats: int) -> float:
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile outside the timed region
+    best = float("inf")
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def plan_merge(mesh, elem_counts: Sequence[int], *, k: int = 1,
+               axis_name: str = "clients", n_hosts: Optional[int] = None,
+               group_counts: Optional[Sequence[int]] = None,
+               block_sizes: Sequence[int] = (128, 256, 512),
+               dcn_gbps: float = 25.0, repeats: int = 3,
+               max_group_candidates: int = 2) -> Dict[str, Any]:
+    """Measured search over merge plans for payloads of these leaf shapes
+    ([k, e] cluster-row sheets, e per leaf in `elem_counts`).
+
+    Times the actual collective exchange of each candidate — the flat f32
+    psum (what einsum/shard_map lower to) and the lane-sliced hierarchical
+    int8 exchange per (num_groups, block_size) — jitted on the mesh with
+    synthetic payloads, best of `repeats`. Score = measured wall +
+    modeled cross-host bytes / dcn_gbps. Returns the full candidate table
+    plus the chosen plan: {"backend", "num_groups", "block_size"}.
+
+    `n_hosts` is the host-group count used for the f32 baseline's DCN
+    accounting (default: the mesh's real process topology). On a real pod
+    the quantized candidates should use num_groups=0 (real topology);
+    `group_counts` overrides for virtual-host emulation."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from fedmse_tpu.parallel.collectives import (_make_quantized_exchange,
+                                                 host_groups)
+
+    n_devices = int(mesh.devices.size)
+    if n_hosts is None:
+        n_hosts = len(host_groups(mesh, 0))
+    if group_counts is None:
+        group_counts = _group_count_candidates(
+            n_devices, n_hosts)[:max_group_candidates]
+    merged = k * int(sum(elem_counts))
+    payloads = tuple(jnp.ones((k, int(e)), jnp.float32)
+                     for e in elem_counts)
+    rep_specs = jax.tree.map(lambda _: P(), payloads)
+
+    candidates: List[Dict[str, Any]] = []
+
+    def add_candidate(backend, num_groups, block_size, wall, dcn):
+        candidates.append({
+            "backend": backend, "num_groups": int(num_groups),
+            "block_size": int(block_size), "wall_s": float(wall),
+            "dcn_bytes": float(dcn),
+            "score_s": float(wall + dcn / (dcn_gbps * 1e9)),
+        })
+
+    # flat f32 all-reduce: the program einsum and shard_map both lower to
+    def flat_dev(leaves):
+        return jax.tree.map(
+            lambda l: jax.lax.psum(l, axis_name), leaves)
+
+    flat_fn = jax.jit(shard_map(flat_dev, mesh=mesh, in_specs=(rep_specs,),
+                                out_specs=rep_specs, check_rep=False))
+    wall = _best_wall(flat_fn, (payloads,), repeats)
+    add_candidate("shard_map", 0, 0, wall,
+                  flat_psum_dcn_bytes(merged, n_devices, n_hosts))
+
+    for g in group_counts:
+        if g <= 1 or n_devices % g != 0:
+            continue
+        intra = host_groups(mesh, g)
+        per = len(intra[0])
+        for bs in block_sizes:
+            exchange = _make_quantized_exchange(axis_name, intra, int(bs))
+
+            def hier_dev(leaves, _intra=intra, _ex=exchange):
+                hs = jax.tree.map(
+                    lambda l: jax.lax.psum(l, axis_name,
+                                           axis_index_groups=_intra),
+                    leaves)
+                return jax.tree.map(_ex, hs)
+
+            hier_fn = jax.jit(shard_map(
+                hier_dev, mesh=mesh, in_specs=(rep_specs,),
+                out_specs=rep_specs, check_rep=False))
+            wall = _best_wall(hier_fn, (payloads,), repeats)
+            payload_q = quantized_payload_bytes(elem_counts, k, per, int(bs))
+            add_candidate("quantized", g, bs, wall,
+                          lane_sliced_dcn_bytes(payload_q, g))
+
+    best = min(candidates, key=lambda c: c["score_s"])
+    return {
+        "chosen": {"backend": best["backend"],
+                   "num_groups": best["num_groups"],
+                   "block_size": best["block_size"]},
+        "candidates": candidates,
+        "merged_elems": merged,
+        "merged_f32_bytes": merged * F32_BYTES,
+        "k": k,
+        "n_devices": n_devices,
+        "n_hosts": int(n_hosts),
+        "dcn_gbps": float(dcn_gbps),
+    }

@@ -264,11 +264,21 @@ def host_fetch(tree: Any) -> Any:
         return jax.device_get(tree)
     from jax.experimental import multihost_utils
 
+    from fedmse_tpu.parallel.costmodel import seam
+
     def fetch(leaf):
         # only non-fully-addressable global arrays need the collective;
         # host numpy / local arrays take the plain path (process_allgather
         # would STACK host data across processes — wrong shape)
         if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            # the lane-plan allgather of the host-sharded tier (round
+            # outputs sharded over pod lanes): payload = the shards this
+            # process contributes, wire = the remote bytes it receives —
+            # measured per call into the same seam the merge backends
+            # profile (podscale artifact: bench.py _podscale_worker)
+            local = sum(int(s.data.nbytes) for s in leaf.addressable_shards)
+            seam.add_host_collective("host_fetch_allgather", local,
+                                     int(leaf.nbytes) - local)
             return np.asarray(multihost_utils.process_allgather(leaf,
                                                                 tiled=True))
         return np.asarray(jax.device_get(leaf))

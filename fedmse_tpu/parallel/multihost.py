@@ -151,9 +151,18 @@ def allgather_tree_sum(tree):
     deterministic and uniform — the same property `uniform_decision` gives
     booleans, extended to partial reductions. Identity single-process: the
     degenerate shard's partial IS the fleet value, bit-for-bit."""
-    if jax.process_count() == 1:
-        return tree
     import numpy as np
+
+    from fedmse_tpu.parallel.costmodel import seam
+    payload = int(sum(np.asarray(l).nbytes for l in jax.tree.leaves(tree)))
+    procs = jax.process_count()
+    # wire bytes: each process's payload crosses to every other process
+    # ((P-1)·payload per participant; 0 single-process — measured, not
+    # modeled, which is what the podscale artifact persists
+    seam.add_host_collective("allgather_tree_sum", payload,
+                             (procs - 1) * payload)
+    if procs == 1:
+        return tree
     from jax.experimental import multihost_utils
     stacked = multihost_utils.process_allgather(tree)
     return jax.tree.map(lambda l: np.asarray(l).sum(axis=0), stacked)
@@ -168,11 +177,20 @@ def allgather_blocks(local, blocks, process_order):
     process pads its rows to the widest block, and the pad tail is dropped
     on reassembly. Identity single-process."""
     import numpy as np
+
+    from fedmse_tpu.parallel.costmodel import seam
     local = np.asarray(local)
-    if jax.process_count() == 1:
+    procs = jax.process_count()
+    widest = max(hi - lo for lo, hi in blocks)
+    row_elems = int(np.prod(local.shape[1:], dtype=np.int64))
+    padded_bytes = widest * row_elems * local.dtype.itemsize
+    # the lane-plan allgather of the host-sharded tier: payload is the
+    # local block, wire counts the padded block each peer must receive
+    seam.add_host_collective("allgather_blocks", int(local.nbytes),
+                             (procs - 1) * padded_bytes)
+    if procs == 1:
         return local
     from jax.experimental import multihost_utils
-    widest = max(hi - lo for lo, hi in blocks)
     padded = np.zeros((widest,) + local.shape[1:], local.dtype)
     padded[: local.shape[0]] = local
     stacked = np.asarray(multihost_utils.process_allgather(padded))

@@ -26,6 +26,14 @@ partial-sums therefore accumulates at most Σ_h max|x_b^(h)|/254 absolute
 error per element — linear in the host count, never in the client count
 (the intra-host stage is exact f32).
 
+The clustered merge (cluster/merge.py's [K, N] sheet folded into the
+collective) ships K cluster-row partials per leaf; `quantize_blockwise_k`
+is the leading-K variant of the codec — every cluster row is blocked and
+scaled INDEPENDENTLY (a [K, n_blocks] scale sheet), so a hot cluster's
+large partial cannot inflate the quantization step of a quiet one. The
+per-row math is exactly `quantize_blockwise`'s, which is why K=1
+bitwise-degenerates to the single-global codec.
+
 All functions are pure jnp and trace cleanly inside shard_map/jit; the
 (q, scale) pair is what actually crosses the DCN link.
 """
@@ -43,6 +51,32 @@ INT8_MAX = 127.0
 ERROR_DENOM = 2.0 * INT8_MAX
 
 
+def quantize_blocks(blocks: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Core of the codec, shared by every layout: blocks [..., block] f32
+    -> (q int8 [..., block], scales f32 [...]). One symmetric scale per
+    block (max|x_b|/127; an all-zero block gets scale 1 so 0/0 never
+    happens). Leading axes are batch — the [K, n_blocks] scale sheet of
+    the clustered merge and the lane-sliced hierarchy both reduce to this
+    per-block rule, so their numerics are the single-leaf codec's."""
+    amax = jnp.max(jnp.abs(blocks), axis=-1)
+    scales = jnp.where(amax > 0, amax / INT8_MAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(blocks / scales[..., None]),
+                 -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scales
+
+
+def dequantize_sum_blocks(q_stack: jax.Array,
+                          scale_stack: jax.Array) -> jax.Array:
+    """Accumulate gathered quantized payloads in block layout:
+    (q [H, ..., block] int8, scales [H, ...] f32) -> f32 [..., block].
+    Dequantize-THEN-accumulate in f32 (the PR 5 wire contract), summing
+    over the leading host axis — block shape in, block shape out, so the
+    caller controls padding/reassembly (the lane-sliced hierarchy sums
+    slices that are later regathered intra-host)."""
+    deq = q_stack.astype(jnp.float32) * scale_stack[..., None]
+    return jnp.sum(deq, axis=0)
+
+
 def quantize_blockwise(x: jax.Array, block_size: int = 256
                        ) -> Tuple[jax.Array, jax.Array]:
     """x (any shape, float) -> (q int8 [n_blocks, block_size],
@@ -51,12 +85,38 @@ def quantize_blockwise(x: jax.Array, block_size: int = 256
     flat = x.astype(jnp.float32).reshape(-1)
     pad = (-flat.size) % block_size
     flat = jnp.pad(flat, (0, pad))
-    blocks = flat.reshape(-1, block_size)
-    amax = jnp.max(jnp.abs(blocks), axis=1)
-    scales = jnp.where(amax > 0, amax / INT8_MAX, 1.0).astype(jnp.float32)
-    q = jnp.clip(jnp.round(blocks / scales[:, None]),
-                 -INT8_MAX, INT8_MAX).astype(jnp.int8)
-    return q, scales
+    return quantize_blocks(flat.reshape(-1, block_size))
+
+
+def quantize_blockwise_k(x: jax.Array, block_size: int = 256
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """Leading-K blockwise codec for clustered partials: x [K, ...] ->
+    (q int8 [K, n_blocks, block_size], scales f32 [K, n_blocks]).
+
+    Each cluster row is flattened, zero-padded to a whole block and
+    scaled independently — blocks NEVER span cluster rows, so the scale
+    sheet is per-cluster per-block and row k's error bound depends only
+    on row k's own partial (see `clustered_quantization_error_bound`).
+    At K=1 this is `quantize_blockwise` of the single row exactly."""
+    k = x.shape[0]
+    flat = x.astype(jnp.float32).reshape(k, -1)
+    pad = (-flat.shape[1]) % block_size
+    flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    return quantize_blocks(flat.reshape(k, -1, block_size))
+
+
+def dequantize_sum_k(q_stack: jax.Array, scale_stack: jax.Array,
+                     shape: Tuple[int, ...]) -> jax.Array:
+    """Accumulate H gathered leading-K payloads ([H, K, n_blocks, block]
+    int8 + [H, K, n_blocks] f32 scales) into one f32 array of `shape`
+    (= [K, ...row shape]). The K>0 twin of `dequantize_sum`: per-row
+    zero-pad is sliced off per row, so row boundaries survive."""
+    total = dequantize_sum_blocks(q_stack, scale_stack)  # [K, nb, block]
+    k = shape[0]
+    size = 1
+    for d in shape[1:]:
+        size *= d
+    return total.reshape(k, -1)[:, :size].reshape(shape)
 
 
 def dequantize_blockwise(q: jax.Array, scales: jax.Array,
@@ -96,3 +156,29 @@ def quantization_error_bound(x, block_size: int = 256) -> float:
     flat = np.pad(flat, (0, pad))
     amax = np.abs(flat.reshape(-1, block_size)).max(axis=1)
     return float(amax.max() / ERROR_DENOM) if amax.size else 0.0
+
+
+def clustered_quantization_error_bound(x, block_size: int = 256):
+    """Per-cluster worst-case absolute elementwise error of ONE
+    quantize/dequantize pass over a [K, ...] partial sheet: np.float64 [K]
+    with entry k = max_b max|x_k,b| / 254 over row k's OWN blocks only
+    (rows are blocked independently — quantize_blockwise_k never lets a
+    block span cluster rows, so row k's bound sees only row k's partial).
+
+    DESIGN.md §23 derives the composition: a clustered hierarchical merge
+    quantizing H host partial sheets P^(h) accumulates at most
+    Σ_h clustered_quantization_error_bound(P^(h))[k] absolute error per
+    element of cluster row k — linear in hosts, never in clients, and
+    per-cluster (a hot cluster cannot leak error into a quiet one).
+    At K=1 this is `quantization_error_bound` of the single row."""
+    import numpy as np
+
+    arr = np.asarray(x, dtype=np.float32)
+    k = arr.shape[0]
+    flat = arr.reshape(k, -1)
+    pad = (-flat.shape[1]) % block_size
+    flat = np.pad(flat, ((0, 0), (0, pad)))
+    if flat.shape[1] == 0:
+        return np.zeros(k, dtype=np.float64)
+    amax = np.abs(flat.reshape(k, -1, block_size)).max(axis=2)
+    return (amax.max(axis=1) / ERROR_DENOM).astype(np.float64)

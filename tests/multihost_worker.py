@@ -247,6 +247,41 @@ def run_hostlocal(pid: int, cfg, clients, dev_x, mesh, n_real: int,
           f"global_rows={full_rows} local_bytes={local_bytes} "
           f"quant_err={max_err:.2e}", flush=True)
 
+    # K-cluster int8 merge across the SAME real process boundary
+    # (DESIGN.md §23): per-device [K, ...] partial sheets, intra-process
+    # psum exact, int8 cluster-row payloads over the gloo link — pinned
+    # against the exact clustered shard_map twin
+    from fedmse_tpu.parallel import (make_clustered_hierarchical_aggregate,
+                                     make_clustered_shardmap_aggregate,
+                                     seam)
+    import jax.numpy as jnp
+
+    from fedmse_tpu.parallel.mesh import shard_clients
+    k = 2
+    cluster = shard_clients(jnp.arange(n_pad, dtype=jnp.int32) % k, mesh)
+    cexact = make_clustered_shardmap_aggregate(model, "avg", mesh, k)
+    cquant = make_clustered_hierarchical_aggregate(model, "avg", mesh, k,
+                                                   num_groups=0)
+    ce, we, he = cexact(engine.states.params, sel, gdata.dev_x, cluster)
+    cq, wq, hq = cquant(engine.states.params, sel, gdata.dev_x, cluster)
+    cw_err = np.abs(np.asarray(host_fetch(we))
+                    - np.asarray(host_fetch(wq))).max()
+    assert cw_err == 0.0, cw_err  # row sums / weights never quantized
+    np.testing.assert_array_equal(np.asarray(host_fetch(he)),
+                                  np.asarray(host_fetch(hq)))
+    ck_err = max(
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(jax.tree.leaves(host_fetch(ce)),
+                        jax.tree.leaves(host_fetch(cq))))
+    ck_scale = max(float(np.abs(np.asarray(a)).max())
+                   for a in jax.tree.leaves(host_fetch(ce)))
+    assert ck_err <= 2 * ck_scale / 254 + 1e-7, (ck_err, ck_scale)
+    prof = seam.snapshot()["merge_profiles"]["quantized"]
+    assert prof["k"] == k and prof["n_groups"] == 2, prof
+    print(f"MULTIHOST_CLUSTER_OK pid={pid} k={k} "
+          f"dcn_bytes={int(prof['dcn_bytes'])} "
+          f"cluster_err={ck_err:.2e}", flush=True)
+
 
 def podtier_config():
     """The pod-tier scenario, shared with the parent's single-process

@@ -1,0 +1,340 @@
+"""Clustered quantized collectives + cross-replica optimizer sharding
+(DESIGN.md §23): the K-cluster merge as per-device [K, ...] partial sheets
+with ONE psum over the stacked cluster rows (shard_map twin pinned BITWISE
+to the einsum lowering), the hierarchical int8 variant per cluster row
+(pinned within the clustered error bound ASSERTED FROM ACTUAL HOST
+PARTIALS), the K=1 degeneracies (same executable by construction), the
+ZeRO-style sharded Adam application (bitwise vs replicated), the measured
+merge cost model, and the effective-backend recording that makes a silent
+f32 fallback impossible to mistake for a quantized capture. All on the
+session-shared 8-virtual-device CPU mesh (tests/conftest.py::mesh8)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedmse_tpu.cluster.merge import make_clustered_aggregate_fn
+from fedmse_tpu.config import CompatConfig, ExperimentConfig
+from fedmse_tpu.data import build_dev_dataset, stack_clients, synthetic_clients
+from fedmse_tpu.federation import RoundEngine
+from fedmse_tpu.federation.state import (init_client_states,
+                                         make_sharded_client_update)
+from fedmse_tpu.models import init_stacked_params, make_model
+from fedmse_tpu.parallel import (make_clustered_hierarchical_aggregate,
+                                 make_clustered_shardmap_aggregate,
+                                 make_hierarchical_aggregate,
+                                 make_shardmap_aggregate, merge_profile,
+                                 plan_merge, seam, shard_clients,
+                                 shard_federation)
+from fedmse_tpu.parallel.quantize import (clustered_quantization_error_bound,
+                                          dequantize_sum_k,
+                                          quantization_error_bound,
+                                          quantize_blockwise,
+                                          quantize_blockwise_k)
+from fedmse_tpu.utils.seeding import ExperimentRngs
+
+pytestmark = pytest.mark.clustermerge
+
+DIM = 10
+N = 16
+K = 8
+
+
+@pytest.fixture(scope="module")
+def model():
+    return make_model("hybrid", DIM, shrink_lambda=3.0)
+
+
+@pytest.fixture(scope="module")
+def inputs(model):
+    rng = np.random.default_rng(7)
+    params = init_stacked_params(model, jax.random.key(0), N)
+    sel = jnp.asarray(rng.integers(0, 2, N).astype(np.float32).clip(0, 1))
+    sel = sel.at[:2].set(1.0)  # at least one selected client
+    dev = jnp.asarray(rng.normal(size=(32, DIM)).astype(np.float32))
+    # every cluster row populated, assignment not device-aligned
+    cluster = jnp.asarray((np.arange(N) * 3) % K, jnp.int32)
+    return params, sel, dev, cluster
+
+
+def sharded(inputs, mesh8):
+    params, sel, dev, cluster = inputs
+    return (shard_clients(params, mesh8), shard_clients(sel, mesh8), dev,
+            shard_clients(cluster, mesh8))
+
+
+# ------------------------- leading-K codec ------------------------- #
+
+def test_codec_k1_degenerates_to_blockwise(rng):
+    x = jnp.asarray(rng.normal(size=(3, 130)).astype(np.float32))
+    qk, sk = quantize_blockwise_k(x[None], 64)
+    q1, s1 = quantize_blockwise(x, 64)
+    np.testing.assert_array_equal(np.asarray(qk)[0], np.asarray(q1))
+    np.testing.assert_array_equal(np.asarray(sk)[0], np.asarray(s1))
+    bk = clustered_quantization_error_bound(x[None], 64)
+    assert bk.shape == (1,)
+    assert bk[0] == quantization_error_bound(x, 64)
+
+
+def test_codec_k_roundtrip_within_per_row_bound(rng):
+    k = 5
+    x = rng.normal(size=(k, 7, 19)).astype(np.float32)
+    x[2] *= 40.0  # one hot row must not inflate the other rows' bounds
+    q, s = quantize_blockwise_k(jnp.asarray(x), 32)
+    assert q.dtype == jnp.int8 and q.shape[0] == k
+    back = np.asarray(dequantize_sum_k(q[None], s[None], x.shape))
+    bound = clustered_quantization_error_bound(x, 32)
+    err = np.abs(back - x).reshape(k, -1).max(axis=1)
+    assert np.all(err <= bound + 1e-7), (err, bound)
+    # per-row bounds: the quiet rows' bounds stay small despite row 2
+    assert bound[0] < bound[2] / 10
+
+
+# ------------------- clustered explicit collectives ------------------- #
+
+@pytest.mark.parametrize("update_type", ["avg", "mse_avg"])
+def test_clustered_shardmap_bitwise_einsum(inputs, mesh8, model,
+                                           update_type):
+    """K=8 per-device partial sheets + one psum over the K-stacked tree is
+    BITWISE the clustered einsum lowering on the same mesh — params,
+    weights, and has_update."""
+    params_s, sel_s, dev, cluster_s = sharded(inputs, mesh8)
+    ein = make_clustered_aggregate_fn(model, update_type, K)
+    sm = make_clustered_shardmap_aggregate(model, update_type, mesh8, K)
+    cp_e, w_e, h_e = ein(params_s, sel_s, dev, cluster_s)
+    cp_s, w_s, h_s = sm(params_s, sel_s, dev, cluster_s)
+    for a, b in zip(jax.tree.leaves(cp_e), jax.tree.leaves(cp_s)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(w_e), np.asarray(w_s))
+    np.testing.assert_array_equal(np.asarray(h_e), np.asarray(h_s))
+
+
+@pytest.mark.parametrize("update_type", ["avg", "mse_avg"])
+def test_k1_clustered_pins_bitwise_to_single_global(inputs, mesh8, model,
+                                                    update_type):
+    """K=1 clustered builders wrap the EXACT single-global program (same
+    executable by construction, the ClusterSpec(k=1).is_null precedent) —
+    so the quantized K=1 merge is bitwise the existing hierarchical one."""
+    params_s, sel_s, dev, _ = sharded(inputs, mesh8)
+    zeros = shard_clients(jnp.zeros(N, jnp.int32), mesh8)
+    base_q = make_hierarchical_aggregate(model, update_type, mesh8,
+                                         num_groups=4, block_size=64)
+    clu_q = make_clustered_hierarchical_aggregate(model, update_type, mesh8,
+                                                  1, num_groups=4,
+                                                  block_size=64)
+    agg, w = base_q(params_s, sel_s, dev)
+    cp, cw, ch = clu_q(params_s, sel_s, dev, zeros)
+    for a, b in zip(jax.tree.leaves(agg), jax.tree.leaves(cp)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[0])
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(cw))
+    assert np.asarray(ch).shape == (1,) and bool(np.asarray(ch)[0])
+
+    base_s = make_shardmap_aggregate(model, update_type, mesh8)
+    clu_s = make_clustered_shardmap_aggregate(model, update_type, mesh8, 1)
+    agg, w = base_s(params_s, sel_s, dev)
+    cp, cw, _ = clu_s(params_s, sel_s, dev, zeros)
+    for a, b in zip(jax.tree.leaves(agg), jax.tree.leaves(cp)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[0])
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(cw))
+
+
+@pytest.mark.parametrize("update_type", ["avg", "mse_avg"])
+def test_clustered_quantized_within_bound_from_host_partials(
+        inputs, mesh8, model, update_type):
+    """K=8 hierarchical int8 vs the exact clustered einsum: the per-cluster
+    error must stay within the §23 composed bound Σ_h bound(P^(h))[k],
+    where each P^(h) is the ACTUAL host-group partial sheet recomputed on
+    host from the same inputs (4 emulated host groups of 2 devices) — the
+    bound is asserted against real partials, not a modeled proxy."""
+    params, sel, dev, cluster = inputs
+    params_s, sel_s, dev_s, cluster_s = sharded(inputs, mesh8)
+    ein = make_clustered_aggregate_fn(model, update_type, K)
+    quant = make_clustered_hierarchical_aggregate(model, update_type, mesh8,
+                                                  K, num_groups=4,
+                                                  block_size=64)
+    cp_e, w_e, h_e = ein(params_s, sel_s, dev_s, cluster_s)
+    cp_q, w_q, h_q = quant(params_s, sel_s, dev_s, cluster_s)
+    # control-plane tensors are NEVER quantized: bitwise across backends
+    np.testing.assert_array_equal(np.asarray(w_e), np.asarray(w_q))
+    np.testing.assert_array_equal(np.asarray(h_e), np.asarray(h_q))
+
+    # normalized sheet row k, col n = one_hot * raw_n / row_sum_k — and the
+    # returned weights ARE that column sum, so sheet * w recovers it
+    one_hot = (np.asarray(cluster)[None, :]
+               == np.arange(K)[:, None]).astype(np.float64)
+    sheetw = one_hot * np.asarray(w_e, np.float64)[None, :]
+    rows_per_group = N // 4
+    for leaf_e, leaf_q, leaf_p in zip(jax.tree.leaves(cp_e),
+                                      jax.tree.leaves(cp_q),
+                                      jax.tree.leaves(params)):
+        lp = np.asarray(leaf_p, np.float64)
+        bound = np.zeros(K)
+        for g in range(4):
+            cols = slice(g * rows_per_group, (g + 1) * rows_per_group)
+            partial = np.einsum("kn,n...->k...", sheetw[:, cols], lp[cols])
+            bound += clustered_quantization_error_bound(
+                partial.astype(np.float32), 64)
+        err = np.abs(np.asarray(leaf_e, np.float64)
+                     - np.asarray(leaf_q, np.float64)).reshape(K, -1)
+        assert np.all(err.max(axis=1) <= bound + 1e-6), (err.max(axis=1),
+                                                         bound)
+
+
+def test_empty_cluster_rows_inert(inputs, mesh8, model):
+    """A cluster row with no selected member must come back all-zero with
+    has_update False — never NaN from a 0/0 normalization."""
+    params_s, sel_s, dev, _ = sharded(inputs, mesh8)
+    # every client in rows 0..3: rows 4..7 empty
+    cluster4 = shard_clients(jnp.asarray(np.arange(N) % 4, jnp.int32), mesh8)
+    for fn in (make_clustered_shardmap_aggregate(model, "avg", mesh8, K),
+               make_clustered_hierarchical_aggregate(
+                   model, "avg", mesh8, K, num_groups=4, block_size=64)):
+        cp, w, h = fn(params_s, sel_s, dev, cluster4)
+        h = np.asarray(h)
+        assert h[:4].all() and not h[4:].any()
+        for leaf in jax.tree.leaves(cp):
+            leaf = np.asarray(leaf)
+            assert np.all(np.isfinite(leaf))
+            np.testing.assert_array_equal(leaf[4:], 0.0)
+
+
+# ---------------- ZeRO-style sharded optimizer update ---------------- #
+
+def test_sharded_adam_update_bitwise_vs_replicated(mesh8, model):
+    """Applying one Adam step with every moment leaf pinned P('clients')
+    produces bitwise the replicated application, and the outputs live
+    sharded — each replica materialized only its partition of the
+    moments (the §23 ZeRO seam)."""
+    tx = optax.adam(1e-3)
+    states = init_client_states(model, tx, jax.random.key(3), N)
+    grads = jax.tree.map(
+        lambda t: (jnp.arange(t.size, dtype=jnp.float32)
+                   .reshape(t.shape) % 7 - 3) * 0.01, states.params)
+    rep = make_sharded_client_update(tx)
+    shd = make_sharded_client_update(tx, mesh8)
+    p_r, o_r = rep(grads, states.opt_state, states.params)
+    p_s, o_s = shd(grads, states.opt_state, states.params)
+    for a, b in zip(jax.tree.leaves(p_r), jax.tree.leaves(p_s)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(o_r), jax.tree.leaves(o_s)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for leaf in jax.tree.leaves(p_s) + jax.tree.leaves(o_s):
+        if leaf.ndim and leaf.shape[0] == N:
+            assert not leaf.sharding.is_fully_replicated
+
+
+# --------------------- measured merge cost model --------------------- #
+
+def test_merge_profile_formulas():
+    prof = merge_profile(backend="quantized", elem_counts=[1000, 24],
+                         k=4, n_devices=8, n_groups=2, per_group=4,
+                         block_size=64)
+    # 1000 elems -> 16 blocks of 64 (lane-aligned at per=4), 24 -> 4 blocks
+    assert prof["dcn_payload_bytes"] == 4 * (16 + 4) * (64 + 4)
+    assert prof["dcn_bytes"] == 2 * 1 * prof["dcn_payload_bytes"]
+    assert prof["merged_elems"] == 4 * 1024
+    # H=2 is where the hierarchy wins big (the module-docstring ~6.8x)
+    assert prof["dcn_reduction_vs_f32"] > 4.0
+
+
+def test_plan_merge_measured_search(mesh8):
+    elems = [353, 64]
+    plan = plan_merge(mesh8, elems, k=4, group_counts=(2, 4),
+                      block_sizes=(64, 256), repeats=1)
+    assert plan["chosen"]["backend"] in ("shard_map", "quantized")
+    # flat baseline + 2 groups x 2 block sizes, every row measured
+    assert len(plan["candidates"]) == 5
+    for c in plan["candidates"]:
+        assert c["wall_s"] > 0.0 and np.isfinite(c["score_s"])
+    backends = {c["backend"] for c in plan["candidates"]}
+    assert backends == {"shard_map", "quantized"}
+    assert plan["merged_elems"] == 4 * sum(elems)
+
+
+def test_seam_records_clustered_quantized_profile(inputs, mesh8, model):
+    seam.reset()
+    params_s, sel_s, dev, cluster_s = sharded(inputs, mesh8)
+    fn = make_clustered_hierarchical_aggregate(model, "avg", mesh8, K,
+                                               num_groups=4, block_size=64)
+    fn(params_s, sel_s, dev, cluster_s)
+    prof = seam.snapshot()["merge_profiles"]["quantized"]
+    assert prof["k"] == K and prof["n_groups"] == 4
+    assert prof["dcn_bytes"] > 0
+    assert prof["dcn_bytes_f32_same_topology"] > prof["dcn_bytes"]
+
+
+# ------------------ effective-backend recording ------------------ #
+
+class _LogCapture(logging.Handler):
+    def __init__(self):
+        super().__init__(logging.DEBUG)
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+@pytest.fixture
+def pkg_log():
+    root = logging.getLogger("fedmse_tpu")
+    handler = _LogCapture()
+    old_level = root.level
+    root.addHandler(handler)
+    root.setLevel(logging.DEBUG)
+    yield handler
+    root.setLevel(old_level)
+    root.removeHandler(handler)
+
+
+@pytest.fixture(scope="module")
+def federation():
+    clients = synthetic_clients(n_clients=6, dim=DIM, n_normal=96,
+                                n_abnormal=40)
+    dev_x = build_dev_dataset(clients, ExperimentRngs(run=0).data_rng)
+    return stack_clients(clients, dev_x, 8, pad_clients_to=8)
+
+
+def _engine(data, model, backend, mesh=None, **cfg_kw):
+    cfg = ExperimentConfig(dim_features=DIM, network_size=6, epochs=1,
+                           batch_size=8, aggregation_backend=backend,
+                           compat=CompatConfig(vote_tie_break=False),
+                           **cfg_kw)
+    return RoundEngine(model, cfg, data, n_real=6,
+                       rngs=ExperimentRngs(run=0), model_type="hybrid",
+                       update_type="mse_avg", fused=True, mesh=mesh)
+
+
+def test_off_mesh_degrade_warns_and_records(federation, model, pkg_log):
+    """The einsum fallback is LOUD (WARNING, was DEBUG) and the effective
+    backend lands in the RoundResult — a silent f32 fallback can never
+    masquerade as a quantized capture."""
+    eng = _engine(federation, model, "quantized")
+    assert eng.agg_backend == "einsum"
+    warned = [r for r in pkg_log.records if "inert" in r.getMessage()]
+    assert warned and all(r.levelno == logging.WARNING for r in warned)
+    res = eng.run_round(0)
+    assert res.backend == "einsum"
+
+
+def test_on_mesh_backend_recorded_in_result(federation, mesh8, model):
+    eng = _engine(federation, model, "quantized", mesh=mesh8, quant_hosts=4)
+    eng.data, eng.states = shard_federation(federation, eng.states, mesh8)
+    eng._ver_x, eng._ver_m = eng._verification_tensors()
+    assert eng.agg_backend == "quantized"
+    res = eng.run_round(0)
+    assert res.backend == "quantized"
+
+
+def test_auto_backend_resolves_via_plan(federation, mesh8, model):
+    eng = _engine(federation, model, "auto", mesh=mesh8)
+    eng.data, eng.states = shard_federation(federation, eng.states, mesh8)
+    eng._ver_x, eng._ver_m = eng._verification_tensors()
+    eff = eng.agg_backend
+    assert eff in ("shard_map", "quantized")
+    assert eng._merge_plan is not None
+    assert eng._merge_plan["chosen"]["backend"] == eff

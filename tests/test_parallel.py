@@ -241,3 +241,19 @@ def test_two_process_hostlocal_and_quantized(two_process_outputs):
     assert results[0].group(1) == results[1].group(1)
     local, total = int(results[0].group(2)), int(results[0].group(3))
     assert local * 2 == total  # each host stacked exactly half the axis
+
+
+def test_two_process_clustered_quantized_merge(two_process_outputs):
+    """The K-cluster hierarchical int8 merge across a REAL process boundary
+    (DESIGN.md §23): per-device [K, ...] partial sheets, intra-process psum
+    exact, int8 cluster-row payloads over the gloo link — pinned inside the
+    worker against the exact clustered shard_map twin (bitwise weights and
+    has_update, params within the per-cluster bound), with the seam's wire
+    profile recording the real 2-group topology. This test checks the pin
+    fired on both processes and agreed."""
+    results = _match_both(
+        two_process_outputs.outs,
+        r"MULTIHOST_CLUSTER_OK pid=\d+ (k=\d+ dcn_bytes=(\d+) "
+        r"cluster_err=[\d.e+-]+)")
+    assert results[0].group(1) == results[1].group(1)
+    assert int(results[0].group(2)) > 0  # the int8 payload crossed DCN
