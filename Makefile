@@ -11,7 +11,7 @@ LIB := fedmse_tpu/native/libfedmse_io.so
         serve-bench chaos-sweep churn-sweep pipeline-bench precision-bench \
         shard-bench knn-bench cohort-bench flywheel-sweep net-bench \
         cluster-sweep podscale-bench redteam-sweep gateway-bench \
-        clustermerge-bench tpu-check
+        clustermerge-bench fusedstep-bench tpu-check
 
 native: $(LIB)
 
@@ -169,6 +169,10 @@ gateway-bench:
 # BENCH_CLUSTERMERGE_r19_cpu.json; hermetic CPU like the tests)
 clustermerge-bench:
 	python bench.py --clustermerge-bench --out BENCH_CLUSTERMERGE_r19_cpu.json
+
+fusedstep-bench:
+	env FEDMSE_TUNE=1 python bench.py --fusedstep-bench \
+		--out BENCH_FUSEDSTEP_r20_cpu.json
 
 tpu-check:
 	python tpu_check.py

@@ -946,6 +946,140 @@ def measure_clustermerge(cfg, n_clients: int = 10000, k: int = 8):
     return out
 
 
+def measure_fusedstep(cfg, n_clients: int = 8, batch: int = 64,
+                      n_batches: int = 8, epochs: int = 3):
+    """Fused train-step + measured autotuner (ISSUE 20; DESIGN.md §24).
+
+    Two row families:
+
+      * fused vs unfused sec/round-body: the SAME `make_local_train_all`
+        Adam round body (vmap over clients, scan over batches, while_loop
+        epochs) timed with train_fusion off / xla / interpret, plus each
+        program's XLA-reported operand bytes (cost_analysis);
+      * tuned vs pow2 at the four migrated call sites: pallas block_rows,
+        the serving bucket ladder at the 1024 serving default, the tiered
+        init chunk, and the int8 quantize block inside plan_merge — every
+        row carries the full measured candidate table (tune/measure.py
+        discipline: warm call, min over repeats), and the winners persist
+        in TUNE_CACHE.json (the bench runs with FEDMSE_TUNE=1).
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from fedmse_tpu.federation.local_training import make_local_train_all
+    from fedmse_tpu.models import init_stacked_params, make_model
+    from fedmse_tpu.parallel import client_mesh
+    from fedmse_tpu.parallel.costmodel import plan_merge
+    from fedmse_tpu.tune import sites
+    from fedmse_tpu.tune.measure import best_wall
+
+    dim = cfg.dim_features
+    out = {"n_clients": n_clients, "batch": batch, "n_batches": n_batches,
+           "epochs": epochs, "dim": dim}
+
+    # --- fused vs unfused round body -------------------------------------
+    model = make_model("hybrid", dim, shrink_lambda=cfg.shrink_lambda)
+    params = init_stacked_params(model, jax.random.key(0), n_clients)
+    tx = optax_adam(cfg.lr_rate)
+    opt = jax.vmap(tx.init)(params)
+    rng = np.random.default_rng(0)
+    txb = jnp.asarray(rng.normal(size=(n_clients, n_batches, batch, dim)),
+                      jnp.float32)
+    tmb = jnp.ones((n_clients, n_batches, batch), jnp.float32)
+    vxb = jnp.asarray(rng.normal(size=(n_clients, 2, batch, dim)),
+                      jnp.float32)
+    vmb = jnp.ones((n_clients, 2, batch), jnp.float32)
+    sel = jnp.ones((n_clients,), jnp.float32)
+    args = (params, opt, params, sel, txb, tmb, vxb, vmb)
+
+    rows = {}
+    for mode in ("off", "xla", "interpret"):
+        train = make_local_train_all(model, tx, epochs, cfg.patience,
+                                     fedprox=False, mu=0.0, donate=False,
+                                     train_fusion=mode)
+        row = {"sec_per_round_body": best_wall(lambda: train(*args)[0],
+                                               repeats=3)}
+        try:  # operand traffic of the compiled program (CPU reports it)
+            cost = train.lower(*args).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            row["operand_bytes"] = float(cost.get("bytes accessed", 0.0))
+            row["flops"] = float(cost.get("flops", 0.0))
+        except Exception as exc:  # noqa: BLE001 — metric is best-effort
+            row["operand_bytes_error"] = str(exc)
+        rows[mode] = row
+    out["train_step"] = rows
+    out["fused_xla_speedup_vs_unfused"] = (
+        rows["off"]["sec_per_round_body"] / rows["xla"]["sec_per_round_body"])
+
+    # --- the four tuned sites, each vs its pow2 default ------------------
+    site_speedups = {}
+
+    br = sites.tune_block_rows(repeats=3)
+    site_speedups["block_rows"] = (
+        br["pow2_default_wall_s"] / br["wall_s"]
+        if br.get("pow2_default_wall_s") else None)
+    out["site_block_rows"] = {
+        "choice": br["choice"], "wall_s": br["wall_s"],
+        "pow2_default": 4096, "pow2_wall_s": br["pow2_default_wall_s"],
+        "speedup_vs_pow2": site_speedups["block_rows"],
+        "candidates": br["candidates"]}
+
+    lad = sites.tune_serve_ladder(max_bucket=1024, dim=dim, repeats=3)
+    scored = lad["expected_wall_s"]
+    site_speedups["serve_ladder"] = scored["pow2"] / min(scored.values())
+    out["site_serve_ladder"] = {
+        "choice": lad["ladder_name"], "ladder": lad["choice"],
+        "expected_wall_s": scored,
+        "speedup_vs_pow2": site_speedups["serve_ladder"],
+        "rung_walls": lad["rung_walls"]}
+
+    tc = sites.tune_tier_chunk(repeats=2)
+    site_speedups["tier_chunk"] = (
+        tc["pow2_default_wall_s"] / tc["wall_s"]
+        if tc.get("pow2_default_wall_s") else None)
+    out["site_tier_chunk"] = {
+        "choice": tc["choice"], "wall_s": tc["wall_s"],
+        "pow2_default": 4096, "pow2_wall_s": tc["pow2_default_wall_s"],
+        "speedup_vs_pow2": site_speedups["tier_chunk"],
+        "candidates": tc["candidates"]}
+
+    mesh = client_mesh()
+    elem_counts = [int(np.prod(l.shape[1:]))
+                   for l in jax.tree.leaves(params)]
+    plan = plan_merge(mesh, elem_counts, k=8)
+    quant = [c for c in plan["candidates"] if c["backend"] == "quantized"]
+    pow2_blocks = [c for c in quant if c["block_size"] in (128, 256, 512)]
+    if quant and pow2_blocks:
+        tuned_best = min(quant, key=lambda c: c["score_s"])
+        pow2_best = min(pow2_blocks, key=lambda c: c["score_s"])
+        site_speedups["quant_block"] = (
+            pow2_best["score_s"] / tuned_best["score_s"])
+        out["site_quant_block"] = {
+            "choice": tuned_best["block_size"],
+            "score_s": tuned_best["score_s"],
+            "pow2_best_block": pow2_best["block_size"],
+            "pow2_score_s": pow2_best["score_s"],
+            "speedup_vs_pow2": site_speedups["quant_block"],
+            "chosen_plan": plan["chosen"], "cached": plan["cached"],
+            "candidates": plan["candidates"]}
+    else:  # no quantized candidate on this topology — log, never hide
+        site_speedups["quant_block"] = None
+        out["site_quant_block"] = {"skipped": "no quantized candidates",
+                                   "candidates": plan["candidates"]}
+
+    real = {k: v for k, v in site_speedups.items() if v is not None}
+    out["site_speedups_vs_pow2"] = site_speedups
+    out["best_site_speedup"] = max(real.values()) if real else None
+    out["acceptance"] = {
+        "tuned_beats_or_matches_pow2_everywhere": all(
+            v >= 0.97 for v in real.values()),  # 3% timer-noise floor
+        "hot_path_speedup_ge_1_15x": any(v >= 1.15 for v in real.values()),
+    }
+    return out
+
+
 def measure_knn(cfg, quality_clients: int = 500,
                 bank_sizes=(128, 256, 512, 1024, 2048, 4096),
                 serve_bucket: int = 1024, quality_rounds: int = 2,
@@ -1547,7 +1681,9 @@ def main():
     cohort_bench = "--cohort-bench" in sys.argv
     podscale_bench = "--podscale-bench" in sys.argv
     clustermerge_bench = "--clustermerge-bench" in sys.argv
-    if shard_bench or cohort_bench or podscale_bench or clustermerge_bench:
+    fusedstep_bench = "--fusedstep-bench" in sys.argv
+    if (shard_bench or cohort_bench or podscale_bench or clustermerge_bench
+            or fusedstep_bench):
         # hermetic CPU + 8 virtual devices, pinned BEFORE any jax import
         # (like the tests and serve-bench): the shard and cohort benches
         # are memory-layout/scale measurements, never TPU-tunnel ones
@@ -1698,6 +1834,34 @@ def main():
         print(line)
         dest = _flag("--out",
                      f"BENCH_CLUSTERMERGE_r19_{device.platform}.json")
+        with open(dest, "w") as f:
+            f.write(line + "\n")
+        return
+
+    if fusedstep_bench:
+        # fused train-step + measured autotuner (ISSUE 20): fused-vs-
+        # unfused round-body sec + operand bytes, and tuned-vs-pow2 at the
+        # four migrated launch-size sites. Winners persist in the committed
+        # TUNE_CACHE.json (FEDMSE_TUNE=1 below is what un-gates the
+        # writes). One JSON line, BENCH_FUSEDSTEP_r20_<platform>.json.
+        os.environ["FEDMSE_TUNE"] = "1"
+        device = jax.devices()[0]
+        out = {
+            "metric": "fused AE train-step (hand-derived backward, one "
+                      "pass) vs flax autodiff round body; measured "
+                      "autotuner vs pow2 at 4 launch-size sites",
+            "value": None,  # filled from the best tuned-site speedup below
+            "unit": "x (best tuned-vs-pow2 site speedup, min-over-k walls)",
+            "device": str(device),
+            "platform": device.platform,
+            "mode": "fused train step + tuning cache (DESIGN.md §24)",
+        }
+        out.update(measure_fusedstep(cfg))
+        out["value"] = out["best_site_speedup"]
+        out.update(capture_provenance())
+        line = json.dumps(out)
+        print(line)
+        dest = _flag("--out", f"BENCH_FUSEDSTEP_r20_{device.platform}.json")
         with open(dest, "w") as f:
             f.write(line + "\n")
         return

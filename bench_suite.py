@@ -577,6 +577,45 @@ def scen_clustermerge():
                         "cluster rows, measured merge plan", **row}
 
 
+def scen_fusedstep():
+    """Scenario 22: fused train step + measured autotuner (ISSUE 20,
+    ops/pallas_ae.py train path + fedmse_tpu/tune/, DESIGN.md §24).
+    Shelled out to `bench.py --fusedstep-bench` (hermetic CPU platform
+    pinned before jax initializes); the tuning cache is redirected to a
+    throwaway path so a noisy suite run never rewrites the COMMITTED
+    TUNE_CACHE.json winners (`make fusedstep-bench` is the committed
+    protocol — BENCH_FUSEDSTEP_r20_cpu.json)."""
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        tmp = f.name
+    scratch_cache = tmp + ".tune"
+    env = {**os.environ, "FEDMSE_TUNE_CACHE": scratch_cache}
+    try:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO_ROOT, "bench.py"),
+                 "--fusedstep-bench", "--out", tmp],
+                capture_output=True, text=True, timeout=1800, env=env)
+        except subprocess.TimeoutExpired:
+            return {"scenario": "fused train step + autotuner",
+                    "error": "bench.py --fusedstep-bench exceeded 1800 s"}
+        if proc.returncode != 0:
+            return {"scenario": "fused train step + autotuner",
+                    "error": proc.stdout[-500:] + proc.stderr[-500:]}
+        with open(tmp) as f:
+            row = json.load(f)
+    finally:
+        os.unlink(tmp)
+        if os.path.exists(scratch_cache):
+            os.unlink(scratch_cache)
+    row.pop("metric", None)
+    return {"scenario": "fused AE train step (hand-derived backward) vs "
+                        "autodiff round body; tuned vs pow2 at 4 "
+                        "launch-size sites", **row}
+
+
 def scen_pipeline(cfg, dataset):
     """Scenario 8: the dispatch pipeline (federation/pipeline.py) — the
     chunked driver loop with chunk k+1's scan enqueued before chunk k's
@@ -599,9 +638,9 @@ def main():
         try:
             only = int(sys.argv[idx])
         except (IndexError, ValueError):
-            sys.exit("--only expects a scenario number 1-21")
-        if not 1 <= only <= 21:
-            sys.exit(f"--only expects a scenario number 1-21, got {only}")
+            sys.exit("--only expects a scenario number 1-22")
+        if not 1 <= only <= 22:
+            sys.exit(f"--only expects a scenario number 1-22, got {only}")
 
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -713,6 +752,9 @@ def main():
 
     if only in (None, 21):
         emit(scen_clustermerge())
+
+    if only in (None, 22):
+        emit(scen_fusedstep())
 
     device = jax.devices()[0]
     out = {"device": str(device), "platform": device.platform,

@@ -284,6 +284,15 @@ class ExperimentConfig:
     # fused single-kernel forward for evaluation: 'off' | 'auto' | 'pallas' |
     # 'xla' ('auto' = pallas on TPU, XLA-fused elsewhere; ops/pallas_ae.py)
     fused_eval: str = "off"
+    # fused single-kernel TRAIN step (forward + loss + hand-derived backward
+    # in one VMEM-resident pass; ops/pallas_ae.py, DESIGN.md §24): 'off' |
+    # 'auto' | 'pallas' | 'interpret' | 'xla'. 'off' (default) keeps the
+    # flax-autodiff batch loss bit-for-bit; 'xla' is the CPU bit-parity
+    # mode (identical math, no pallas — grads pinned to the autodiff body,
+    # PARITY.md); 'interpret' pins the Pallas lowering off-TPU; 'auto' =
+    # pallas on TPU, xla elsewhere. The Adam update is unchanged in every
+    # mode — only value_and_grad's backward is swapped (custom_vjp).
+    train_fusion: str = "off"
     # Anomaly-score selection, ORTHOGONAL to model_type (fedmse_tpu/knn/,
     # DESIGN.md §13): 'auto' keeps the reference pairing (autoencoder ->
     # AE-MSE reconstruction error, hybrid -> centroid density); 'mse' /

@@ -66,15 +66,35 @@ class LocalTrainResult(NamedTuple):
 
 def make_local_train_one(model, tx: optax.GradientTransformation,
                          epochs: int, patience: int, fedprox: bool,
-                         mu: float) -> Callable:
-    """Build the single-client local-training function (to be vmapped)."""
+                         mu: float, train_fusion: str = "off") -> Callable:
+    """Build the single-client local-training function (to be vmapped).
 
-    def batch_loss(params, prev_global, x, m):
-        latent, recon = model.apply({"params": params}, x)
-        loss = model.loss(x, latent, recon, m)
-        if fedprox:
-            loss = loss + mu * prox_term(params, prev_global)
-        return loss
+    train_fusion (cfg.train_fusion; DESIGN.md §24): 'off' keeps the flax
+    apply + autodiff batch loss. Any other mode ('auto'|'pallas'|
+    'interpret'|'xla') swaps in ops/pallas_ae.make_fused_train_loss — the
+    same loss with a custom VJP whose backward is the hand-derived fused
+    train kernel, so `value_and_grad` below returns the kernel's grads
+    through the UNCHANGED Adam update. The fedprox μ-prox term stays
+    autodiff in both branches (gradients sum); the early-stop validation
+    scans reuse batch_loss too, where the custom-vjp primal runs the cheap
+    packed forward only."""
+
+    if train_fusion != "off":
+        from fedmse_tpu.ops.pallas_ae import make_fused_train_loss
+        fused_loss = make_fused_train_loss(model, mode=train_fusion)
+
+        def batch_loss(params, prev_global, x, m):
+            loss = fused_loss(params, x, m)
+            if fedprox:
+                loss = loss + mu * prox_term(params, prev_global)
+            return loss
+    else:
+        def batch_loss(params, prev_global, x, m):
+            latent, recon = model.apply({"params": params}, x)
+            loss = model.loss(x, latent, recon, m)
+            if fedprox:
+                loss = loss + mu * prox_term(params, prev_global)
+            return loss
 
     grad_fn = jax.value_and_grad(batch_loss)
 
@@ -147,7 +167,8 @@ def make_local_train_one(model, tx: optax.GradientTransformation,
 
 def make_local_train_all(model, tx: optax.GradientTransformation,
                          epochs: int, patience: int, fedprox: bool, mu: float,
-                         donate: bool = True, restore_best: bool = False) -> Callable:
+                         donate: bool = True, restore_best: bool = False,
+                         train_fusion: str = "off") -> Callable:
     """Jitted, vmapped training of all clients with a selection mask.
 
     Returns fn(states_params, states_opt, prev_global, sel_mask, data,
@@ -169,7 +190,8 @@ def make_local_train_all(model, tx: optax.GradientTransformation,
         backends (the 1-core CPU fallback), and what keeps the 20%-
         participation 50-client scenario from training 5x too much work.
     """
-    train_one = make_local_train_one(model, tx, epochs, patience, fedprox, mu)
+    train_one = make_local_train_one(model, tx, epochs, patience, fedprox, mu,
+                                     train_fusion=train_fusion)
     train_vmapped = jax.vmap(train_one)
 
     @partial(jax.jit, donate_argnums=(0, 1) if donate else ())

@@ -126,8 +126,8 @@ def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
            cfg.verification_threshold, cfg.performance_threshold,
            cfg.hardened_verification, cfg.recovery_budget,
            cfg.flatten_optimizer,
-           model_type, cfg.metric, cfg.fused_eval, cfg.score_kind,
-           cfg.knn_bank_size, cfg.knn_k, cfg.knn_topk)
+           model_type, cfg.metric, cfg.fused_eval, cfg.train_fusion,
+           cfg.score_kind, cfg.knn_bank_size, cfg.knn_k, cfg.knn_topk)
     hit = _PROGRAM_CACHE.get(key)
     if hit is not None:
         return hit
@@ -141,7 +141,8 @@ def _engine_programs(model, cfg: ExperimentConfig, model_type: str,
         "train_all": make_local_train_all(
             model, tx, epochs=cfg.epochs, patience=cfg.patience,
             fedprox=(update_type == "fedprox"), mu=cfg.fedprox_mu,
-            restore_best=not cfg.compat.no_best_restore),
+            restore_best=not cfg.compat.no_best_restore,
+            train_fusion=cfg.train_fusion),
         "scores_fn": make_mse_scores_fn(
             model, restandardize=cfg.compat.restandardize_vote_data,
             tie_break=cfg.compat.vote_tie_break),

@@ -141,7 +141,7 @@ class TieredRoundEngine:
     def __init__(self, model, cfg: ExperimentConfig, data: FederatedData,
                  n_real: int, rngs: ExperimentRngs, model_type: str,
                  update_type: str, poison_fn=None, chaos=None, elastic=None,
-                 mesh=None, init_chunk: int = 4096, cluster=None,
+                 mesh=None, init_chunk=None, cluster=None,
                  host_sharded: bool = False, local_data: bool = False,
                  redteam=None):
         if cfg.metric == "time":
@@ -168,6 +168,17 @@ class TieredRoundEngine:
         self.mesh = mesh
         self._warned_backend_off = False  # log the einsum fallback once
         self._merge_plan = None           # measured plan (backend='auto')
+        if init_chunk is None:
+            # measured tier-init chunk (fedmse_tpu/tune, DESIGN.md §24):
+            # a signature-matched cache entry for this backend wins, else
+            # the historical 4096. Explicit init_chunk= is used verbatim.
+            try:
+                from fedmse_tpu.tune import sites
+                init_chunk = sites.lookup_tier_chunk() or 4096
+            except Exception:
+                init_chunk = 4096
+        self.init_chunk = int(init_chunk)
+        init_chunk = self.init_chunk
 
         programs = _engine_programs(model, cfg, model_type, update_type)
         self.tx = programs["tx"]

@@ -51,6 +51,22 @@ def norm_to_origin(x: jax.Array) -> jax.Array:
     return jnp.linalg.norm(x, axis=-1)
 
 
+def row_norms_packed(x: jax.Array) -> jax.Array:
+    """Row L2 norms with keepdims — `sqrt(sum(square))`, the ONE formula
+    shared by the fused AE kernel and its XLA twin (ops/pallas_ae.py).
+
+    The kernel used to spell this `sqrt(sum(square))` while the XLA
+    fallback used `jnp.linalg.norm`; on real floats the two are bitwise
+    identical (|x|² == x² clears only the sign bit before the multiply),
+    but two spellings of one score surface is how parity pins rot. Kept
+    as the raw sqrt form because it must lower inside a Pallas kernel
+    (Mosaic has no linalg); no dtype cast here — the fused kernel feeds
+    an f32 accumulator and MUST stay cast-free for bf16 tiles, callers
+    owning the f32 contract cast before calling (ops/pallas_ae.py does:
+    its z is already the f32 dot accumulator)."""
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+
+
 def pairwise_sq_dists(q: jax.Array, b: jax.Array) -> jax.Array:
     """All-pairs squared Euclidean distances [Q, L] x [B, L] -> [Q, B].
 

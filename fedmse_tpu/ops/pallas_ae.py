@@ -31,6 +31,26 @@ input/weight tiles — halving the dominant per-grid-step HBM bytes — while
 every dot accumulates f32 on the MXU and the packed output stays f32
 (MSE/latent norm are anomaly scores). The f32 default is bit-identical to
 the pre-policy kernel.
+
+Fused TRAIN step (DESIGN.md §24): `_train_kernel` extends the forward
+pass with the hand-derived backward of the actual training loss
+(ops/losses.py mse_loss / shrink_loss with the safe-norm guard) in the
+SAME VMEM-resident pass per row block — 4 forward + 7 backward matmuls
+over [128, 128] tiles, ~12 tile-sized intermediates, well under 1 MiB of
+VMEM at block_rows=512 rows. Per-layer gradient tiles accumulate across
+row blocks in revisited f32 output blocks; every cotangent dot takes
+`preferred_element_type=f32` (the f32-accum contract held through the
+backward). `fused_train_grads` is the raw (loss, grads) entry;
+`make_fused_train_loss` wraps it in a `jax.custom_vjp` so the round
+engine's unchanged `jax.value_and_grad` + Adam update consumes it
+(federation/local_training.py, cfg.train_fusion). The gradient math is
+normalized OUTSIDE the kernel: with M = Σ mask, every grad term carries a
+common 1/M factor and the kernel emits Σ-style partials (grads·M, raw
+loss sums), so no traced scalar ever enters the kernel.
+
+Block sizing: `block_rows=None` resolves through the measured tuning
+cache (fedmse_tpu/tune, site 'pallas_block_rows') and falls back to the
+v5e-swept BLOCK_ROWS constant — pow2 is the default, not the decision.
 """
 
 from __future__ import annotations
@@ -45,6 +65,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fedmse_tpu.ops import losses
+from fedmse_tpu.ops.distance import row_norms_packed
+
 LANE = 128
 # Block size chosen by an on-hardware sweep (v5e, TPU_CHECK.json): at the
 # 10-client eval volume (40k rows) per-pass on-chip time was 129/94/78/69/64 us
@@ -53,6 +76,24 @@ LANE = 128
 # 4k rows: 15.2 vs 19.1 us). Fewer grid steps amortize the weight-load and
 # per-step overhead; 4096x128 f32 in+out tiles are ~4 MiB, well under VMEM.
 BLOCK_ROWS = 4096
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def default_block_rows() -> int:
+    """Resolve `block_rows=None`: the measured tuning cache's winner for
+    site 'pallas_block_rows' when a signature-matched entry exists for this
+    backend, else the v5e-swept BLOCK_ROWS constant. Imported lazily —
+    fedmse_tpu/tune measures THIS module's kernel, so the static dependency
+    points tune -> ops and this hook must not invert it at import time."""
+    try:
+        from fedmse_tpu.tune import sites
+        tuned = sites.lookup_block_rows()
+    except Exception:
+        tuned = None
+    return int(tuned) if tuned else BLOCK_ROWS
 
 
 def _pad2(w: jax.Array, rows: int = LANE, cols: int = LANE) -> jax.Array:
@@ -107,7 +148,7 @@ def _kernel(dim, latent_dim, x_ref, w1_ref, b1_ref, w2_ref, b2_ref,
 
     err = jnp.square(x.astype(jnp.float32) - recon)  # padded cols are 0 - 0
     mse = jnp.sum(err, axis=1, keepdims=True) / dim
-    znorm = jnp.sqrt(jnp.sum(jnp.square(z), axis=1, keepdims=True))
+    znorm = row_norms_packed(z)  # ops/distance.py — ONE spelling, both paths
 
     col = jax.lax.broadcasted_iota(jnp.int32, z.shape, 1)
     packed = jnp.where(col < latent_dim, z, 0.0)
@@ -156,7 +197,7 @@ def _fused_xla(x_pad: jax.Array, mats: Tuple[jax.Array, ...],
     recon = dot(h2, w4) + b4
     mse = jnp.sum(jnp.square(x_pad.astype(jnp.float32) - recon),
                   axis=1, keepdims=True) / dim
-    znorm = jnp.linalg.norm(z, axis=1, keepdims=True)
+    znorm = row_norms_packed(z)  # same helper as `_kernel`: parity by shared code
     col = jax.lax.broadcasted_iota(jnp.int32, z.shape, 1)
     packed = jnp.where(col < latent_dim, z, 0.0)
     packed = jnp.where(col == latent_dim, mse, packed)
@@ -166,12 +207,13 @@ def _fused_xla(x_pad: jax.Array, mats: Tuple[jax.Array, ...],
 
 def fused_forward_stats(params: Dict[str, Any], x: jax.Array,
                         latent_dim: int = 7, mode: str = "auto",
-                        block_rows: int = BLOCK_ROWS,
+                        block_rows: int | None = None,
                         compute_dtype: Any = jnp.float32
                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(latent [R, L], per_row_mse [R], latent_norm [R]) in one fused pass.
 
     mode: 'pallas' | 'xla' | 'interpret' | 'auto' (pallas on TPU, else XLA).
+    block_rows: None resolves through the tuning cache (`default_block_rows`).
 
     compute_dtype (ops/precision.py): the input/weight TILE dtype. bf16
     halves the per-grid-step HBM bytes of the x tile and the replicated
@@ -196,6 +238,13 @@ def fused_forward_stats(params: Dict[str, Any], x: jax.Array,
             f"fused AE kernel packs features, hidden units and (latent, mse, "
             f"znorm) into {LANE} lanes; got dim={dim}, hidden={hidden}, "
             f"latent_dim={latent_dim}")
+    if rows == 0:
+        # 0-row edge, pinned equal across every mode without tracing a
+        # zero-block grid (the clamp below would ask for a (0,) grid).
+        empty = jnp.zeros((0,), jnp.float32)
+        return jnp.zeros((0, latent_dim), jnp.float32), empty, empty
+    if block_rows is None:
+        block_rows = default_block_rows()
     # Clamp the block to the input: tiny calls (per-client train splits,
     # ~700 rows) should not pad-and-compute a full 4096-row block. Rows is
     # static under jit, so this costs nothing; waste is bounded at 511 rows.
@@ -222,3 +271,329 @@ def fused_forward_stats(params: Dict[str, Any], x: jax.Array,
     mse = packed[:rows, latent_dim]
     znorm = packed[:rows, latent_dim + 1]
     return latent, mse, znorm
+
+
+# ---------------------------------------------------------------------------
+# Fused TRAIN step: forward + per-row loss + hand-derived backward
+# ---------------------------------------------------------------------------
+#
+# The differentiated loss is EXACTLY federation/local_training.py's
+# batch_loss body (minus fedprox, which stays autodiff outside — it sums):
+#
+#   L = masked_mean(per_sample_mse(x, recon), m)
+#     + λ · masked_mean(safe_norm(z), m)            (λ = 0 for plain AE)
+#
+# with M = Σm and the losses.py 1e-38 safe-div. Every gradient term
+# carries a common 1/M factor, so the kernel emits UN-normalized partials
+# (Σ-style sums over its row block, accumulated across grid steps) and the
+# host applies inv_m once — no traced scalar enters the kernel. Writing
+# r = recon, per-row-block derivation (padded lanes stay exactly 0 through
+# the whole chain because padded weight rows/cols are 0):
+#
+#   ∂L̃/∂r       = −(2/D)·m·(x − r)                       (L̃ = L·M)
+#   ∂L̃/∂b4      = Σ_rows ∂L̃/∂r       ∂L̃/∂W4 = h2ᵀ·∂L̃/∂r
+#   ∂L̃/∂h2      = ∂L̃/∂r·W4ᵀ, gated by (h2 > 0)           (relu' = 0 at 0,
+#                                                  jax.nn.relu's convention)
+#   ∂L̃/∂z       = ∂L̃/∂a3·W3ᵀ + λ·m·z·[sq > 0]/‖z‖         (safe-norm grad:
+#                                                    exactly 0 at z = 0)
+#   ...and the mirror-image chain through W2/b2, relu, W1/b1.
+#
+# 4 forward + 7 backward matmuls (dh2, dW4, dW3, dz, dW2, dh1, dW1 — dot
+# generals contracting rows/lanes in place of explicit transposes), all on
+# [block_rows, 128] / [128, 128] tiles with f32 accumulation
+# (`preferred_element_type`), cotangents cast to the tile dtype before
+# each MXU dot (bf16 recipe; identity at f32). Gradient outputs live in
+# revisited f32 VMEM blocks: grid step 0 writes, later steps add.
+
+
+def _train_kernel(dim, latent_dim, lam, x_ref, m_ref, w1_ref, b1_ref,
+                  w2_ref, b2_ref, w3_ref, b3_ref, w4_ref, b4_ref,
+                  dw1_ref, dw2_ref, dw3_ref, dw4_ref, db_ref):
+    f32 = jnp.float32
+    x = x_ref[:]
+    cdt = x.dtype
+    m = m_ref[:]                     # [bR, 128] f32: row mask on every lane
+    w1, w2, w3, w4 = w1_ref[:], w2_ref[:], w3_ref[:], w4_ref[:]
+    # aᵀ @ b (contract rows) / a @ bᵀ (contract lanes) without explicit
+    # transposes — dot_general keeps both operands in their VMEM layout.
+    dotT_ab = lambda a, b: jax.lax.dot_general(  # noqa: E731
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=f32)
+    dot_abT = lambda a, b: jax.lax.dot_general(  # noqa: E731
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=f32)
+
+    # -- forward: identical math to `_kernel` -------------------------------
+    h1 = jnp.maximum(
+        jnp.dot(x, w1, preferred_element_type=f32) + b1_ref[:],
+        0.0).astype(cdt)
+    z = jnp.dot(h1, w2, preferred_element_type=f32) + b2_ref[:]
+    zc = z.astype(cdt)
+    h2 = jnp.maximum(
+        jnp.dot(zc, w3, preferred_element_type=f32) + b3_ref[:],
+        0.0).astype(cdt)
+    recon = jnp.dot(h2, w4, preferred_element_type=f32) + b4_ref[:]
+
+    err = x.astype(f32) - recon                  # padded cols: 0 - 0
+    s_mse = jnp.sum(m * jnp.square(err))         # Σ_i m_i Σ_j err²  (·1/D·M out)
+    sq = jnp.sum(jnp.square(z), axis=1, keepdims=True)
+    nz = (sq > 0).astype(f32)
+    zn = jnp.sqrt(jnp.where(sq > 0, sq, 1.0)) * nz   # losses.py safe norm
+    colm = jax.lax.broadcasted_iota(jnp.int32, m.shape, 1)
+    s_zn = jnp.sum(jnp.where(colm == 0, m * zn, 0.0))
+
+    # -- backward -----------------------------------------------------------
+    dr = (-2.0 / dim) * (m * err)                # ∂L̃/∂recon, f32
+    drc = dr.astype(cdt)
+    db4 = jnp.sum(dr, axis=0, keepdims=True)
+    dw4 = dotT_ab(h2, drc)
+    da3 = jnp.where(h2 > 0, dot_abT(drc, w4), 0.0)
+    da3c = da3.astype(cdt)
+    db3 = jnp.sum(da3, axis=0, keepdims=True)
+    dw3 = dotT_ab(zc, da3c)
+    inv = nz / jnp.where(sq > 0, zn, 1.0)        # safe 1/‖z‖, 0 at z = 0
+    dz = dot_abT(da3c, w3) + lam * m * z * inv
+    dzc = dz.astype(cdt)
+    db2 = jnp.sum(dz, axis=0, keepdims=True)
+    dw2 = dotT_ab(h1, dzc)
+    da1 = jnp.where(h1 > 0, dot_abT(dzc, w2), 0.0)
+    da1c = da1.astype(cdt)
+    db1 = jnp.sum(da1, axis=0, keepdims=True)
+    dw1 = dotT_ab(x, da1c)
+
+    # Pack the four bias grads + the two loss sums into one [8, 128] f32
+    # tile (the f32 minimum tile): rows 0-3 = db1..db4, row 4 col 0/1 =
+    # s_mse/s_zn, rows 5-7 = 0.
+    row8 = jax.lax.broadcasted_iota(jnp.int32, (8, LANE), 0)
+    col8 = jax.lax.broadcasted_iota(jnp.int32, (8, LANE), 1)
+    db = jnp.where(row8 == 0, jnp.broadcast_to(db1, (8, LANE)), 0.0)
+    db = jnp.where(row8 == 1, jnp.broadcast_to(db2, (8, LANE)), db)
+    db = jnp.where(row8 == 2, jnp.broadcast_to(db3, (8, LANE)), db)
+    db = jnp.where(row8 == 3, jnp.broadcast_to(db4, (8, LANE)), db)
+    sums = jnp.where(col8 == 0, s_mse, jnp.where(col8 == 1, s_zn, 0.0))
+    db = jnp.where(row8 == 4, sums, db)
+
+    # Output blocks map every grid step to block (0, 0): step 0 initializes,
+    # later steps accumulate in VMEM (grads are sums over row blocks).
+    @pl.when(pl.program_id(0) == 0)
+    def _first():
+        dw1_ref[:] = dw1
+        dw2_ref[:] = dw2
+        dw3_ref[:] = dw3
+        dw4_ref[:] = dw4
+        db_ref[:] = db
+
+    @pl.when(pl.program_id(0) > 0)
+    def _accum():
+        dw1_ref[:] += dw1
+        dw2_ref[:] += dw2
+        dw3_ref[:] += dw3
+        dw4_ref[:] += dw4
+        db_ref[:] += db
+
+
+@functools.partial(jax.jit, static_argnames=("dim", "latent_dim", "lam",
+                                             "interpret", "block_rows"))
+def _fused_train_pallas(x_pad: jax.Array, m_pad: jax.Array,
+                        mats: Tuple[jax.Array, ...], dim: int,
+                        latent_dim: int, lam: float, interpret: bool,
+                        block_rows: int) -> Tuple[jax.Array, ...]:
+    rows = x_pad.shape[0]
+    grid = (pl.cdiv(rows, block_rows),)
+    full = lambda: pl.BlockSpec((LANE, LANE), lambda i: (0, 0),  # noqa: E731
+                                memory_space=pltpu.VMEM)
+    bias = lambda: pl.BlockSpec((1, LANE), lambda i: (0, 0),  # noqa: E731
+                                memory_space=pltpu.VMEM)
+    rowb = lambda: pl.BlockSpec((block_rows, LANE), lambda i: (i, 0),  # noqa: E731
+                                memory_space=pltpu.VMEM)
+    acc = lambda r: pl.BlockSpec((r, LANE), lambda i: (0, 0),  # noqa: E731
+                                 memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_train_kernel, float(dim), latent_dim, float(lam)),
+        grid=grid,
+        in_specs=[rowb(), rowb(),
+                  full(), bias(), full(), bias(), full(), bias(),
+                  full(), bias()],
+        out_specs=[acc(LANE)] * 4 + [acc(8)],
+        out_shape=[jax.ShapeDtypeStruct((LANE, LANE), jnp.float32)] * 4
+        + [jax.ShapeDtypeStruct((8, LANE), jnp.float32)],
+        interpret=interpret,
+    )(x_pad, m_pad, *mats)
+
+
+def _fused_train_xla(x_pad: jax.Array, m_pad: jax.Array,
+                     mats: Tuple[jax.Array, ...], dim: int, latent_dim: int,
+                     lam: float):
+    """Identical train-step math without pallas (the bit-parity mode on
+    non-TPU backends): same padded tiles, same dot_general contractions
+    with f32 accumulation, same inter-layer casts, same safe-norm guards.
+    Returns (s_mse, s_zn, (dw1..dw4), (db1..db4)) un-normalized."""
+    w1, b1, w2, b2, w3, b3, w4, b4 = mats
+    f32 = jnp.float32
+    cdt = x_pad.dtype
+    m = m_pad
+    dot = lambda a, b: jnp.dot(a, b, preferred_element_type=f32)  # noqa: E731
+    dotT_ab = lambda a, b: jax.lax.dot_general(  # noqa: E731
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=f32)
+    dot_abT = lambda a, b: jax.lax.dot_general(  # noqa: E731
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=f32)
+
+    h1 = jnp.maximum(dot(x_pad, w1) + b1, 0.0).astype(cdt)
+    z = dot(h1, w2) + b2
+    zc = z.astype(cdt)
+    h2 = jnp.maximum(dot(zc, w3) + b3, 0.0).astype(cdt)
+    recon = dot(h2, w4) + b4
+
+    err = x_pad.astype(f32) - recon
+    s_mse = jnp.sum(m * jnp.square(err))
+    sq = jnp.sum(jnp.square(z), axis=1, keepdims=True)
+    nz = (sq > 0).astype(f32)
+    zn = jnp.sqrt(jnp.where(sq > 0, sq, 1.0)) * nz
+    s_zn = jnp.sum(m[:, :1] * zn)
+
+    dr = (-2.0 / dim) * (m * err)
+    drc = dr.astype(cdt)
+    db4 = jnp.sum(dr, axis=0)
+    dw4 = dotT_ab(h2, drc)
+    da3 = jnp.where(h2 > 0, dot_abT(drc, w4), 0.0)
+    da3c = da3.astype(cdt)
+    db3 = jnp.sum(da3, axis=0)
+    dw3 = dotT_ab(zc, da3c)
+    inv = nz / jnp.where(sq > 0, zn, 1.0)
+    dz = dot_abT(da3c, w3) + lam * m * z * inv
+    dzc = dz.astype(cdt)
+    db2 = jnp.sum(dz, axis=0)
+    dw2 = dotT_ab(h1, dzc)
+    da1 = jnp.where(h1 > 0, dot_abT(dzc, w2), 0.0)
+    da1c = da1.astype(cdt)
+    db1 = jnp.sum(da1, axis=0)
+    dw1 = dotT_ab(x_pad, da1c)
+    return s_mse, s_zn, (dw1, dw2, dw3, dw4), (db1, db2, db3, db4)
+
+
+def fused_train_grads(params: Dict[str, Any], x: jax.Array,
+                      mask: jax.Array | None = None, *,
+                      shrink_lambda: float = 0.0,
+                      latent_dim: int | None = None, mode: str = "auto",
+                      compute_dtype: Any = jnp.float32,
+                      block_rows: int | None = None
+                      ) -> Tuple[jax.Array, Dict[str, Any]]:
+    """Training loss + per-leaf grads in ONE fused pass over row blocks.
+
+    loss = masked_mean(per_sample_mse) + shrink_lambda · masked_mean(‖z‖)
+    (ops/losses.py verbatim, incl. the 1e-38 safe-div and safe-norm);
+    grads matches `jax.grad` of the flax apply + loss to f32 tolerance
+    (pinned in tests/test_fusedstep.py). `mask` is the padded-batch row
+    mask (None = all rows real). mode as in `fused_forward_stats`;
+    block_rows=None resolves through the tuning cache. Returns the grads
+    with the SAME tree structure as `params` (dict or FrozenDict), leaves
+    f32 — what the optax Adam update expects."""
+    rows, dim = x.shape
+    hidden = params["encoder"]["Dense_0"]["kernel"].shape[1]
+    if latent_dim is None:
+        latent_dim = params["encoder"]["Dense_1"]["kernel"].shape[1]
+    if dim > LANE or hidden > LANE or latent_dim > LANE:
+        raise ValueError(
+            f"fused AE train kernel packs features/hidden/latent into {LANE} "
+            f"lanes; got dim={dim}, hidden={hidden}, latent_dim={latent_dim}")
+    if mode == "auto":
+        mode = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if mode not in ("pallas", "interpret", "xla"):
+        raise ValueError(f"unknown train-fusion mode {mode!r}; expected "
+                         "'pallas' | 'xla' | 'interpret' | 'auto'")
+    if mask is None:
+        mask = jnp.ones((rows,), jnp.float32)
+    mask = mask.astype(jnp.float32)
+    lam = float(shrink_lambda)
+    mats = pack_params(params, compute_dtype)
+
+    if mode == "xla" or rows == 0:
+        # No row padding needed (and the 0-row edge must not build a grid).
+        x_pad = jnp.zeros((rows, LANE), compute_dtype)
+        x_pad = x_pad.at[:, :dim].set(x.astype(compute_dtype))
+        m_pad = jnp.broadcast_to(mask[:, None], (rows, LANE))
+        s_mse, s_zn, dws, dbs = _fused_train_xla(
+            x_pad, m_pad, mats, dim, latent_dim, lam)
+    else:
+        block = block_rows if block_rows is not None else default_block_rows()
+        # Multiple-of-16 blocks keep bf16 tiles at/above the (16, 128)
+        # Mosaic minimum (f32 needs only (8, 128)); clamp to the input so
+        # a 12-row training batch runs one 16-row block, not 4096.
+        block = _round_up(max(16, min(int(block), _round_up(rows, 16))), 16)
+        rows_pad = _round_up(rows, block)
+        x_pad = jnp.zeros((rows_pad, LANE), compute_dtype)
+        x_pad = x_pad.at[:rows, :dim].set(x.astype(compute_dtype))
+        m_pad = jnp.zeros((rows_pad, LANE), jnp.float32)
+        m_pad = m_pad.at[:rows, :].set(
+            jnp.broadcast_to(mask[:, None], (rows, LANE)))
+        dw1, dw2, dw3, dw4, db = _fused_train_pallas(
+            x_pad, m_pad, mats, dim, latent_dim, lam,
+            mode == "interpret", block)
+        dws = (dw1, dw2, dw3, dw4)
+        dbs = (db[0], db[1], db[2], db[3])
+        s_mse, s_zn = db[4, 0], db[4, 1]
+
+    msum = jnp.sum(mask, dtype=jnp.float32)
+    inv_m = 1.0 / jnp.maximum(msum, 1e-38)       # losses.py _safe_div
+    loss = inv_m * (s_mse / dim + lam * s_zn)
+    g = lambda t: (inv_m * t).astype(jnp.float32)  # noqa: E731
+    dw1, dw2, dw3, dw4 = dws
+    db1, db2, db3, db4 = dbs
+    tree = {
+        "encoder": {
+            "Dense_0": {"kernel": g(dw1[:dim, :hidden]),
+                        "bias": g(db1[:hidden])},
+            "Dense_1": {"kernel": g(dw2[:hidden, :latent_dim]),
+                        "bias": g(db2[:latent_dim])},
+        },
+        "decoder": {
+            "Dense_0": {"kernel": g(dw3[:latent_dim, :hidden]),
+                        "bias": g(db3[:hidden])},
+            "Dense_1": {"kernel": g(dw4[:hidden, :dim]),
+                        "bias": g(db4[:dim])},
+        },
+    }
+    # Re-hang the leaves on params' own treedef (dict vs FrozenDict) so the
+    # optimizer sees an identical tree structure. Both flatten key-sorted.
+    grads = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params),
+        jax.tree_util.tree_leaves(tree))
+    return loss, grads
+
+
+def make_fused_train_loss(model: Any, mode: str = "auto",
+                          block_rows: int | None = None):
+    """(params, x, mask) -> scalar loss whose VJP IS the fused train kernel.
+
+    `jax.value_and_grad` of the returned function yields the hand-derived
+    per-leaf grads, so the round engine's unchanged Adam update consumes
+    the fusion (federation/local_training.py, cfg.train_fusion). The
+    PRIMAL — what runs when nobody asks for grads, i.e. the early-stop
+    validation scans — is the cheap packed forward (`fused_forward_stats`)
+    plus the losses.py masked means; the vjp fwd runs the full fused train
+    pass and stashes the grads as residuals. bwd scales them by the scalar
+    cotangent and returns zero cotangents for (x, mask): data is never
+    differentiated in this stack. fedprox's μ-prox term stays autodiff
+    OUTSIDE this function (gradients sum)."""
+    latent = int(model.latent_dim)
+    lam = float(getattr(model, "shrink_lambda", 0.0))
+    cdt = getattr(model, "compute_dtype", jnp.float32)
+    kw = dict(shrink_lambda=lam, latent_dim=latent, mode=mode,
+              compute_dtype=cdt, block_rows=block_rows)
+
+    @jax.custom_vjp
+    def fused_loss(params, x, m):
+        _, mse_rows, zn_rows = fused_forward_stats(
+            params, x, latent_dim=latent, mode=mode, block_rows=block_rows,
+            compute_dtype=cdt)
+        return (losses.masked_mean(mse_rows, m)
+                + lam * losses.masked_mean(zn_rows, m))
+
+    def fwd(params, x, m):
+        loss, grads = fused_train_grads(params, x, m, **kw)
+        return loss, (grads, jnp.zeros_like(x), jnp.zeros_like(m))
+
+    def bwd(res, ct):
+        grads, zx, zm = res
+        return (jax.tree_util.tree_map(lambda t: ct * t, grads), zx, zm)
+
+    fused_loss.defvjp(fwd, bwd)
+    return fused_loss

@@ -192,7 +192,7 @@ def _best_wall(fn, args, repeats: int) -> float:
 def plan_merge(mesh, elem_counts: Sequence[int], *, k: int = 1,
                axis_name: str = "clients", n_hosts: Optional[int] = None,
                group_counts: Optional[Sequence[int]] = None,
-               block_sizes: Sequence[int] = (128, 256, 512),
+               block_sizes: Optional[Sequence[int]] = None,
                dcn_gbps: float = 25.0, repeats: int = 3,
                max_group_candidates: int = 2) -> Dict[str, Any]:
     """Measured search over merge plans for payloads of these leaf shapes
@@ -205,6 +205,14 @@ def plan_merge(mesh, elem_counts: Sequence[int], *, k: int = 1,
     modeled cross-host bytes / dcn_gbps. Returns the full candidate table
     plus the chosen plan: {"backend", "num_groups", "block_size"}.
 
+    `block_sizes=None` races the tuned candidate grid
+    (fedmse_tpu/tune sites.QUANT_BLOCK_CANDIDATES — the pow2 trio plus the
+    192/384 midpoints the pow2 default never considered). Measured plans
+    persist in the tuning cache under site 'merge_plan', keyed on the FULL
+    argument signature plus backend/device — an exact-signature hit skips
+    the re-measure (returned with "cached": True); anything stale
+    re-measures. Cache writes are FEDMSE_TUNE-gated (tune/cache.py).
+
     `n_hosts` is the host-group count used for the f32 baseline's DCN
     accounting (default: the mesh's real process topology). On a real pod
     the quantized candidates should use num_groups=0 (real topology);
@@ -216,13 +224,29 @@ def plan_merge(mesh, elem_counts: Sequence[int], *, k: int = 1,
 
     from fedmse_tpu.parallel.collectives import (_make_quantized_exchange,
                                                  host_groups)
+    from fedmse_tpu.tune.cache import default_cache
+    from fedmse_tpu.tune.sites import QUANT_BLOCK_CANDIDATES, backend_signature
 
+    if block_sizes is None:
+        block_sizes = QUANT_BLOCK_CANDIDATES
     n_devices = int(mesh.devices.size)
     if n_hosts is None:
         n_hosts = len(host_groups(mesh, 0))
     if group_counts is None:
         group_counts = _group_count_candidates(
             n_devices, n_hosts)[:max_group_candidates]
+
+    cache = default_cache()
+    plan_sig = {**backend_signature(),
+                "elem_counts": [int(e) for e in elem_counts], "k": int(k),
+                "axis_name": axis_name, "n_devices": n_devices,
+                "n_hosts": int(n_hosts),
+                "group_counts": [int(g) for g in group_counts],
+                "block_sizes": [int(b) for b in block_sizes],
+                "dcn_gbps": float(dcn_gbps), "repeats": int(repeats)}
+    hit = cache.lookup("merge_plan", plan_sig)
+    if hit is not None:
+        return {**hit["plan"], "cached": True}
     merged = k * int(sum(elem_counts))
     payloads = tuple(jnp.ones((k, int(e)), jnp.float32)
                      for e in elem_counts)
@@ -273,7 +297,7 @@ def plan_merge(mesh, elem_counts: Sequence[int], *, k: int = 1,
                           lane_sliced_dcn_bytes(payload_q, g))
 
     best = min(candidates, key=lambda c: c["score_s"])
-    return {
+    plan = {
         "chosen": {"backend": best["backend"],
                    "num_groups": best["num_groups"],
                    "block_size": best["block_size"]},
@@ -285,3 +309,5 @@ def plan_merge(mesh, elem_counts: Sequence[int], *, k: int = 1,
         "n_hosts": int(n_hosts),
         "dcn_gbps": float(dcn_gbps),
     }
+    cache.store("merge_plan", plan_sig, plan["chosen"], plan=plan)
+    return {**plan, "cached": False}

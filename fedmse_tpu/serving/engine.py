@@ -44,7 +44,8 @@ import collections
 import dataclasses
 import time
 import warnings
-from typing import Any, Dict, Optional, Union
+from bisect import bisect_left
+from typing import Any, Dict, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -224,6 +225,15 @@ class ServingEngine:
         thresholds come from the ordinary `fit_calibration` path — it
         calibrates through engine.score, whatever the score kind.
     max_bucket : largest compiled row bucket; larger requests are chunked.
+    bucket_ladder : the compiled row-bucket ladder (fedmse_tpu/tune,
+        DESIGN.md §24). 'auto' (default) consults the measured tuning
+        cache for a ladder keyed on (backend, max_bucket, dim) and falls
+        back to the historical pow2 ladder on a miss — so engines whose
+        max_bucket was never tuned (tests, tiny deployments) behave
+        exactly as before. 'pow2' forces the historical ladder; an
+        explicit ascending int sequence (last rung == max_bucket) is used
+        verbatim. Every rung is one compiled program; `bucket_for` pads a
+        request to the smallest rung that holds it.
     precision : 'f32' (default, bit-identical to the pre-policy engine) or
         'bf16' (or a PrecisionPolicy, ops/precision.py). Under bf16 the
         resident params and the dispatched row buffers are bfloat16 —
@@ -292,6 +302,7 @@ class ServingEngine:
                  score_kind: str = "auto", knn_k: int = 8,
                  knn_topk: str = "exact", multi_tenant: bool = True,
                  max_bucket: int = 1024,
+                 bucket_ladder: Union[str, Sequence[int]] = "auto",
                  precision: Union[str, PrecisionPolicy] = "f32",
                  mesh: Any = None, routing: str = "auto",
                  roster: Optional[ServingRoster] = None):
@@ -342,6 +353,7 @@ class ServingEngine:
         self.knn_k = knn_k
         self.knn_topk = knn_topk
         self.max_bucket = 1 << (max_bucket - 1).bit_length()  # round up pow2
+        self._ladder = self._resolve_ladder(bucket_ladder)
         self.num_gateways = (
             jax.tree.leaves(params)[0].shape[0] if multi_tenant else 1)
         if routing not in ("auto", "gather", "dense"):
@@ -603,21 +615,50 @@ class ServingEngine:
 
     # ------------------------- compiled programs ------------------------- #
 
+    def _resolve_ladder(self, bucket_ladder):
+        """Resolve the compiled bucket ladder (see the class docstring).
+        The tuned lookup is a pure cache read keyed on (backend,
+        max_bucket, dim) — a miss, a missing tune package, or any lookup
+        failure degrades to the historical pow2 ladder."""
+        if isinstance(bucket_ladder, str):
+            if bucket_ladder == "pow2":
+                bucket_ladder = None
+            elif bucket_ladder == "auto":
+                try:
+                    from fedmse_tpu.tune import sites
+                    bucket_ladder = sites.lookup_serve_ladder(
+                        self.max_bucket,
+                        int(getattr(self.model, "input_dim", 0)))
+                except Exception:
+                    bucket_ladder = None
+            else:
+                raise ValueError(f"unknown bucket_ladder {bucket_ladder!r} "
+                                 "('auto' | 'pow2' | explicit sequence)")
+        if bucket_ladder is None:
+            out, b = [], 1
+            while b <= self.max_bucket:
+                out.append(b)
+                b <<= 1
+            return out
+        ladder = sorted({int(b) for b in bucket_ladder})
+        if not ladder or ladder[0] < 1 or ladder[-1] != self.max_bucket:
+            raise ValueError(
+                f"bucket ladder {ladder} must be ascending positive rungs "
+                f"ending at max_bucket {self.max_bucket}")
+        return ladder
+
     @property
     def buckets(self):
-        """Every static row bucket this engine compiles (powers of two)."""
-        out, b = [], 1
-        while b <= self.max_bucket:
-            out.append(b)
-            b <<= 1
-        return out
+        """Every static row bucket this engine compiles (ascending; the
+        pow2 ladder unless a tuned/explicit ladder was installed)."""
+        return list(self._ladder)
 
     def bucket_for(self, n_rows: int) -> int:
-        """Smallest power-of-two bucket holding n_rows (<= max_bucket)."""
+        """Smallest ladder bucket holding n_rows (<= max_bucket)."""
         if n_rows > self.max_bucket:
             raise ValueError(f"{n_rows} rows exceed max_bucket "
                              f"{self.max_bucket}; chunk first")
-        return 1 << max(0, n_rows - 1).bit_length()
+        return self._ladder[bisect_left(self._ladder, max(n_rows, 1))]
 
     def _build_scorer(self):
         model, kind = self.model, self.score_kind

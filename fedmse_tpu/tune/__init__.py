@@ -1,0 +1,28 @@
+"""Measured autotuner for launch-size knobs (DESIGN.md §24).
+
+Every size knob in the stack used to be a pow2 heuristic: the Pallas
+forward's `block_rows` (a v5e constant), the serving engine's pow2 bucket
+ladder, the tiered init chunk, and the int8 quantize block. This package
+replaces convention with measurement — the `plan_merge` discipline from
+parallel/costmodel.py (warm once, min-over-k wall) generalized into:
+
+  * `cache`   — a backend+shape-keyed JSON tuning cache (TUNE_CACHE.json,
+                a committed artifact for this box). Lookups require an
+                EXACT signature match; anything else re-measures — a
+                stale entry can never be silently reused. Writes are
+                gated by FEDMSE_TUNE=1 so test runs never mutate the
+                committed artifact.
+  * `measure` — warm, min-over-k candidate timing.
+  * `sites`   — the four migrated call sites: tune_* measures and
+                persists a winner, lookup_* is the cheap hot-path read
+                consumed by ops/pallas_ae.py, serving/engine.py,
+                federation/tiered.py and parallel/costmodel.py.
+
+`bench.py --fusedstep-bench` (FEDMSE_TUNE=1) populates the cache and
+records tuned-vs-pow2 walls in BENCH_FUSEDSTEP artifacts.
+"""
+
+from fedmse_tpu.tune.cache import (DEFAULT_PATH, TuningCache,  # noqa: F401
+                                   default_cache)
+from fedmse_tpu.tune.measure import best_wall, measure_candidates  # noqa: F401
+from fedmse_tpu.tune import sites  # noqa: F401

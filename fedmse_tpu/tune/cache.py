@@ -1,0 +1,167 @@
+"""Backend+shape-keyed JSON tuning cache with exact-signature invalidation.
+
+One file, one schema:
+
+    {"version": 1,
+     "sites": {"<site>": [{"signature": {...}, "choice": ...,
+                           "measured_at": <unix>, ...extras}, ...]}}
+
+The SIGNATURE is the invalidation rule: a lookup returns an entry only
+when its JSON-normalized signature equals the caller's exactly — backend,
+device kind, probe shape, candidate set, everything the measurement
+depended on. A mismatched entry is simply invisible, so the caller falls
+through to re-measure; there is no fuzzy matching and no partial reuse
+(tests/test_tune.py pins the stale-signature path).
+
+Write discipline: the default cache path is a COMMITTED artifact
+(TUNE_CACHE.json at the repo root), so disk writes are gated — they
+happen only when FEDMSE_TUNE=1 is set (the bench does this) or the cache
+was constructed explicitly writable. Un-gated `store` calls still update
+the in-process copy, so a session that measured once does not measure
+again; they just never dirty the working tree. Disk writes are atomic
+(tmp + os.replace) and re-read on mtime change, so concurrent readers
+see whole files only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, Optional
+
+DEFAULT_PATH = Path(__file__).resolve().parents[2] / "TUNE_CACHE.json"
+ENV_PATH = "FEDMSE_TUNE_CACHE"    # override the cache file location
+ENV_WRITE = "FEDMSE_TUNE"         # "1" = stores may write to disk
+VERSION = 1
+
+
+def normalize_signature(sig: Any) -> Any:
+    """Canonical JSON image of a signature (sorted keys, tuples->lists):
+    what equality is defined over, both in memory and on disk."""
+    return json.loads(json.dumps(sig, sort_keys=True))
+
+
+class TuningCache:
+    """See module docstring. Thread-safe; cheap repeated lookups (the file
+    is memoized on (mtime_ns, size) and only re-parsed when it changes)."""
+
+    def __init__(self, path: Optional[os.PathLike] = None,
+                 writable: Optional[bool] = None) -> None:
+        if path is None:
+            path = os.environ.get(ENV_PATH) or DEFAULT_PATH
+        self.path = Path(path)
+        self._writable = writable
+        self._lock = threading.Lock()
+        self._stat_key: Any = ()
+        self._data: Dict[str, Any] = {"version": VERSION, "sites": {}}
+        self._dirty = False  # un-gated stores live only in self._data
+
+    @property
+    def writable(self) -> bool:
+        if self._writable is None:
+            return os.environ.get(ENV_WRITE) == "1"
+        return bool(self._writable)
+
+    # ------------------------------------------------------------------ #
+
+    def _read_locked(self) -> Dict[str, Any]:
+        try:
+            st = self.path.stat()
+            key = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            key = None
+        if key == self._stat_key or (key is None and self._dirty):
+            return self._data
+        data: Dict[str, Any] = {"version": VERSION, "sites": {}}
+        if key is not None:
+            try:
+                loaded = json.loads(self.path.read_text())
+                if isinstance(loaded, dict) and loaded.get("version") == VERSION:
+                    data = loaded
+            except (OSError, ValueError):
+                pass  # unreadable cache == empty cache: re-measure
+        self._stat_key = key
+        self._data = data
+        self._dirty = False
+        return data
+
+    def lookup(self, site: str, signature: Any) -> Optional[Dict[str, Any]]:
+        """The entry whose signature matches EXACTLY, else None."""
+        sig = normalize_signature(signature)
+        with self._lock:
+            for entry in self._read_locked().get("sites", {}).get(site, []):
+                if entry.get("signature") == sig:
+                    return dict(entry)
+        return None
+
+    def store(self, site: str, signature: Any, choice: Any,
+              **extras: Any) -> Dict[str, Any]:
+        """Insert/replace the entry for (site, signature). Disk write only
+        when `writable` (see module docstring); always updates memory."""
+        entry = {"signature": normalize_signature(signature),
+                 "choice": choice,
+                 "measured_at": time.time(), **extras}
+        entry = normalize_signature(entry)  # one canonical JSON image
+        with self._lock:
+            data = self._read_locked()
+            rows = data.setdefault("sites", {}).setdefault(site, [])
+            rows[:] = [e for e in rows
+                       if e.get("signature") != entry["signature"]]
+            rows.append(entry)
+            if self.writable:
+                self._write_locked(data)
+            else:
+                self._dirty = True
+        return dict(entry)
+
+    def _write_locked(self, data: Dict[str, Any]) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=str(self.path.parent),
+                                   prefix=self.path.name, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.chmod(tmp, 0o644)  # mkstemp's 0600 is wrong for a committed file
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        st = self.path.stat()
+        self._stat_key = (st.st_mtime_ns, st.st_size)
+        self._data = data
+        self._dirty = False
+
+    def get_or_measure(self, site: str, signature: Any,
+                       measure: Callable[[], Dict[str, Any]]
+                       ) -> Dict[str, Any]:
+        """Cached entry on exact signature match; otherwise run `measure`
+        (must return {"choice": ..., ...extras}) and store its result.
+        The returned entry carries "cached": True/False accordingly."""
+        hit = self.lookup(site, signature)
+        if hit is not None:
+            return {**hit, "cached": True}
+        result = dict(measure())
+        choice = result.pop("choice")
+        entry = self.store(site, signature, choice, **result)
+        return {**entry, "cached": False}
+
+
+_default: Optional[TuningCache] = None
+
+
+def default_cache() -> TuningCache:
+    """Process-wide cache at the env-resolved path (rebuilt if
+    FEDMSE_TUNE_CACHE changes — tests repoint it at tmp dirs)."""
+    global _default
+    path = os.environ.get(ENV_PATH) or str(DEFAULT_PATH)
+    if _default is None or str(_default.path) != str(path):
+        _default = TuningCache(path)
+    return _default

@@ -1,0 +1,44 @@
+"""Warm, min-over-k candidate timing — plan_merge's discipline, factored.
+
+`best_wall` is parallel/costmodel.py's `_best_wall` contract: one
+un-timed call first (compile + warm caches), then the MINIMUM wall over
+`repeats` timed calls — min, not mean, because launch-size decisions care
+about the achievable cost of a configuration, and one-sided scheduler
+noise only ever inflates a sample. `measure_candidates` runs it across a
+candidate list and returns the argmin with the full table (the table is
+what lands in TUNE_CACHE.json / bench artifacts — a choice without its
+losing candidates is not auditable).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Sequence
+
+
+def best_wall(fn: Callable[[], Any], repeats: int = 3) -> float:
+    """Min wall seconds of `fn()` over `repeats`, after one warm call.
+    Blocks on the returned value, so async jax dispatch is fully timed."""
+    import jax
+
+    jax.block_until_ready(fn())  # compile/warm outside the timed region
+    best = float("inf")
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measure_candidates(candidates: Sequence[Any],
+                       run: Callable[[Any], Any],
+                       repeats: int = 3) -> Dict[str, Any]:
+    """Time `run(candidate)` for each candidate; return
+    {"choice", "wall_s", "candidates": [{"value", "wall_s"}, ...]}."""
+    rows: List[Dict[str, Any]] = []
+    for cand in candidates:
+        rows.append({"value": cand,
+                     "wall_s": best_wall(lambda: run(cand), repeats=repeats)})
+    best = min(rows, key=lambda r: r["wall_s"])
+    return {"choice": best["value"], "wall_s": best["wall_s"],
+            "candidates": rows}

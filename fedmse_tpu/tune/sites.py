@@ -1,0 +1,254 @@
+"""The four migrated launch-size call sites (tune_* measure, lookup_* read).
+
+Each site has a fixed PROBE — the representative workload the candidates
+race on — and a signature binding (backend, device kind, probe spec,
+candidate set) so a cache entry measured on another box, another backend,
+or another candidate grid is invisible and the caller re-measures:
+
+  * `pallas_block_rows`  — ops/pallas_ae.py `fused_forward_stats`
+    block_rows=None. Races the packed forward at the eval volume over the
+    Pallas grid actually executed on this backend ('pallas' on TPU,
+    'interpret' elsewhere — the interpret path's per-grid-step overhead
+    is real cost on this box, which is exactly why measurement beats the
+    v5e constant here).
+  * `serve_bucket_ladder` — serving/engine.py bucket_ladder="auto". Races
+    whole LADDERS, not single sizes: per-rung scoring wall is measured
+    once per distinct rung, then each ladder is scored as the expected
+    dispatch wall over a deterministic spread of request sizes. The pow2
+    ladder pays up to 2x row padding just under each rung; the
+    pow2+midpoint ladder halves the worst-case padding for one extra
+    compiled program per octave.
+  * `tier_init_chunk`    — federation/tiered.py init_chunk=None. Races
+    `TieredClientStore.create` (vmapped per-chunk device init + host
+    scatter) at a probe fleet width.
+  * int8 quantize block  — parallel/costmodel.py plan_merge block_sizes=
+    None resolves to `QUANT_BLOCK_CANDIDATES` (the pow2 trio plus the
+    midpoints PR 19 never raced), and the measured plan itself persists
+    under site 'merge_plan'.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from fedmse_tpu.tune.cache import TuningCache, default_cache
+from fedmse_tpu.tune.measure import measure_candidates
+
+BLOCK_ROWS_CANDIDATES = (512, 1024, 1536, 2048, 3072, 4096, 6144, 8192)
+TIER_CHUNK_CANDIDATES = (512, 1024, 2048, 3072, 4096, 6144, 8192)
+QUANT_BLOCK_CANDIDATES = (128, 192, 256, 384, 512)
+
+# probe shapes: the reference AE topology at the r04 eval volume
+_PROBE_DIM, _PROBE_HIDDEN, _PROBE_LATENT = 115, 27, 7
+_BLOCK_PROBE_ROWS = 16384
+_TIER_PROBE_CLIENTS = 4096
+_LADDER_PROBE_DRAWS = 64
+
+
+def backend_signature() -> Dict[str, str]:
+    """What a measurement is valid for: the jax backend + device kind."""
+    import jax
+
+    dev = jax.devices()[0]
+    return {"backend": jax.default_backend(),
+            "device": str(getattr(dev, "device_kind", dev.platform))}
+
+
+def _probe_params(rng: np.random.Generator):
+    import jax.numpy as jnp
+
+    def dense(din, dout):
+        return {"kernel": jnp.asarray(rng.normal(size=(din, dout)) * 0.1,
+                                      jnp.float32),
+                "bias": jnp.asarray(rng.normal(size=(dout,)) * 0.01,
+                                    jnp.float32)}
+
+    return {"encoder": {"Dense_0": dense(_PROBE_DIM, _PROBE_HIDDEN),
+                        "Dense_1": dense(_PROBE_HIDDEN, _PROBE_LATENT)},
+            "decoder": {"Dense_0": dense(_PROBE_LATENT, _PROBE_HIDDEN),
+                        "Dense_1": dense(_PROBE_HIDDEN, _PROBE_DIM)}}
+
+
+# --------------------------- pallas block_rows --------------------------- #
+
+def _block_rows_signature(
+        candidates: Sequence[int] = BLOCK_ROWS_CANDIDATES) -> Dict[str, Any]:
+    sig = backend_signature()
+    return {**sig,
+            "mode": "pallas" if sig["backend"] == "tpu" else "interpret",
+            "probe_rows": _BLOCK_PROBE_ROWS, "dim": _PROBE_DIM,
+            "candidates": list(candidates)}
+
+
+def lookup_block_rows(cache: Optional[TuningCache] = None) -> Optional[int]:
+    """Tuned block_rows for this backend, or None (caller falls back to
+    the BLOCK_ROWS constant). Pure cache read — never measures."""
+    cache = cache or default_cache()
+    hit = cache.lookup("pallas_block_rows", _block_rows_signature())
+    return int(hit["choice"]) if hit else None
+
+
+def tune_block_rows(cache: Optional[TuningCache] = None, repeats: int = 3,
+                    candidates: Sequence[int] = BLOCK_ROWS_CANDIDATES,
+                    probe_rows: int = _BLOCK_PROBE_ROWS) -> Dict[str, Any]:
+    """Race the packed forward per candidate block size and persist the
+    winner. Measures the Pallas grid path this backend actually executes."""
+    import jax.numpy as jnp
+
+    from fedmse_tpu.ops import pallas_ae
+
+    cache = cache or default_cache()
+    sig = _block_rows_signature(candidates)
+    sig["probe_rows"] = int(probe_rows)
+    rng = np.random.default_rng(0)
+    params = _probe_params(rng)
+    x = jnp.asarray(rng.normal(size=(probe_rows, _PROBE_DIM)), jnp.float32)
+
+    def run(block):
+        return pallas_ae.fused_forward_stats(
+            params, x, latent_dim=_PROBE_LATENT, mode=sig["mode"],
+            block_rows=int(block))[1]
+
+    result = measure_candidates(candidates, run, repeats=repeats)
+    pow2 = next((r["wall_s"] for r in result["candidates"]
+                 if int(r["value"]) == pallas_ae.BLOCK_ROWS), None)
+    return cache.store("pallas_block_rows", sig, int(result["choice"]),
+                       wall_s=result["wall_s"], pow2_default_wall_s=pow2,
+                       candidates=result["candidates"])
+
+
+# --------------------------- serving bucket ladder ----------------------- #
+
+def pow2_ladder(max_bucket: int) -> List[int]:
+    out, b = [], 1
+    while b <= max_bucket:
+        out.append(b)
+        b <<= 1
+    return out
+
+
+def ladder_candidates(max_bucket: int) -> Dict[str, List[int]]:
+    """The raced ladders. 'pow2' is the engine's historical default;
+    'pow2_mid' adds the 3·2ᵏ midpoint rung per octave (worst-case row
+    padding 2x -> 1.33x, one extra compiled program per octave)."""
+    p2 = pow2_ladder(max_bucket)
+    mids = {3 * b for b in p2 if 3 * b < max_bucket and b >= 1}
+    return {"pow2": p2, "pow2_mid": sorted(set(p2) | mids)}
+
+
+def ladder_bucket_for(n_rows: int, ladder: Sequence[int]) -> int:
+    """Smallest ladder rung holding n_rows (ladder sorted ascending)."""
+    i = bisect_left(ladder, max(n_rows, 1))
+    if i >= len(ladder):
+        raise ValueError(f"{n_rows} rows exceed max bucket {ladder[-1]}")
+    return int(ladder[i])
+
+
+def _serve_signature(max_bucket: int, dim: int) -> Dict[str, Any]:
+    return {**backend_signature(), "max_bucket": int(max_bucket),
+            "dim": int(dim), "probe_draws": _LADDER_PROBE_DRAWS}
+
+
+def lookup_serve_ladder(max_bucket: int, dim: int = _PROBE_DIM,
+                        cache: Optional[TuningCache] = None
+                        ) -> Optional[List[int]]:
+    """Tuned bucket ladder for (backend, max_bucket, dim), or None (caller
+    keeps the pow2 ladder). The signature keys on max_bucket, so small
+    test engines never see an entry tuned for the serving default."""
+    cache = cache or default_cache()
+    hit = cache.lookup("serve_bucket_ladder", _serve_signature(max_bucket, dim))
+    return [int(b) for b in hit["choice"]] if hit else None
+
+
+def tune_serve_ladder(max_bucket: int = 1024, dim: int = _PROBE_DIM,
+                      repeats: int = 3,
+                      cache: Optional[TuningCache] = None) -> Dict[str, Any]:
+    """Race whole ladders on the packed scoring forward: measure wall once
+    per distinct rung, score each ladder as the MEAN dispatch wall over a
+    deterministic spread of request sizes in [1, max_bucket]."""
+    import jax.numpy as jnp
+
+    from fedmse_tpu.ops import pallas_ae
+
+    cache = cache or default_cache()
+    sig = _serve_signature(max_bucket, dim)
+    ladders = ladder_candidates(max_bucket)
+    rng = np.random.default_rng(0)
+    params = _probe_params(rng)
+    # deterministic pseudo-uniform request sizes (golden-ratio stride)
+    sizes = [int(((i * 0.6180339887) % 1.0) * max_bucket) + 1
+             for i in range(1, _LADDER_PROBE_DRAWS + 1)]
+
+    rungs = sorted({ladder_bucket_for(n, lad)
+                    for lad in ladders.values() for n in sizes})
+    xs = {r: jnp.asarray(rng.normal(size=(r, dim)), jnp.float32)
+          for r in rungs}
+
+    def run(rung):
+        return pallas_ae.fused_forward_stats(
+            params, xs[rung], latent_dim=_PROBE_LATENT, mode="xla")[1]
+
+    walls = {r["value"]: r["wall_s"]
+             for r in measure_candidates(rungs, run,
+                                         repeats=repeats)["candidates"]}
+    scored = {name: float(np.mean([walls[ladder_bucket_for(n, lad)]
+                                   for n in sizes]))
+              for name, lad in ladders.items()}
+    best_name = min(scored, key=scored.get)
+    return cache.store(
+        "serve_bucket_ladder", sig, list(ladders[best_name]),
+        ladder_name=best_name, expected_wall_s=scored,
+        pow2_wall_s=scored["pow2"], rung_walls={str(k): v
+                                                for k, v in walls.items()})
+
+
+# --------------------------- tiered init chunk --------------------------- #
+
+def _tier_signature(
+        candidates: Sequence[int] = TIER_CHUNK_CANDIDATES) -> Dict[str, Any]:
+    return {**backend_signature(), "probe_clients": _TIER_PROBE_CLIENTS,
+            "dim": _PROBE_DIM, "candidates": list(candidates)}
+
+
+def lookup_tier_chunk(cache: Optional[TuningCache] = None) -> Optional[int]:
+    """Tuned init_chunk for this backend, or None (caller falls back to
+    the historical 4096)."""
+    cache = cache or default_cache()
+    hit = cache.lookup("tier_init_chunk", _tier_signature())
+    return int(hit["choice"]) if hit else None
+
+
+def tune_tier_chunk(cache: Optional[TuningCache] = None, repeats: int = 2,
+                    candidates: Sequence[int] = TIER_CHUNK_CANDIDATES,
+                    probe_clients: int = _TIER_PROBE_CLIENTS
+                    ) -> Dict[str, Any]:
+    """Race `TieredClientStore.create` (the real call site: vmapped
+    per-chunk device init + host scatter) across chunk sizes."""
+    import jax
+    import optax
+
+    from fedmse_tpu.federation.state import TieredClientStore
+    from fedmse_tpu.models.autoencoder import ShrinkAutoencoder
+
+    cache = cache or default_cache()
+    sig = _tier_signature(candidates)
+    sig["probe_clients"] = int(probe_clients)
+    model = ShrinkAutoencoder(input_dim=_PROBE_DIM, hidden_neus=_PROBE_HIDDEN,
+                              latent_dim=_PROBE_LATENT)
+    tx = optax.adam(1e-3)
+    rng = jax.random.PRNGKey(0)
+
+    def run(chunk):
+        store = TieredClientStore.create(model, tx, rng, probe_clients,
+                                         init_chunk=int(chunk))
+        return store.host.params
+
+    result = measure_candidates(candidates, run, repeats=repeats)
+    pow2 = next((r["wall_s"] for r in result["candidates"]
+                 if int(r["value"]) == 4096), None)
+    return cache.store("tier_init_chunk", sig, int(result["choice"]),
+                       wall_s=result["wall_s"], pow2_default_wall_s=pow2,
+                       candidates=result["candidates"])

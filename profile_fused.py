@@ -206,7 +206,8 @@ def _phase_ablation(engine, chunks=(8, 32)):
     train_flat = make_local_train_all(
         model=engine.model, tx=flat_tx, epochs=cfg.epochs,
         patience=cfg.patience, fedprox=False, mu=cfg.fedprox_mu,
-        restore_best=not cfg.compat.no_best_restore)
+        restore_best=not cfg.compat.no_best_restore,
+        train_fusion=getattr(cfg, "train_fusion", "off"))
 
     def stub_train(params, opt_state, prev_global, sel_mask, txb, tmb,
                    vxb, vmb, sel_idx=None):
@@ -274,7 +275,15 @@ def _phase_ablation(engine, chunks=(8, 32)):
             shares[name.replace("no_", "")] = round(
                 full_b - result[name]["marginal_sec_per_round"], 6)
     shares["residual_skeleton"] = result["skeleton"]["marginal_sec_per_round"]
+    # the per-segment round budget as WALL SHARES of the full marginal
+    # round (train / vote_scoring / verify / eval / merge+control residual
+    # sum to ~1; negative jitter rounds to 0) — the headline the PROFILE
+    # artifact tracks across train_fusion modes
+    wall_shares = ({name: round(max(sec, 0.0) / full_b, 4)
+                    for name, sec in shares.items()}
+                   if full_b > 0 else {})
     out = {"variants": result, "marginal_attribution_sec": shares,
+           "wall_shares": wall_shares,
            "chunks": list(chunks),
            "method": "b(full) - b(variant) per phase; b fit over two "
                      "chunk sizes, min of REPS warm dispatches each"}
@@ -330,6 +339,25 @@ def _host_gap(engine, chunk: int = 8, n_chunks: int = 4):
     }
 
 
+def _tuned_sizes(cfg):
+    """The launch sizes this profile actually ran with (DESIGN.md §24):
+    pure tuning-cache lookups — None means no entry for this backend and
+    the code path fell back to its pow2 default."""
+    try:
+        from fedmse_tpu.ops.pallas_ae import BLOCK_ROWS
+        from fedmse_tpu.tune import sites
+        return {
+            "pallas_block_rows": sites.lookup_block_rows(),
+            "pallas_block_rows_default": BLOCK_ROWS,
+            "serve_bucket_ladder_1024": sites.lookup_serve_ladder(
+                1024, cfg.dim_features),
+            "tier_init_chunk": sites.lookup_tier_chunk(),
+            "tier_init_chunk_default": 4096,
+        }
+    except Exception as e:  # profile must survive a broken/missing cache
+        return {"error": repr(e)}
+
+
 def main():
     _ensure_live_backend()
     from fedmse_tpu.utils.platform import (capture_provenance,
@@ -345,8 +373,14 @@ def main():
 
     out_path = _arg("--out", "PROFILE.json")
     chunks = [int(c) for c in _arg("--chunks", "1,8,32,128").split(",")]
+    train_fusion = _arg("--train-fusion", "off")
+    if train_fusion not in ("off", "auto", "pallas", "interpret", "xla"):
+        sys.exit(f"--train-fusion expects off|auto|pallas|interpret|xla, "
+                 f"got {train_fusion!r}")
 
     cfg = ExperimentConfig()  # committed quick-run defaults
+    if train_fusion != "off":
+        cfg = cfg.replace(train_fusion=train_fusion)
     data, n_real, rngs = build_data(cfg, 10)
     model = make_model("hybrid", cfg.dim_features,
                        shrink_lambda=cfg.shrink_lambda)
@@ -404,6 +438,8 @@ def main():
                     "SAE-CEN + mse_avg, 5 epochs/round, batch 12, 50% "
                     "participation)",
         "device": str(device), "platform": device.platform,
+        "train_fusion": cfg.train_fusion,
+        "tuned_sizes": _tuned_sizes(cfg),
         "chunk_sweep": points,
         "fit": {"dispatch_overhead_s": round(a, 5),
                 "marginal_sec_per_round": round(b, 5),

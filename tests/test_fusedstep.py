@@ -1,0 +1,223 @@
+"""Fused Pallas train-step kernel (ops/pallas_ae.py train path, DESIGN.md
+§24): the hand-derived backward pinned per-leaf against `jax.grad` of the
+flax apply at f32 and bf16, the Pallas lowering pinned via interpret mode
+(interpret ≡ xla BITWISE — same math, same order), the custom-vjp route
+through the UNCHANGED Adam round body (train_fusion=xla vs the autodiff
+body, both model types), masked/padded-row exactness, multi-block grid
+accumulation, and the znorm-unification edges (0-row/1-row equal across
+every mode through the one shared helper in ops/distance.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedmse_tpu.federation.local_training import make_local_train_all
+from fedmse_tpu.models.autoencoder import init_stacked_params
+from fedmse_tpu.models import make_model
+from fedmse_tpu.ops.distance import row_norms_packed
+from fedmse_tpu.ops.pallas_ae import (fused_forward_stats, fused_train_grads,
+                                      make_fused_train_loss)
+
+pytestmark = pytest.mark.fusedstep
+
+DIM, HIDDEN, LATENT = 115, 27, 7
+
+
+def _model(model_type: str, precision: str = "f32"):
+    return make_model(model_type, dim_features=DIM, hidden_neus=HIDDEN,
+                      latent_dim=LATENT, precision=precision)
+
+
+def _params(model, seed=0):
+    return model.init(jax.random.PRNGKey(seed), jnp.zeros((1, DIM)))["params"]
+
+
+def _batch(rows, seed=0, pad_from=None):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(rows, DIM)), jnp.float32)
+    m = jnp.ones((rows,), jnp.float32)
+    if pad_from is not None:
+        x = x.at[pad_from:].set(0.0)
+        m = m.at[pad_from:].set(0.0)
+    return x, m
+
+
+def _ref_value_and_grad(model, params, x, m):
+    def loss_fn(p):
+        latent, recon = model.apply({"params": p}, x)
+        return model.loss(x, latent, recon, m)
+    return jax.value_and_grad(loss_fn)(params)
+
+
+def _leaf_rel(ref, got):
+    """Per-leaf scale-normalized error: max|Δ| / max|ref| (elementwise
+    relative error is meaningless at near-zero entries)."""
+    return jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))
+                           / (jnp.max(jnp.abs(a)) + 1e-30)), ref, got)
+
+
+@pytest.mark.parametrize("model_type", ["autoencoder", "hybrid"])
+@pytest.mark.parametrize("mode", ["xla", "interpret"])
+def test_grad_parity_f32(model_type, mode):
+    """ISSUE r20 acceptance: per-leaf grads <= 1e-5 rel vs flax autodiff
+    at f32, both model types, xla AND interpret."""
+    model = _model(model_type)
+    params = _params(model)
+    lam = float(getattr(model, "shrink_lambda", 0.0))
+    x, m = _batch(12, pad_from=9)
+    ref_l, ref_g = _ref_value_and_grad(model, params, x, m)
+    loss, grads = fused_train_grads(params, x, m, shrink_lambda=lam,
+                                    mode=mode)
+    assert jax.tree_util.tree_structure(grads) == \
+        jax.tree_util.tree_structure(params)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-6)
+    rel = _leaf_rel(ref_g, grads)
+    assert max(jax.tree_util.tree_leaves(rel)) <= 1e-5, rel
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert leaf.dtype == jnp.float32  # grads are f32 masters
+
+
+@pytest.mark.parametrize("model_type", ["autoencoder", "hybrid"])
+def test_grad_parity_bf16(model_type):
+    """bf16 tiles: f32-accum contract held through the backward — grads
+    stay f32 and track the bf16 flax autodiff body to bf16-scale slack."""
+    model = _model(model_type, precision="bf16")
+    params = _params(model)
+    lam = float(getattr(model, "shrink_lambda", 0.0))
+    x, m = _batch(12)
+    ref_l, ref_g = _ref_value_and_grad(model, params, x, m)
+    loss, grads = fused_train_grads(params, x, m, shrink_lambda=lam,
+                                    mode="xla", compute_dtype=jnp.bfloat16)
+    # bf16 has ~3 decimal digits; both bodies quantize at different points
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=3e-2)
+    rel = _leaf_rel(ref_g, grads)
+    assert max(jax.tree_util.tree_leaves(rel)) <= 6e-2, rel
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert leaf.dtype == jnp.float32
+
+
+@pytest.mark.parametrize("rows", [12, 40])
+def test_interpret_equals_xla(rows):
+    """The Pallas lowering pin (CPU discipline): interpret mode runs the
+    kernel's real dataflow. Direct calls track the XLA twin to fp
+    re-association slack only (the kernel pads rows to the block, which
+    changes XLA's reduction shapes), both on a single-block grid and when
+    block_rows=16 forces multi-step grid accumulation. The BITWISE
+    interpret ≡ xla pin lives in test_round_body_xla_matches_autodiff."""
+    model = _model("hybrid")
+    params = _params(model)
+    x, m = _batch(rows)
+    lx, gx = fused_train_grads(params, x, m, shrink_lambda=10.0, mode="xla")
+    for block in (64, 16):
+        li, gi = fused_train_grads(params, x, m, shrink_lambda=10.0,
+                                   mode="interpret", block_rows=block)
+        np.testing.assert_allclose(float(li), float(lx), rtol=1e-6)
+        rel = _leaf_rel(gx, gi)
+        assert max(jax.tree_util.tree_leaves(rel)) <= 1e-6, (block, rel)
+
+
+@pytest.mark.parametrize("model_type", ["autoencoder", "hybrid"])
+def test_round_body_xla_matches_autodiff(model_type):
+    """train_fusion=xla through the UNCHANGED Adam round body (vmap over
+    clients, scan over batches, while_loop over epochs with early stop)
+    tracks the autodiff body per-leaf; interpret is bitwise xla."""
+    model = _model(model_type)
+    N, B, NB, NVB = 4, 12, 3, 2
+    params = init_stacked_params(model, jax.random.PRNGKey(0), N)
+    tx = optax.adam(1e-3)
+    opt = jax.vmap(tx.init)(params)
+    rng = np.random.default_rng(1)
+    txb = jnp.asarray(rng.normal(size=(N, NB, B, DIM)), jnp.float32)
+    tmb = jnp.ones((N, NB, B), jnp.float32).at[:, -1, 6:].set(0.0)
+    txb = txb * tmb[..., None]
+    vxb = jnp.asarray(rng.normal(size=(N, NVB, B, DIM)), jnp.float32)
+    vmb = jnp.ones((N, NVB, B), jnp.float32)
+    sel = jnp.ones((N,), jnp.float32)
+    fedprox = model_type == "autoencoder"  # exercise the prox sum too
+    outs = {}
+    for mode in ("off", "xla", "interpret"):
+        train = make_local_train_all(model, tx, epochs=3, patience=1,
+                                     fedprox=fedprox, mu=0.01, donate=False,
+                                     train_fusion=mode)
+        outs[mode] = train(params, opt, params, sel, txb, tmb, vxb, vmb)
+    for mode in ("xla", "interpret"):
+        scale = max(float(jnp.max(jnp.abs(leaf)))
+                    for leaf in jax.tree_util.tree_leaves(outs["off"][0]))
+        delta = max(jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))),
+            outs["off"][0], outs[mode][0])))
+        assert delta <= 1e-5 * scale
+        np.testing.assert_allclose(np.asarray(outs[mode][3]),
+                                   np.asarray(outs["off"][3]), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(outs["xla"][0]),
+                    jax.tree_util.tree_leaves(outs["interpret"][0])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_all_masked_batch_matches_reference():
+    """An all-padded batch (M = 0): losses.masked_mean is NaN there (XLA
+    CPU flushes the 1e-38 safe-div subnormal to 0), and the fused path
+    must reproduce the reference semantics EXACTLY — same-shaped NaN loss
+    — not invent a safer answer. The round body discards these lanes via
+    the selection mask, exactly as it does for the autodiff body. A batch
+    with a single real row must stay finite and match the reference."""
+    model = _model("hybrid")
+    params = _params(model)
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(8, DIM)),
+                    jnp.float32)
+    m0 = jnp.zeros((8,), jnp.float32)
+    ref0, _ = _ref_value_and_grad(model, params, x * 0.0, m0)
+    assert np.isnan(float(ref0))  # repo semantics, pinned
+    m1 = m0.at[0].set(1.0)
+    ref1, ref_g1 = _ref_value_and_grad(model, params, x, m1)
+    for mode in ("xla", "interpret"):
+        l0, _ = fused_train_grads(params, x * 0.0, m0, shrink_lambda=10.0,
+                                  mode=mode)
+        assert np.isnan(float(l0))
+        l1, g1 = fused_train_grads(params, x, m1, shrink_lambda=10.0,
+                                   mode=mode)
+        assert np.isfinite(float(l1))
+        np.testing.assert_allclose(float(l1), float(ref1), rtol=1e-6)
+        rel = _leaf_rel(ref_g1, g1)
+        assert max(jax.tree_util.tree_leaves(rel)) <= 1e-5, (mode, rel)
+
+
+def test_znorm_edges_and_shared_helper():
+    """Satellite: znorm unified through ops/distance.row_norms_packed —
+    the helper is bitwise jnp.linalg.norm on real floats, and the packed
+    forward's 0-row/1-row edges agree across xla and interpret."""
+    z = jnp.asarray(np.random.default_rng(2).normal(size=(9, 7)), jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(row_norms_packed(z)),
+        np.asarray(jnp.linalg.norm(z, axis=-1, keepdims=True)))
+
+    model = _model("hybrid")
+    params = _params(model)
+    for rows in (0, 1):
+        x = jnp.asarray(np.random.default_rng(3).normal(size=(rows, DIM)),
+                        jnp.float32)
+        outs = {mode: fused_forward_stats(params, x, latent_dim=LATENT,
+                                          mode=mode)
+                for mode in ("xla", "interpret")}
+        for name, idx in (("latent", 0), ("mse", 1), ("znorm", 2)):
+            a, b = outs["xla"][idx], outs["interpret"][idx]
+            assert a.shape == b.shape
+            assert a.shape[0] == rows
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_primal_matches_fwd_value():
+    """make_fused_train_loss: the cheap forward-only primal (validation
+    scans) and the grad-producing fwd agree on the loss value to fp
+    re-association order."""
+    model = _model("hybrid")
+    params = _params(model)
+    x, m = _batch(24, pad_from=20)
+    floss = make_fused_train_loss(model, mode="xla")
+    primal = floss(params, x, m)                       # no grad requested
+    fwd_val, _ = jax.value_and_grad(floss)(params, x, m)
+    np.testing.assert_allclose(float(primal), float(fwd_val), rtol=1e-6)

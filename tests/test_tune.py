@@ -1,0 +1,206 @@
+"""Measured autotuner + tuning cache (fedmse_tpu/tune/, DESIGN.md §24):
+exact-signature invalidation (a stale entry is INVISIBLE and provably
+re-measures — the r20 acceptance criterion), FEDMSE_TUNE-gated disk
+writes (un-gated stores never dirty the committed TUNE_CACHE.json),
+min-over-k argmin with the full audit table, the ladder helpers, the
+serving engine's tuned/explicit ladder path (scores identical to pow2 —
+the ladder changes padding, never math), the pallas block_rows
+tune→lookup round trip, and plan_merge's cached re-plan skip."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedmse_tpu.models import make_model
+from fedmse_tpu.models.autoencoder import init_client_params
+from fedmse_tpu.parallel.costmodel import plan_merge
+from fedmse_tpu.serving.engine import ServingEngine
+from fedmse_tpu.tune import TuningCache, measure_candidates, sites
+from fedmse_tpu.tune.cache import default_cache
+
+pytestmark = pytest.mark.tune
+
+DIM = 115
+
+
+# ------------------------------ cache ------------------------------------ #
+
+def test_cache_roundtrip_exact_signature(tmp_path):
+    path = tmp_path / "tc.json"
+    c = TuningCache(path, writable=True)
+    sig = {"backend": "cpu", "probe": 8, "candidates": [1, 2]}
+    c.store("site", sig, 42, wall_s=0.5)
+    assert c.lookup("site", sig)["choice"] == 42
+    # signature equality is over the JSON image: key order and tuple vs
+    # list must not matter...
+    reordered = {"candidates": (1, 2), "probe": 8, "backend": "cpu"}
+    assert c.lookup("site", reordered)["choice"] == 42
+    # ...but ANY value drift makes the entry invisible
+    assert c.lookup("site", {**sig, "probe": 9}) is None
+    assert c.lookup("site", {**sig, "candidates": [1, 2, 4]}) is None
+    assert c.lookup("other_site", sig) is None
+    # a fresh reader sees the atomic write
+    assert TuningCache(path).lookup("site", sig)["choice"] == 42
+    on_disk = json.loads(path.read_text())
+    assert on_disk["version"] == 1 and "site" in on_disk["sites"]
+
+
+def test_stale_signature_provably_remeasures(tmp_path):
+    """Acceptance: a cache entry with a mismatched signature re-measures."""
+    c = TuningCache(tmp_path / "tc.json", writable=True)
+    calls = []
+
+    def measure():
+        calls.append(1)
+        return {"choice": 10 * len(calls), "wall_s": 0.1}
+
+    sig_a = {"backend": "cpu", "candidates": [1, 2]}
+    e1 = c.get_or_measure("s", sig_a, measure)
+    assert (e1["choice"], e1["cached"], len(calls)) == (10, False, 1)
+    e2 = c.get_or_measure("s", sig_a, measure)
+    assert (e2["choice"], e2["cached"], len(calls)) == (10, True, 1)
+    # changed candidate grid = stale signature -> measured AGAIN
+    sig_b = {"backend": "cpu", "candidates": [1, 2, 3]}
+    e3 = c.get_or_measure("s", sig_b, measure)
+    assert (e3["choice"], e3["cached"], len(calls)) == (20, False, 2)
+    # both entries coexist; re-storing sig_a REPLACES, never duplicates
+    c.store("s", sig_a, 99)
+    rows = json.loads((tmp_path / "tc.json").read_text())["sites"]["s"]
+    assert len(rows) == 2
+    assert c.lookup("s", sig_a)["choice"] == 99
+
+
+def test_writes_are_env_gated(tmp_path, monkeypatch):
+    monkeypatch.delenv("FEDMSE_TUNE", raising=False)
+    path = tmp_path / "tc.json"
+    c = TuningCache(path)  # writable=None -> FEDMSE_TUNE gate
+    c.store("s", {"a": 1}, 7)
+    assert not path.exists()                   # committed artifact untouched
+    assert c.lookup("s", {"a": 1})["choice"] == 7   # but the session reuses it
+    monkeypatch.setenv("FEDMSE_TUNE", "1")
+    c.store("s", {"a": 2}, 8)
+    data = json.loads(path.read_text())        # gated write flushes BOTH
+    sigs = [e["signature"] for e in data["sites"]["s"]]
+    assert {"a": 1} in sigs and {"a": 2} in sigs
+
+
+def test_corrupt_cache_reads_as_empty(tmp_path):
+    path = tmp_path / "tc.json"
+    path.write_text("{not json")
+    c = TuningCache(path, writable=True)
+    assert c.lookup("s", {"a": 1}) is None
+    c.store("s", {"a": 1}, 5)                  # and store repairs the file
+    assert TuningCache(path).lookup("s", {"a": 1})["choice"] == 5
+
+
+def test_measure_candidates_argmin_and_table():
+    def run(delay):
+        time.sleep(delay)
+        return delay
+
+    out = measure_candidates([0.004, 0.0, 0.002], run, repeats=1)
+    assert out["choice"] == 0.0
+    assert [r["value"] for r in out["candidates"]] == [0.004, 0.0, 0.002]
+    assert all(r["wall_s"] >= 0.0 for r in out["candidates"])
+    assert out["wall_s"] == min(r["wall_s"] for r in out["candidates"])
+
+
+# ------------------------------ ladders ----------------------------------- #
+
+def test_ladder_helpers():
+    assert sites.pow2_ladder(16) == [1, 2, 4, 8, 16]
+    lc = sites.ladder_candidates(16)
+    assert lc["pow2"] == [1, 2, 4, 8, 16]
+    assert lc["pow2_mid"] == [1, 2, 3, 4, 6, 8, 12, 16]
+    assert sites.ladder_bucket_for(5, lc["pow2"]) == 8
+    assert sites.ladder_bucket_for(5, lc["pow2_mid"]) == 6   # padding 8->6
+    assert sites.ladder_bucket_for(0, lc["pow2_mid"]) == 1
+    assert sites.ladder_bucket_for(16, lc["pow2_mid"]) == 16
+    with pytest.raises(ValueError):
+        sites.ladder_bucket_for(17, lc["pow2"])
+
+
+def _single_engine(**kw):
+    model = make_model("autoencoder", DIM)
+    params = init_client_params(model, jax.random.PRNGKey(0))
+    return model, ServingEngine(model, "autoencoder", params, None,
+                                multi_tenant=False, max_bucket=16, **kw)
+
+
+def test_engine_explicit_ladder_same_scores_less_padding():
+    _, e_mid = _single_engine(bucket_ladder=[1, 2, 3, 4, 6, 8, 12, 16])
+    _, e_p2 = _single_engine(bucket_ladder="pow2")
+    assert e_mid.buckets == [1, 2, 3, 4, 6, 8, 12, 16]
+    assert e_p2.buckets == [1, 2, 4, 8, 16]
+    assert (e_mid.bucket_for(5), e_p2.bucket_for(5)) == (6, 8)
+    rows = np.asarray(np.random.default_rng(0).normal(size=(5, DIM)),
+                      np.float32)
+    # the ladder changes PADDING only: scores are the same numbers
+    np.testing.assert_allclose(e_mid.score(rows), e_p2.score(rows),
+                               rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError):
+        _single_engine(bucket_ladder=[1, 2, 3])       # last rung != max_bucket
+    with pytest.raises(ValueError):
+        _single_engine(bucket_ladder=[0, 2, 16])      # non-positive rung
+    # no 1-rung is legal: a 1-row request just pads to the first rung
+    _, e_no1 = _single_engine(bucket_ladder=[2, 4, 16])
+    assert e_no1.bucket_for(1) == 2
+
+
+def test_engine_auto_ladder_reads_cache_keyed_on_max_bucket(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("FEDMSE_TUNE_CACHE", str(tmp_path / "tc.json"))
+    monkeypatch.setenv("FEDMSE_TUNE", "1")
+    tuned = [1, 2, 3, 4, 6, 8, 12, 16]
+    default_cache().store("serve_bucket_ladder",
+                          sites._serve_signature(16, DIM), tuned,
+                          ladder_name="pow2_mid")
+    _, eng = _single_engine(bucket_ladder="auto")
+    assert eng.buckets == tuned
+    assert sites.lookup_serve_ladder(16, DIM) == tuned
+    # an engine at another max_bucket misses the entry -> pow2 fallback
+    model = make_model("autoencoder", DIM)
+    params = init_client_params(model, jax.random.PRNGKey(0))
+    eng8 = ServingEngine(model, "autoencoder", params, None,
+                         multi_tenant=False, max_bucket=8)
+    assert eng8.buckets == [1, 2, 4, 8]
+    assert sites.lookup_serve_ladder(8, DIM) is None
+
+
+# ------------------------- block_rows round trip -------------------------- #
+
+def test_tune_block_rows_roundtrip(tmp_path, monkeypatch):
+    """tune -> store -> lookup under one signature; drifting the probe
+    makes the entry invisible again (pure-read lookup never measures)."""
+    monkeypatch.setattr(sites, "_BLOCK_PROBE_ROWS", 64)
+    cache = TuningCache(tmp_path / "tc.json", writable=True)
+    assert sites.lookup_block_rows(cache) is None
+    entry = sites.tune_block_rows(cache, repeats=1, probe_rows=64)
+    assert entry["choice"] in sites.BLOCK_ROWS_CANDIDATES
+    assert len(entry["candidates"]) == len(sites.BLOCK_ROWS_CANDIDATES)
+    assert sites.lookup_block_rows(cache) == entry["choice"]
+    monkeypatch.setattr(sites, "_BLOCK_PROBE_ROWS", 128)   # probe drift
+    assert sites.lookup_block_rows(cache) is None
+
+
+# ------------------------- plan_merge cache skip -------------------------- #
+
+def test_plan_merge_remeasure_skip(mesh8, tmp_path, monkeypatch):
+    """An identical plan_merge call hits the 'merge_plan' entry and skips
+    the measured search; ANY argument drift re-measures."""
+    monkeypatch.setenv("FEDMSE_TUNE_CACHE", str(tmp_path / "tc.json"))
+    monkeypatch.setenv("FEDMSE_TUNE", "1")
+    kw = dict(k=2, block_sizes=(64,), repeats=1, max_group_candidates=1)
+    p1 = plan_merge(mesh8, [64], **kw)
+    assert p1["cached"] is False
+    p2 = plan_merge(mesh8, [64], **kw)
+    assert p2["cached"] is True
+    assert p2["chosen"] == p1["chosen"]
+    assert p2["candidates"] == p1["candidates"]   # full audit table survives
+    p3 = plan_merge(mesh8, [64], **{**kw, "dcn_gbps": 50.0})
+    assert p3["cached"] is False
